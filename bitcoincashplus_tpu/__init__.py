@@ -1,0 +1,28 @@
+"""bitcoincashplus_tpu — a TPU-native full-node framework.
+
+A from-scratch re-design of the capabilities of ``grospy/bitcoincashplus``
+(a Bitcoin-Core-lineage full node; see SURVEY.md for the layer map) built
+TPU-first on JAX / XLA / Pallas / pjit:
+
+- consensus/  : params, serialization, tx/block primitives, Merkle, PoW rules
+                (reference: src/primitives/, src/consensus/, src/pow.cpp)
+- crypto/     : CPU crypto reference paths (sha256d, ripemd160, secp256k1 scalar)
+                (reference: src/crypto/, src/secp256k1/)
+- ops/        : Pallas/jnp TPU kernels (SHA-256d, Merkle tree-reduce, batch ECDSA)
+- parallel/   : device mesh, shard_map nonce sharding, dispatch/batching layer
+                (reference analogue: src/checkqueue.h CCheckQueue)
+- validation/ : chainstate engine — ConnectBlock/ActivateBestChain/coins views
+                (reference: src/validation.cpp, src/coins.*)
+- store/      : block files + sqlite-backed index/UTXO (reference: src/txdb.*,
+                src/dbwrapper.* over LevelDB)
+- mempool/    : ancestor-feerate mempool (reference: src/txmempool.*)
+- mining/     : block assembler + extranonce (reference: src/miner.cpp)
+- p2p/        : asyncio wire protocol (reference: src/net.*, src/net_processing.*)
+- rpc/        : JSON-RPC parity surface (reference: src/rpc/, src/httpserver.*)
+- node/       : init/flags/logging/scheduler; the --tpu flag (reference: src/init.*)
+- cli/        : bcpd / bcp-cli entry points (reference: src/bitcoind.cpp,
+                src/bitcoin-cli.cpp)
+- native/     : C++ hot-path CPU fallbacks loaded via ctypes
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""Consensus layer: serialization, primitives, Merkle, PoW, chain parameters."""

@@ -1,0 +1,186 @@
+"""Bitcoin wire serialization primitives.
+
+Re-designs the reference's template-based stream serialization
+(src/serialize.h READWRITE macros, src/streams.h CDataStream) as explicit
+little-endian codec functions over ``bytes`` / ``memoryview``. The wire format
+is consensus-critical and byte-identical to the reference; only the idiom
+changes (no C++ template metaprogramming — plain functions + a cursor).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+MAX_SIZE = 0x02000000  # src/serialize.h:~26 (MAX_SIZE) — sanity bound for sizes
+
+
+class DeserializationError(ValueError):
+    """Raised on malformed wire bytes (reference: std::ios_base::failure)."""
+
+
+@dataclass
+class ByteReader:
+    """Cursor over immutable bytes — replaces CDataStream's read side."""
+
+    data: memoryview
+    pos: int = 0
+
+    def __init__(self, data: bytes | bytearray | memoryview, pos: int = 0):
+        self.data = memoryview(data)
+        self.pos = pos
+
+    def read(self, n: int) -> memoryview:
+        if n < 0 or self.pos + n > len(self.data):
+            raise DeserializationError(
+                f"read past end: want {n} at {self.pos}, have {len(self.data)}"
+            )
+        out = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+    def read_bytes(self, n: int) -> bytes:
+        return bytes(self.read(n))
+
+    @property
+    def remaining(self) -> int:
+        return len(self.data) - self.pos
+
+    def empty(self) -> bool:
+        return self.pos >= len(self.data)
+
+
+# ---- fixed-width little-endian integers ----
+
+def ser_u8(v: int) -> bytes:
+    return struct.pack("<B", v)
+
+
+def ser_u16(v: int) -> bytes:
+    return struct.pack("<H", v)
+
+
+def ser_u32(v: int) -> bytes:
+    return struct.pack("<I", v)
+
+
+def ser_i32(v: int) -> bytes:
+    return struct.pack("<i", v)
+
+
+def ser_u64(v: int) -> bytes:
+    return struct.pack("<Q", v)
+
+
+def ser_i64(v: int) -> bytes:
+    return struct.pack("<q", v)
+
+
+def deser_u8(r: ByteReader) -> int:
+    return r.read(1)[0]
+
+
+def deser_u16(r: ByteReader) -> int:
+    return struct.unpack("<H", r.read(2))[0]
+
+
+def deser_u32(r: ByteReader) -> int:
+    return struct.unpack("<I", r.read(4))[0]
+
+
+def deser_i32(r: ByteReader) -> int:
+    return struct.unpack("<i", r.read(4))[0]
+
+
+def deser_u64(r: ByteReader) -> int:
+    return struct.unpack("<Q", r.read(8))[0]
+
+
+def deser_i64(r: ByteReader) -> int:
+    return struct.unpack("<q", r.read(8))[0]
+
+
+# ---- CompactSize varint (src/serialize.h:~200 WriteCompactSize/ReadCompactSize) ----
+
+def ser_compact_size(n: int) -> bytes:
+    if n < 0:
+        raise ValueError("negative compact size")
+    if n < 253:
+        return struct.pack("<B", n)
+    if n <= 0xFFFF:
+        return b"\xfd" + struct.pack("<H", n)
+    if n <= 0xFFFFFFFF:
+        return b"\xfe" + struct.pack("<I", n)
+    return b"\xff" + struct.pack("<Q", n)
+
+
+def deser_compact_size(r: ByteReader, range_check: bool = True) -> int:
+    tag = r.read(1)[0]
+    if tag < 253:
+        n = tag
+    elif tag == 253:
+        n = deser_u16(r)
+        if n < 253:
+            raise DeserializationError("non-canonical CompactSize")
+    elif tag == 254:
+        n = deser_u32(r)
+        if n < 0x10000:
+            raise DeserializationError("non-canonical CompactSize")
+    else:
+        n = deser_u64(r)
+        if n < 0x100000000:
+            raise DeserializationError("non-canonical CompactSize")
+    if range_check and n > MAX_SIZE:
+        raise DeserializationError("CompactSize exceeds MAX_SIZE")
+    return n
+
+
+# ---- variable-length byte strings / vectors ----
+
+def ser_var_bytes(b: bytes) -> bytes:
+    return ser_compact_size(len(b)) + b
+
+
+def deser_var_bytes(r: ByteReader) -> bytes:
+    n = deser_compact_size(r)
+    return r.read_bytes(n)
+
+
+def ser_vector(items, ser_item) -> bytes:
+    out = [ser_compact_size(len(items))]
+    for it in items:
+        out.append(ser_item(it))
+    return b"".join(out)
+
+
+def deser_vector(r: ByteReader, deser_item) -> list:
+    n = deser_compact_size(r)
+    # Do not pre-allocate by claimed n (DoS); items bound the loop naturally.
+    return [deser_item(r) for _ in range(n)]
+
+
+# ---- uint256 <-> bytes helpers (src/uint256.h) ----
+# Internal convention: a hash is 32 raw bytes in *wire order* (little-endian of
+# the number). Hex display is byte-reversed, matching uint256::GetHex.
+
+def uint256_from_bytes(b: bytes) -> int:
+    if len(b) != 32:
+        raise ValueError("uint256 needs 32 bytes")
+    return int.from_bytes(b, "little")
+
+
+def uint256_to_bytes(v: int) -> bytes:
+    return v.to_bytes(32, "little")
+
+
+def hash_to_hex(b: bytes) -> str:
+    """32 wire bytes -> display hex (reversed), e.g. block hashes in RPC."""
+    return bytes(reversed(b)).hex()
+
+
+def hex_to_hash(s: str) -> bytes:
+    """Display hex -> 32 wire bytes."""
+    b = bytes.fromhex(s)
+    if len(b) != 32:
+        raise ValueError("hash hex must be 64 chars")
+    return bytes(reversed(b))

@@ -1,0 +1,1 @@
+"""CPU crypto reference paths (the TPU kernels are differential-tested against these)."""

@@ -1,0 +1,15 @@
+"""Test configuration.
+
+Tests run on CPU with an 8-device virtual mesh so multi-chip sharding logic
+(parallel/) is exercised without TPU hardware — the same mechanism the driver
+uses for dryrun_multichip (see __graft_entry__.py). Must run before jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,74 @@
+"""CPU crypto tests (reference model: src/test/crypto_tests.cpp)."""
+
+import hashlib
+import struct
+
+from hypothesis import given
+from hypothesis import strategies as st
+
+from bitcoincashplus_tpu.crypto.hashes import (
+    SHA256_INIT,
+    hash160,
+    header_midstate,
+    ripemd160,
+    sha256,
+    sha256_compress,
+    sha256d,
+    sha256d_from_midstate,
+)
+
+
+class TestVectors:
+    def test_sha256_nist(self):
+        # FIPS 180-4 examples
+        assert sha256(b"abc").hex() == (
+            "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"
+        )
+        assert sha256(b"").hex() == (
+            "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855"
+        )
+
+    def test_sha256d(self):
+        assert sha256d(b"hello").hex() == (
+            "9595c9df90075148eb06860365df33584b75bff782a510c6cd4883a419833d50"
+        )
+
+    def test_ripemd160(self):
+        assert ripemd160(b"abc").hex() == "8eb208f7e05d987a9b044a8e98c6b087f15a0bfc"
+
+    def test_hash160(self):
+        # Genesis output pubkey -> well-known P2PKH hash
+        pubkey = bytes.fromhex(
+            "04678afdb0fe5548271967f1a67130b7105cd6a828e03909a67962e0ea1f61deb6"
+            "49f6bc3f4cef38c4f35504e51ec112de5c384df7ba0b8d578a4c702b6bf11d5f"
+        )
+        assert hash160(pubkey).hex() == "62e907b15cbf27d5425399ebf6f0fb50ebb88f18"
+
+
+class TestCompression:
+    """The pure-Python compression must agree with hashlib — it seeds the
+    midstates used by the mining kernel."""
+
+    @given(st.binary(min_size=64, max_size=64))
+    def test_single_block_vs_hashlib(self, block):
+        # hash of exactly-64-byte message: compress(msg) then compress(padding)
+        st1 = sha256_compress(SHA256_INIT, block)
+        pad = b"\x80" + b"\x00" * 55 + struct.pack(">Q", 512)
+        st2 = sha256_compress(st1, pad)
+        assert struct.pack(">8I", *st2) == hashlib.sha256(block).digest()
+
+    @given(st.binary(min_size=80, max_size=80))
+    def test_midstate_header_path(self, header):
+        expect = sha256d(header)
+        mid = header_midstate(header)
+        assert sha256d_from_midstate(mid, header[64:]) == expect
+
+    def test_genesis_header_midstate(self):
+        from bitcoincashplus_tpu.consensus.params import main_params
+
+        hdr = main_params().genesis.header.serialize()
+        mid = header_midstate(hdr)
+        got = sha256d_from_midstate(mid, hdr[64:])
+        assert bytes(reversed(got)).hex() == (
+            "000000000019d6689c085ae165831e934ff763ae46a2a6c172b3f1b60a8ce26f"
+        )

@@ -1,0 +1,55 @@
+"""Merkle tests (reference model: src/test/merkle_tests.cpp — cross-check vs a
+naive recursive algorithm, plus the CVE-2012-2459 mutation edge)."""
+
+import os
+
+from hypothesis import given
+from hypothesis import strategies as st
+
+from bitcoincashplus_tpu.consensus.merkle import (
+    compute_merkle_root,
+    merkle_root_naive,
+)
+
+hash32 = st.binary(min_size=32, max_size=32)
+
+
+class TestMerkleRoot:
+    def test_empty(self):
+        root, mutated = compute_merkle_root([])
+        assert root == b"\x00" * 32 and not mutated
+
+    def test_single(self):
+        h = os.urandom(32)
+        root, mutated = compute_merkle_root([h])
+        assert root == h and not mutated
+
+    @given(st.lists(hash32, min_size=1, max_size=64, unique=True))
+    def test_matches_naive(self, hashes):
+        root, mutated = compute_merkle_root(hashes)
+        assert root == merkle_root_naive(hashes)
+        assert not mutated  # unique leaves can't trip the duplication check
+
+    def test_genesis_root(self):
+        from bitcoincashplus_tpu.consensus.params import main_params
+
+        g = main_params().genesis
+        root, mutated = compute_merkle_root([g.vtx[0].txid])
+        assert root == g.header.hash_merkle_root and not mutated
+
+    def test_cve_2012_2459_mutation_detected(self):
+        """A tx list ending in a duplicated pair yields the same root as the
+        shorter list but must set the mutated flag."""
+        a, b, c = (bytes([i]) * 32 for i in (1, 2, 3))
+        root3, mut3 = compute_merkle_root([a, b, c])
+        root4, mut4 = compute_merkle_root([a, b, c, c])
+        assert root3 == root4
+        assert not mut3
+        assert mut4
+
+    def test_odd_padding_not_flagged(self):
+        # 3 distinct leaves: level-1 duplication of the last node is the
+        # consensus rule, not a mutation.
+        leaves = [os.urandom(32) for _ in range(3)]
+        _, mutated = compute_merkle_root(leaves)
+        assert not mutated

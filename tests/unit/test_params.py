@@ -1,0 +1,69 @@
+"""Chain-parameter tests — genesis self-consistency is our strongest offline
+consensus anchor (SURVEY.md §8.5.3)."""
+
+import pytest
+
+# NB: alias the testnet accessor — a bare `testnet_params` name would be
+# collected by pytest as a test function.
+from bitcoincashplus_tpu.consensus.params import (
+    get_block_subsidy,
+    main_params,
+    regtest_params,
+    select_params,
+)
+from bitcoincashplus_tpu.consensus.params import testnet_params as get_testnet_params
+from bitcoincashplus_tpu.consensus.tx import COIN
+
+
+class TestGenesis:
+    def test_mainnet_genesis_hash(self):
+        assert main_params().genesis.hash_hex == (
+            "000000000019d6689c085ae165831e934ff763ae46a2a6c172b3f1b60a8ce26f"
+        )
+
+    def test_testnet_genesis_hash(self):
+        assert get_testnet_params().genesis.hash_hex == (
+            "000000000933ea01ad0ee984209779baaec3ced90fa3f408719526f8d77f4943"
+        )
+
+    def test_regtest_genesis_hash(self):
+        assert regtest_params().genesis.hash_hex == (
+            "0f9188f13cb7b2c71f2a335e3a4fc328bf5beb436012afca590b1a11466e2206"
+        )
+
+    def test_genesis_merkle_equals_coinbase_txid(self):
+        for params in (main_params(), get_testnet_params(), regtest_params()):
+            g = params.genesis
+            assert g.header.hash_merkle_root == g.vtx[0].txid
+
+
+class TestSelect:
+    def test_select(self):
+        assert select_params("main").network == "main"
+        assert select_params("regtest").network == "regtest"
+        assert select_params("testnet").network == "test"
+        with pytest.raises(ValueError):
+            select_params("nope")
+
+
+class TestSubsidy:
+    def test_halving_schedule_main(self):
+        c = main_params().consensus
+        assert get_block_subsidy(0, c) == 50 * COIN
+        assert get_block_subsidy(209_999, c) == 50 * COIN
+        assert get_block_subsidy(210_000, c) == 25 * COIN
+        assert get_block_subsidy(420_000, c) == 12 * COIN + COIN // 2
+        assert get_block_subsidy(64 * 210_000, c) == 0
+
+    def test_total_supply_under_cap(self):
+        c = main_params().consensus
+        total = sum(
+            get_block_subsidy(h * c.subsidy_halving_interval, c)
+            * c.subsidy_halving_interval
+            for h in range(70)
+        )
+        assert total < 21_000_000 * COIN
+
+    def test_regtest_halving(self):
+        c = regtest_params().consensus
+        assert get_block_subsidy(150, c) == 25 * COIN

@@ -1,0 +1,179 @@
+"""PoW rule tests (reference model: src/test/pow_tests.cpp — retarget math on
+synthetic header chains; compact-bits codec edges from arith_uint256 tests)."""
+
+from dataclasses import dataclass, field
+
+import pytest
+from hypothesis import given
+from hypothesis import strategies as st
+
+from bitcoincashplus_tpu.consensus.params import main_params, regtest_params
+from bitcoincashplus_tpu.consensus.pow import (
+    calculate_next_work_required,
+    check_proof_of_work,
+    compact_to_target,
+    get_block_proof,
+    get_next_work_required,
+    target_to_compact,
+)
+
+
+@dataclass
+class FakeIndex:
+    """Minimal CBlockIndex stand-in for retarget tests."""
+
+    height: int
+    time: int
+    bits: int
+    prev: "FakeIndex | None" = None
+    chain_work: int = 0
+
+    def get_ancestor(self, height: int):
+        idx = self
+        while idx is not None and idx.height > height:
+            idx = idx.prev
+        return idx
+
+
+class TestCompactBits:
+    @pytest.mark.parametrize(
+        "bits,target,bad",
+        [
+            (0, 0, False),
+            (0x00123456, 0, False),
+            (0x01003456, 0, False),
+            (0x01123456, 0x12, False),
+            (0x02008000, 0x80, False),
+            (0x05009234, 0x92340000, False),
+            (0x04923456, 0, True),  # negative
+            (0x1D00FFFF, 0xFFFF << 208, False),
+            (0xFF123456, 0, True),  # overflow
+        ],
+    )
+    def test_decode_vectors(self, bits, target, bad):
+        # vectors from upstream bignum_tests/arith_uint256 SetCompact table
+        t, flag = compact_to_target(bits)
+        if not bad:
+            assert t == target
+        assert flag == bad
+
+    @given(st.integers(min_value=1, max_value=(1 << 255) - 1))
+    def test_roundtrip_via_compact(self, target):
+        bits = target_to_compact(target)
+        t2, bad = compact_to_target(bits)
+        assert not bad
+        # compact encoding keeps 23-24 bits of mantissa; re-encoding is stable
+        assert target_to_compact(t2) == bits
+
+    def test_mainnet_powlimit_encoding(self):
+        assert target_to_compact(main_params().consensus.pow_limit) == 0x1D00FFFF
+
+
+class TestCheckProofOfWork:
+    def test_genesis_passes(self):
+        p = main_params()
+        assert check_proof_of_work(p.genesis.get_hash(), p.genesis.header.bits, p.consensus)
+
+    def test_wrong_nonce_fails(self):
+        p = main_params()
+        hdr = p.genesis.header.with_nonce(p.genesis.header.nonce + 1)
+        assert not check_proof_of_work(hdr.get_hash(), hdr.bits, p.consensus)
+
+    def test_target_above_powlimit_rejected(self):
+        p = main_params()
+        easy_bits = target_to_compact(p.consensus.pow_limit * 2)
+        assert not check_proof_of_work(b"\x00" * 32, easy_bits, p.consensus)
+
+    def test_zero_and_negative_rejected(self):
+        p = main_params()
+        assert not check_proof_of_work(b"\x00" * 32, 0x01003456, p.consensus)
+        assert not check_proof_of_work(b"\x00" * 32, 0x04923456, p.consensus)
+
+
+class TestRetarget:
+    """Mirrors pow_tests.cpp GetBlockProofEquivalentTime-family cases."""
+
+    def _prev(self, height, time, bits):
+        return FakeIndex(height=height, time=time, bits=bits)
+
+    def test_exact_two_weeks_no_change(self):
+        p = main_params().consensus
+        prev = self._prev(2015, 1261130161, 0x1D00FFFF)
+        # pow_tests: nLastRetargetTime chosen so actual == target timespan
+        first_time = prev.time - p.pow_target_timespan
+        assert calculate_next_work_required(prev, first_time, p) == 0x1D00FFFF
+
+    def test_clamp_lower(self):
+        """Actual timespan < timespan/4 clamps to /4 (difficulty up max 4x)."""
+        p = main_params().consensus
+        prev = self._prev(2015, 1262152739, 0x1D00FFFF)
+        first_time = prev.time  # zero elapsed
+        bits = calculate_next_work_required(prev, first_time, p)
+        t_new, _ = compact_to_target(bits)
+        t_old, _ = compact_to_target(0x1D00FFFF)
+        assert t_new == target_to_compact_roundtrip(t_old // 4)
+
+    def test_clamp_upper(self):
+        """Actual timespan > 4*target clamps (difficulty down max 4x), bounded
+        by pow_limit."""
+        p = main_params().consensus
+        prev = self._prev(2015, 1262152739, 0x1D00FFFF)
+        first_time = prev.time - 100 * p.pow_target_timespan
+        bits = calculate_next_work_required(prev, first_time, p)
+        # 0x1D00FFFF * 4 > pow_limit → clamp to pow_limit, whose compact
+        # encoding is 0x1D00FFFF (matches pow_tests.cpp expectations)
+        assert bits == 0x1D00FFFF
+
+    def test_regtest_no_retargeting(self):
+        p = regtest_params().consensus
+        prev = self._prev(2015, 1_000_000, 0x207FFFFF)
+        assert get_next_work_required(prev, 2_000_000, p) == 0x207FFFFF
+
+    def test_regtest_min_difficulty_rule_still_applies(self):
+        """fPowNoRetargeting must not bypass the min-difficulty special case:
+        tip at non-limit bits + >2x spacing gap → pow-limit bits (reference
+        keeps the no-retarget check inside CalculateNextWorkRequired only)."""
+        p = regtest_params().consensus
+        prev = self._prev(10, 1_000_000, 0x207FFFFE)
+        bits = get_next_work_required(prev, 1_000_000 + p.pow_target_spacing * 2 + 1, p)
+        assert bits == 0x207FFFFF
+
+    def test_genesis_gets_powlimit(self):
+        p = main_params().consensus
+        assert get_next_work_required(None, 0, p) == 0x1D00FFFF
+
+    def test_mid_interval_keeps_bits(self):
+        p = main_params().consensus
+        chain = FakeIndex(height=0, time=0, bits=0x1D00FFFF)
+        for h in range(1, 100):
+            chain = FakeIndex(height=h, time=h * 600, bits=0x1D00FFFF, prev=chain)
+        assert get_next_work_required(chain, 100 * 600, p) == 0x1D00FFFF
+
+    def test_full_interval_retarget_fires(self):
+        """Build 2016 blocks at half spacing: difficulty must increase 2x."""
+        p = main_params().consensus
+        chain = FakeIndex(height=0, time=0, bits=0x1C0FFFFF)
+        for h in range(1, 2016):
+            chain = FakeIndex(height=h, time=h * 300, bits=0x1C0FFFFF, prev=chain)
+        bits = get_next_work_required(chain, 2016 * 300, p)
+        t_old, _ = compact_to_target(0x1C0FFFFF)
+        # Exact reference arithmetic: timespan spans 2015 gaps of 300s
+        expected = target_to_compact(t_old * (2015 * 300) // p.pow_target_timespan)
+        assert bits == expected
+        t_new, _ = compact_to_target(bits)
+        assert t_new < t_old  # difficulty increased
+
+
+def target_to_compact_roundtrip(target: int) -> int:
+    t, _ = compact_to_target(target_to_compact(target))
+    return t
+
+
+class TestBlockProof:
+    def test_proof_monotonic(self):
+        hard, _ = compact_to_target(0x1C0FFFFF)
+        assert get_block_proof(0x1C0FFFFF) > get_block_proof(0x1D00FFFF)
+
+    def test_genesis_proof(self):
+        # 0x1D00FFFF → proof = 2^32 / (0xFFFF0000... + 1) ≈ 2^32 / 2^224·k
+        assert get_block_proof(0x1D00FFFF) == 0x100010001

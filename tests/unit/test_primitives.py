@@ -1,0 +1,85 @@
+"""Tx/block primitive tests (reference model: src/test/transaction_tests.cpp
+round-trip parts, src/test/uint256_tests.cpp)."""
+
+import pytest
+from hypothesis import given
+from hypothesis import strategies as st
+
+from bitcoincashplus_tpu.consensus.block import CBlock, CBlockHeader
+from bitcoincashplus_tpu.consensus.serialize import ByteReader, DeserializationError
+from bitcoincashplus_tpu.consensus.tx import COutPoint, CTransaction, CTxIn, CTxOut
+
+# hypothesis strategies for consensus objects
+outpoints = st.builds(
+    COutPoint, st.binary(min_size=32, max_size=32), st.integers(0, 0xFFFFFFFF)
+)
+txins = st.builds(
+    CTxIn, outpoints, st.binary(max_size=100), st.integers(0, 0xFFFFFFFF)
+)
+txouts = st.builds(
+    CTxOut, st.integers(-1, 21_000_000 * 100_000_000), st.binary(max_size=100)
+)
+txs = st.builds(
+    CTransaction,
+    st.integers(-(2**31), 2**31 - 1),
+    st.lists(txins, max_size=5).map(tuple),
+    st.lists(txouts, max_size=5).map(tuple),
+    st.integers(0, 0xFFFFFFFF),
+)
+headers = st.builds(
+    CBlockHeader,
+    st.integers(-(2**31), 2**31 - 1),
+    st.binary(min_size=32, max_size=32),
+    st.binary(min_size=32, max_size=32),
+    st.integers(0, 0xFFFFFFFF),
+    st.integers(0, 0xFFFFFFFF),
+    st.integers(0, 0xFFFFFFFF),
+)
+
+
+class TestRoundTrip:
+    @given(txs)
+    def test_tx(self, tx):
+        assert CTransaction.from_bytes(tx.serialize()) == tx
+
+    @given(headers)
+    def test_header(self, hdr):
+        assert CBlockHeader.from_bytes(hdr.serialize()) == hdr
+        assert len(hdr.serialize()) == 80
+
+    @given(st.lists(txs, min_size=1, max_size=4))
+    def test_block(self, vtx):
+        blk = CBlock(CBlockHeader(), tuple(vtx))
+        rt = CBlock.from_bytes(blk.serialize())
+        assert rt.header == blk.header
+        assert [t.txid for t in rt.vtx] == [t.txid for t in blk.vtx]
+
+
+class TestKnownSerialization:
+    def test_genesis_coinbase_txid(self):
+        from bitcoincashplus_tpu.consensus.params import main_params
+
+        cb = main_params().genesis.vtx[0]
+        assert cb.txid_hex == (
+            "4a5e1e4baab89f3a32518a88c31bc87f618f76673e2cc77ab2127b7afdeda33b"
+        )
+        assert cb.is_coinbase()
+
+    def test_genesis_block_size(self):
+        from bitcoincashplus_tpu.consensus.params import main_params
+
+        assert main_params().genesis.size() == 285  # canonical genesis size
+
+    def test_trailing_bytes_rejected(self):
+        from bitcoincashplus_tpu.consensus.params import main_params
+
+        raw = main_params().genesis.vtx[0].serialize()
+        with pytest.raises(DeserializationError):
+            CTransaction.from_bytes(raw + b"\x00")
+
+    def test_truncated_rejected(self):
+        from bitcoincashplus_tpu.consensus.params import main_params
+
+        raw = main_params().genesis.serialize()
+        with pytest.raises(DeserializationError):
+            CBlock.from_bytes(raw[:-1])

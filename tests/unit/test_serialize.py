@@ -1,0 +1,75 @@
+"""Serialization codec tests (reference model: src/test/serialize_tests.cpp)."""
+
+import pytest
+from hypothesis import given
+from hypothesis import strategies as st
+
+from bitcoincashplus_tpu.consensus.serialize import (
+    ByteReader,
+    DeserializationError,
+    deser_compact_size,
+    deser_var_bytes,
+    hash_to_hex,
+    hex_to_hash,
+    ser_compact_size,
+    ser_var_bytes,
+    uint256_from_bytes,
+    uint256_to_bytes,
+)
+
+
+class TestCompactSize:
+    @pytest.mark.parametrize(
+        "n,encoded",
+        [
+            (0, b"\x00"),
+            (252, b"\xfc"),
+            (253, b"\xfd\xfd\x00"),
+            (0xFFFF, b"\xfd\xff\xff"),
+            (0x10000, b"\xfe\x00\x00\x01\x00"),
+            (0x02000000, b"\xfe\x00\x00\x00\x02"),
+        ],
+    )
+    def test_known_encodings(self, n, encoded):
+        assert ser_compact_size(n) == encoded
+        assert deser_compact_size(ByteReader(encoded)) == n
+
+    @given(st.integers(min_value=0, max_value=0x02000000))
+    def test_roundtrip(self, n):
+        assert deser_compact_size(ByteReader(ser_compact_size(n))) == n
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            b"\xfd\xfc\x00",
+            b"\xfe\xff\xff\x00\x00",
+            b"\xff" + (0xFFFFFFFF).to_bytes(8, "little"),  # fits in 0xfe form
+        ],
+    )
+    def test_non_canonical_rejected(self, bad):
+        with pytest.raises(DeserializationError):
+            deser_compact_size(ByteReader(bad))
+
+    def test_max_size_enforced(self):
+        with pytest.raises(DeserializationError):
+            deser_compact_size(ByteReader(b"\xfe\x01\x00\x00\x02"))
+
+    def test_truncated(self):
+        with pytest.raises(DeserializationError):
+            deser_compact_size(ByteReader(b"\xfd\x01"))
+
+
+class TestVarBytes:
+    @given(st.binary(max_size=512))
+    def test_roundtrip(self, b):
+        assert deser_var_bytes(ByteReader(ser_var_bytes(b))) == b
+
+
+class TestUint256:
+    def test_hex_reversal(self):
+        wire = bytes(range(32))
+        assert hex_to_hash(hash_to_hex(wire)) == wire
+
+    @given(st.integers(min_value=0, max_value=(1 << 256) - 1))
+    def test_int_roundtrip(self, v):
+        assert uint256_from_bytes(uint256_to_bytes(v)) == v
