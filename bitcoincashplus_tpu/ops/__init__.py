@@ -1,0 +1,12 @@
+"""TPU compute kernels (JAX/XLA/Pallas).
+
+The node's two compute-bound subsystems (BASELINE.json north star):
+  - sha256.py / miner.py / merkle.py — SHA-256d PoW search, batched header
+    and Merkle hashing (replaces src/crypto/sha256*.cpp + the scalar nonce
+    loop in src/rpc/mining.cpp:~120 (generateBlocks)).
+  - secp256k1.py / ecdsa_batch.py — vectorized batch ECDSA verification
+    (replaces src/secp256k1 + CCheckQueue fan-out).
+
+Everything here is pure-functional and jit-compatible; host orchestration
+lives in validation/ and mining/.
+"""

@@ -1,0 +1,85 @@
+"""TPU Merkle-root construction.
+
+Replaces src/consensus/merkle.cpp:~45 (ComputeMerkleRoot)'s serial pairwise
+loop with a lane-parallel tree reduction: each level hashes all digest pairs
+at once (double-SHA of the 64-byte concatenation, 3 compressions), log2(n)
+levels total (BASELINE.json config: 4k-tx snapshot -> 12 levels).
+
+Consensus-exact odd handling: when a level has an odd node count the LAST
+node is paired with itself (the CVE-2012-2459 duplication rule) — applied
+per level on the host between device calls, never by power-of-two padding,
+because padding changes the tree shape for non-pow2 counts.
+
+Lane padding: each level is padded up to a multiple of PAD_LANES with
+garbage pairs (masked out on the host) so recompilation is bounded by the
+number of distinct padded sizes, not distinct tx counts (SURVEY.md §8.4
+bucketing).
+
+Also detects the known Merkle "mutation" (two identical consecutive hashes
+forming a duplicated pair), which the reference surfaces via the *mutated
+flag for CheckBlock's duplicate-tx rule.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sha256 import sha256d_64
+
+PAD_LANES = 128  # one VPU lane row; keeps distinct compiled shapes ~O(log n)
+
+
+@partial(jax.jit, static_argnames=("n_pairs",))
+def _level_jit(words, n_pairs: int):
+    """(n_pairs, 16) uint32 pair words -> (n_pairs, 8) parent digest words."""
+    return jnp.stack(sha256d_64([words[:, i] for i in range(16)]), axis=-1)
+
+
+def _digests_to_words(digests: np.ndarray) -> np.ndarray:
+    """(N, 32) uint8 digests -> (N, 8) uint32 BE words."""
+    return digests.reshape(-1, 8, 4).view(">u4").squeeze(-1).astype(np.uint32)
+
+
+def _words_to_digests(words: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(words).astype(">u4").view(np.uint8).reshape(-1, 32)
+
+
+def compute_merkle_root_tpu(hashes: list[bytes]) -> tuple[bytes, bool]:
+    """Drop-in for consensus.merkle.compute_merkle_root on large inputs.
+
+    Returns (root, mutated). Device round-trips once per level; each level is
+    one fused XLA computation over all pairs.
+    """
+    if not hashes:
+        return b"\x00" * 32, False
+    mutated = False
+    level = _digests_to_words(
+        np.frombuffer(b"".join(hashes), dtype=np.uint8).reshape(-1, 32)
+    )
+    while len(level) > 1:
+        n = len(level)
+        # Mutation check runs BEFORE odd-duplication (identical adjacent
+        # nodes at even positions; the legitimate self-pair added below must
+        # not flag) — same order as consensus/merkle.py and the reference.
+        whole = n - (n & 1)
+        mutated |= bool(
+            np.any(np.all(level[0:whole:2] == level[1:whole:2], axis=1))
+        )
+        if n & 1:
+            level = np.concatenate([level, level[-1:]], axis=0)
+            n += 1
+        left, right = level[0::2], level[1::2]
+        pairs = np.concatenate([left, right], axis=1)  # (n/2, 16)
+        n_pairs = len(pairs)
+        padded = -(-n_pairs // PAD_LANES) * PAD_LANES
+        if padded != n_pairs:
+            pairs = np.concatenate(
+                [pairs, np.zeros((padded - n_pairs, 16), dtype=np.uint32)], axis=0
+            )
+        out = np.asarray(_level_jit(jnp.asarray(pairs), padded))[:n_pairs]
+        level = out
+    return _words_to_digests(level)[0].tobytes(), mutated

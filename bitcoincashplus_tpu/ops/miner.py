@@ -1,0 +1,105 @@
+"""TPU proof-of-work nonce sweep.
+
+Replaces the scalar CPU mining loop in src/rpc/mining.cpp:~120
+(generateBlocks):
+
+    while (nMaxTries > 0 && nNonce < nInnerLoopCount &&
+           !CheckProofOfWork(pblock->GetHash(), nBits, params)) ++nNonce;
+
+with a data-parallel sweep: a `lax.while_loop` over nonce tiles, each tile
+hashing TILE nonces at once from the header midstate (2 compressions per
+nonce), comparing against the target as 8xu32 LE limbs on-device, and
+early-exiting the loop on the first hit. One dispatch sweeps up to the whole
+32-bit nonce space; the host polls a tiny (found, nonce, tiles) result.
+
+Multi-chip sharding over ICI lives in parallel/nonce_shard.py (shard_map over
+a ('chip',) mesh; each chip owns a contiguous nonce range).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto.hashes import header_midstate
+from .sha256 import (
+    bytes_to_words_np,
+    digest_to_limbs,
+    header_sweep_digest,
+    le256,
+    target_to_limbs_np,
+)
+
+# Default tile: 64Ki nonces per device loop iteration. Large enough to fill
+# the 8x128 VPU lanes many times over (amortizing loop overhead), small
+# enough to stay comfortably in VMEM (~16 live u32 vectors * 256KiB).
+DEFAULT_TILE = 1 << 16
+
+
+def _sweep_tile(midstate8, tail3, target_limbs, base_nonce, tile: int):
+    """Hash one tile of `tile` consecutive nonces; return (hit, nonce).
+    `nonce` is the lowest in-tile hit when hit is True (argmax finds the
+    first True lane; nonces are base+iota so lane order == nonce order)."""
+    lanes = jax.lax.broadcasted_iota(jnp.uint32, (tile, 1), 0).squeeze(-1)
+    nonces = base_nonce + lanes
+    h8 = header_sweep_digest(midstate8, tail3, nonces)
+    ok = le256(digest_to_limbs(h8), target_limbs)
+    hit = jnp.any(ok)
+    idx = jnp.argmax(ok)
+    return hit, nonces[idx]
+
+
+@partial(jax.jit, static_argnames=("tile",))
+def sweep_jit(midstate, tail, target_limbs, start_nonce, n_tiles, tile: int = DEFAULT_TILE):
+    """Sweep [start_nonce, start_nonce + n_tiles*tile) for a PoW hit.
+
+    midstate: (8,) uint32; tail: (3,) uint32 BE words of header bytes 64..75;
+    target_limbs: (8,) uint32 LE limbs; start_nonce, n_tiles: uint32 scalars.
+    Returns (found bool, nonce uint32, tiles_done uint32). Nonce arithmetic
+    wraps mod 2^32 exactly like the reference's uint32 nNonce.
+    """
+    mid8 = [midstate[i] for i in range(8)]
+    tail3 = [tail[i] for i in range(3)]
+    tgt = [target_limbs[j] for j in range(8)]
+
+    def cond(carry):
+        i, found, _ = carry
+        return jnp.logical_and(i < n_tiles, jnp.logical_not(found))
+
+    def body(carry):
+        i, _, _ = carry
+        base = start_nonce + i.astype(jnp.uint32) * np.uint32(tile)
+        hit, nonce = _sweep_tile(mid8, tail3, tgt, base, tile)
+        return i + np.uint32(1), hit, nonce
+
+    i0 = jnp.uint32(0)
+    found0 = jnp.array(False)
+    nonce0 = jnp.uint32(0)
+    tiles, found, nonce = jax.lax.while_loop(cond, body, (i0, found0, nonce0))
+    return found, nonce, tiles
+
+
+def sweep_header(header80: bytes, target: int, start_nonce: int = 0,
+                 max_nonces: int = 1 << 32, tile: int = DEFAULT_TILE):
+    """Host API: search for a nonce making sha256d(header) <= target.
+
+    Returns (nonce or None, hashes_attempted). The header's own nonce field is
+    ignored; bytes 0..75 define the search. Mirrors generateBlocks' semantics
+    (bounded attempts, first hit wins) at tile granularity.
+    """
+    assert len(header80) == 80
+    midstate = np.array(header_midstate(header80), dtype=np.uint32)
+    tail = bytes_to_words_np(np.frombuffer(header80[64:76], dtype=np.uint8))
+    tgt = target_to_limbs_np(target)
+    n_tiles = min((max_nonces + tile - 1) // tile, (1 << 32) // tile)
+    found, nonce, tiles = sweep_jit(
+        jnp.asarray(midstate), jnp.asarray(tail), jnp.asarray(tgt),
+        jnp.uint32(start_nonce), jnp.uint32(n_tiles), tile=tile,
+    )
+    hashes = int(tiles) * tile
+    if bool(found):
+        return int(nonce), hashes
+    return None, hashes

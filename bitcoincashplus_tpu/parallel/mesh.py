@@ -1,0 +1,55 @@
+"""Device mesh construction.
+
+One 1-D mesh axis ('chip',) spanning all local devices — the v5e-8 target is
+a single host with 8 chips in a 2x4 ICI ring (SURVEY.md §6.8); a 1-D logical
+axis is the right shape because both sharded workloads (nonce sweep, sig
+batch) are embarrassingly parallel with a single tiny reduction.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+CHIP_AXIS = "chip"
+
+
+def local_devices(min_count: int = 1) -> list:
+    """Devices for the mesh. Honors JAX_PLATFORMS explicitly because the
+    axon TPU plugin registers itself as the default backend regardless of
+    that env var — tests/dryrun set JAX_PLATFORMS=cpu +
+    xla_force_host_platform_device_count=N and must get the N virtual CPU
+    devices, not the tunneled TPU. Falls back to the CPU backend when the
+    default backend is too small (driver dryrun_multichip path)."""
+    plat = os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip()
+    if plat:
+        try:
+            return jax.devices(plat)
+        except RuntimeError:
+            pass
+    devs = jax.devices()
+    if len(devs) < min_count:
+        try:
+            cpu = jax.devices("cpu")
+            if len(cpu) >= min_count:
+                return cpu
+        except RuntimeError:
+            pass
+    return devs
+
+
+def device_count() -> int:
+    return len(local_devices())
+
+
+def chip_mesh(n: int | None = None) -> Mesh:
+    """Mesh over the first n local devices (default: all)."""
+    devs = local_devices(min_count=n or 1)
+    if n is not None:
+        if n > len(devs):
+            raise ValueError(f"requested {n} devices, have {len(devs)}")
+        devs = devs[:n]
+    return Mesh(np.array(devs), (CHIP_AXIS,))

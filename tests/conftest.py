@@ -1,15 +1,26 @@
 """Test configuration.
 
-Tests run on CPU with an 8-device virtual mesh so multi-chip sharding logic
-(parallel/) is exercised without TPU hardware — the same mechanism the driver
-uses for dryrun_multichip (see __graft_entry__.py). Must run before jax import.
+Tests run on the CPU backend with an 8-device virtual mesh so multi-chip
+sharding logic (parallel/) is exercised without TPU hardware — the same
+mechanism the driver uses for dryrun_multichip (see __graft_entry__.py).
+
+Note: this environment presets JAX_PLATFORMS=axon (a tunneled TPU plugin
+that wins default-backend selection even over JAX_PLATFORMS=cpu), so forcing
+the env var alone is not enough — we also pin jax_default_device to CPU
+after import. parallel/mesh.local_devices honors JAX_PLATFORMS for the mesh
+device list. Kernel-vs-real-TPU behavior is covered by the driver's bench
+run (bench.py), not by this suite.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # overwrite the preset 'axon'
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (env must be set first)
+
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
