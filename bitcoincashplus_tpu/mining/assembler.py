@@ -1,0 +1,146 @@
+"""Block template assembly.
+
+Reference: src/miner.cpp:~130 (BlockAssembler::CreateNewBlock), :~440
+(IncrementExtraNonce). Package selection over the mempool's ancestor-feerate
+index (addPackageTxs :~300) plugs in via the `mempool` argument — with no
+mempool the template is coinbase-only (enough for regtest generatetoaddress,
+the reference behaves identically on an empty mempool).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+from ..consensus.block import CBlock, CBlockHeader
+from ..consensus.merkle import block_merkle_root
+from ..consensus.params import ChainParams, get_block_subsidy
+from ..consensus.pow import get_next_work_required
+from ..consensus.tx import COutPoint, CTransaction, CTxIn, CTxOut
+from ..validation.chain import CBlockIndex
+from ..validation.chainstate import ChainstateManager, _script_int
+
+
+def bip34_coinbase_script_sig(height: int, extranonce: int = 0) -> bytes:
+    """Height push (BIP34) + extranonce push — the reference's
+    IncrementExtraNonce writes CScript() << nHeight << CScriptNum(nExtraNonce)."""
+    tail = _script_int(extranonce) if extranonce > 0 else b""
+    sig = _script_int(height) + tail
+    if len(sig) < 2:  # bad-cb-length lower bound
+        sig += b"\x00"
+    return sig
+
+
+@dataclass
+class BlockTemplate:
+    """CBlockTemplate (src/miner.h): block + per-tx fees/sigops."""
+
+    block: CBlock
+    fees: list[int] = field(default_factory=list)
+    height: int = 0
+    target: int = 0
+
+
+class BlockAssembler:
+    """BlockAssembler (src/miner.cpp:~110)."""
+
+    def __init__(self, chainstate: ChainstateManager, mempool=None):
+        self.chainstate = chainstate
+        self.mempool = mempool
+        self.params: ChainParams = chainstate.params
+
+    def create_new_block(self, script_pubkey: bytes,
+                         time_override: Optional[int] = None) -> BlockTemplate:
+        """CreateNewBlock: coinbase + greedy package selection + a
+        TestBlockValidity dry-run (the reference asserts its own template
+        connects)."""
+        tip = self.chainstate.tip()
+        assert tip is not None
+        height = tip.height + 1
+        consensus = self.params.consensus
+
+        now = self.chainstate.get_time()
+        block_time = max(tip.get_median_time_past() + 1, now)
+        if time_override is not None:
+            block_time = time_override
+        bits = get_next_work_required(tip, block_time, consensus)
+
+        txs: list[CTransaction] = []
+        fees: list[int] = []
+        total_fees = 0
+        if self.mempool is not None:
+            selected = self.mempool.select_for_block(
+                max_size=self.params.max_block_size - 1000,
+                height=height,
+                block_time=tip.get_median_time_past(),
+            )
+            for entry in selected:
+                txs.append(entry.tx)
+                fees.append(entry.fee)
+                total_fees += entry.fee
+
+        coinbase = CTransaction(
+            version=1,
+            vin=(CTxIn(COutPoint(), bip34_coinbase_script_sig(height), 0xFFFFFFFF),),
+            vout=(CTxOut(total_fees + get_block_subsidy(height, consensus), script_pubkey),),
+            locktime=0,
+        )
+        vtx = (coinbase, *txs)
+        root, _ = block_merkle_root(_BlockView(vtx))
+        header = CBlockHeader(
+            version=0x20000000,
+            hash_prev_block=tip.hash,
+            hash_merkle_root=root,
+            time=block_time,
+            bits=bits,
+            nonce=0,
+        )
+        block = CBlock(header, vtx)
+        from ..consensus.pow import compact_to_target
+
+        target, _bad = compact_to_target(bits)
+        tmpl = BlockTemplate(block=block, fees=[0, *fees], height=height, target=target)
+        self._test_block_validity(tmpl)
+        return tmpl
+
+    def _test_block_validity(self, tmpl: BlockTemplate) -> None:
+        """TestBlockValidity (src/validation.cpp:~3500): dry-run the
+        non-PoW checks so a bad template never reaches the miner."""
+        cs = self.chainstate
+        tip = cs.tip()
+        cs.check_block(tmpl.block, check_pow=False)
+        cs.contextual_check_block(tmpl.block, tip)
+        # connect dry-run on a scratch cache layer (discarded afterwards)
+        from ..validation.coins import CoinsCache
+
+        idx = CBlockIndex(tmpl.block.header, tmpl.block.get_hash(), tip)
+        cs.connect_block(tmpl.block, idx, check_scripts=True, view=CoinsCache(cs.coins))
+
+
+class _BlockView:
+    """Minimal duck-typed block for block_merkle_root before CBlock exists."""
+
+    def __init__(self, vtx):
+        self.vtx = vtx
+
+
+def increment_extranonce(block: CBlock, height: int, extranonce: int) -> CBlock:
+    """IncrementExtraNonce (src/miner.cpp:~440): bump the coinbase scriptSig
+    extranonce and recompute the Merkle root. Returns a new block (immutables
+    all the way down); the caller owns the extranonce counter."""
+    coinbase = block.vtx[0]
+    new_cb = CTransaction(
+        version=coinbase.version,
+        vin=(
+            CTxIn(
+                COutPoint(),
+                bip34_coinbase_script_sig(height, extranonce),
+                coinbase.vin[0].sequence,
+            ),
+        ),
+        vout=coinbase.vout,
+        locktime=coinbase.locktime,
+    )
+    vtx = (new_cb, *block.vtx[1:])
+    root, _ = block_merkle_root(_BlockView(vtx))
+    return CBlock(replace(block.header, hash_merkle_root=root), vtx)

@@ -33,9 +33,11 @@ from .sha256 import sha256d_64
 PAD_LANES = 128  # one VPU lane row; keeps distinct compiled shapes ~O(log n)
 
 
-@partial(jax.jit, static_argnames=("n_pairs",))
-def _level_jit(words, n_pairs: int):
-    """(n_pairs, 16) uint32 pair words -> (n_pairs, 8) parent digest words."""
+@jax.jit
+def _level_jit(words):
+    """(n_pairs, 16) uint32 pair words -> (n_pairs, 8) parent digest words.
+    jit specializes on the (lane-padded) shape; recompiles are bounded by
+    the number of distinct padded sizes."""
     return jnp.stack(sha256d_64([words[:, i] for i in range(16)]), axis=-1)
 
 
@@ -80,6 +82,6 @@ def compute_merkle_root_tpu(hashes: list[bytes]) -> tuple[bytes, bool]:
             pairs = np.concatenate(
                 [pairs, np.zeros((padded - n_pairs, 16), dtype=np.uint32)], axis=0
             )
-        out = np.asarray(_level_jit(jnp.asarray(pairs), padded))[:n_pairs]
+        out = np.asarray(_level_jit(jnp.asarray(pairs)))[:n_pairs]
         level = out
     return _words_to_digests(level)[0].tobytes(), mutated

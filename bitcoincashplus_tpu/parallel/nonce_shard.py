@@ -82,13 +82,15 @@ def _sharded_sweep_jit(midstate, tail, target_limbs, start_nonce, n_tiles,
 
 
 def sweep_header_sharded(header80: bytes, target: int, start_nonce: int = 0,
-                         nonces_per_chip: int = 1 << 24,
+                         max_nonces: int = 1 << 32,
                          tile: int = DEFAULT_TILE,
                          n_chips: int | None = None):
     """Host API: multi-chip PoW search. Returns (nonce or None, total_hashes).
 
-    The full range covered is n_chips * nonces_per_chip starting at
-    start_nonce; chip c owns [start + c*span, start + (c+1)*span).
+    Same signature contract as ops.miner.sweep_header so callers
+    (mining/generate.mine_block's `sweep` hook) can inject either. max_nonces
+    is the TOTAL budget across chips; chip c owns the contiguous stripe
+    [start + c*span, start + (c+1)*span) with span = max_nonces / n_chips.
     """
     assert len(header80) == 80
     if n_chips is None:
@@ -98,7 +100,7 @@ def sweep_header_sharded(header80: bytes, target: int, start_nonce: int = 0,
         bytes_to_words_np(np.frombuffer(header80[64:76], dtype=np.uint8))
     )
     tgt = jnp.asarray(target_to_limbs_np(target))
-    n_tiles = max(1, nonces_per_chip // tile)
+    n_tiles = max(1, max_nonces // n_chips // tile)
     found, nonce, tiles = _sharded_sweep_jit(
         midstate, tail, tgt, jnp.uint32(start_nonce), jnp.uint32(n_tiles),
         tile=tile, n_chips=n_chips,

@@ -1,0 +1,18 @@
+"""Persistence layer.
+
+Reference mapping (SURVEY.md §3.1):
+  - blockstore.py — blk?????.dat / rev?????.dat append-only block & undo
+    files (src/validation.cpp SaveBlockToDisk / WriteUndoDataForBlock).
+  - kvstore.py — CDBWrapper-shaped ordered KV (src/dbwrapper.{h,cpp}) over
+    sqlite3 (stdlib; LevelDB has no binding in this environment — deviation
+    documented in SURVEY.md §8.5.6). Batch-atomic writes + WAL mode give the
+    same crash-safety contract (flush cadence + best-block marker).
+  - chainstatedb.py — the coins DB ('chainstate') and block index DB
+    (src/txdb.{h,cpp} CCoinsViewDB / CBlockTreeDB) on top of kvstore.
+"""
+
+from .blockstore import BlockStore, MemoryBlockStore
+from .kvstore import KVStore
+from .chainstatedb import CoinsDB, BlockIndexDB
+
+__all__ = ["BlockStore", "MemoryBlockStore", "KVStore", "CoinsDB", "BlockIndexDB"]

@@ -1,0 +1,107 @@
+"""Coins DB and block-index DB over the KV store.
+
+Reference: src/txdb.{h,cpp} — CCoinsViewDB ('chainstate' LevelDB: key
+DB_COIN 'C' + outpoint, value Coin; DB_BEST_BLOCK 'B' marker) and
+CBlockTreeDB ('blocks/index': DB_BLOCK_INDEX 'b' + hash -> CDiskBlockIndex,
+DB_BLOCK_FILES, DB_REINDEX_FLAG, DB_FLAG for -txindex).
+
+The coins schema here stores one row per outpoint (the 0.15+ per-output
+model, not 0.14's per-tx CCoins) — better granularity for flush batching.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, Optional
+
+from ..consensus.block import CBlockHeader
+from ..consensus.serialize import ByteReader
+from ..consensus.tx import COutPoint
+from ..validation.coins import Coin, CoinsView
+from .kvstore import KVStore
+
+_COIN = b"C"
+_BEST = b"B"
+_BLOCK_INDEX = b"b"
+_BLOCK_POS = b"f"
+_UNDO_POS = b"u"
+_FLAG = b"F"
+_NULL_HASH = b"\x00" * 32
+
+
+def _coin_key(op: COutPoint) -> bytes:
+    return _COIN + op.hash + struct.pack("<I", op.n)
+
+
+class CoinsDB(CoinsView):
+    """CCoinsViewDB — the persistent bottom of the view stack."""
+
+    def __init__(self, kv: KVStore):
+        self.kv = kv
+
+    def get_coin(self, outpoint: COutPoint) -> Optional[Coin]:
+        raw = self.kv.get(_coin_key(outpoint))
+        return Coin.deserialize(raw) if raw is not None else None
+
+    def best_block(self) -> bytes:
+        return self.kv.get(_BEST) or _NULL_HASH
+
+    def batch_write(self, coins: dict, best_block: bytes) -> None:
+        puts: dict[bytes, bytes] = {}
+        deletes: list[bytes] = []
+        for op, coin in coins.items():
+            if coin is None:
+                deletes.append(_coin_key(op))
+            else:
+                puts[_coin_key(op)] = coin.serialize()
+        puts[_BEST] = best_block
+        # single transaction: coins + best-block marker move together —
+        # the crash-consistency invariant (SURVEY.md §6.3)
+        self.kv.write_batch(puts, deletes, sync=True)
+
+    def count_coins(self) -> int:
+        return sum(1 for _ in self.kv.iterate(_COIN))
+
+
+class BlockIndexDB:
+    """CBlockTreeDB — headers + file positions + flags, enough to rebuild
+    the in-memory block tree at startup (LoadBlockIndexDB)."""
+
+    def __init__(self, kv: KVStore):
+        self.kv = kv
+
+    def put_index_batch(self, entries: list) -> None:
+        """entries: (hash, header80, height, status, n_tx, blkpos, undopos)."""
+        puts = {}
+        for h, header80, height, status, n_tx, blkpos, undopos in entries:
+            puts[_BLOCK_INDEX + h] = (
+                header80
+                + struct.pack("<iII", height, status, n_tx)
+                + struct.pack("<iii", *(blkpos or (-1, -1, -1)))
+                + struct.pack("<iii", *(undopos or (-1, -1, -1)))
+            )
+        self.kv.write_batch(puts)
+
+    def iterate_index(self) -> Iterator[tuple]:
+        """Yields (hash, CBlockHeader, height, status, n_tx, blkpos, undopos)."""
+        for k, v in self.kv.iterate(_BLOCK_INDEX):
+            h = k[1:]
+            header = CBlockHeader.deserialize(ByteReader(v[:80]))
+            height, status, n_tx = struct.unpack("<iII", v[80:92])
+            blkpos = struct.unpack("<iii", v[92:104])
+            undopos = struct.unpack("<iii", v[104:116])
+            yield (
+                h,
+                header,
+                height,
+                status,
+                n_tx,
+                None if blkpos[0] < 0 else blkpos,
+                None if undopos[0] < 0 else undopos,
+            )
+
+    def put_flag(self, name: bytes, value: bool) -> None:
+        self.kv.put(_FLAG + name, b"1" if value else b"0")
+
+    def get_flag(self, name: bytes) -> bool:
+        return self.kv.get(_FLAG + name) == b"1"

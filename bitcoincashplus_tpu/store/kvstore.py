@@ -1,0 +1,89 @@
+"""CDBWrapper-shaped key-value store over sqlite3.
+
+Reference: src/dbwrapper.{h,cpp} (CDBWrapper, CDBBatch, CDBIterator) over
+LevelDB. sqlite3 (WAL mode) provides the same contract this framework needs:
+ordered byte-key iteration, atomic batch writes, durable sync on request.
+The obfuscation-key machinery of the reference (anti-virus false-positive
+mitigation) is intentionally dropped — it has no behavioral surface.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+from typing import Iterator, Optional
+
+
+class KVStore:
+    def __init__(self, path: str):
+        # isolation_level=None -> explicit transaction control
+        self._db = sqlite3.connect(path, isolation_level=None)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute("PRAGMA synchronous=NORMAL")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB NOT NULL)"
+        )
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        row = self._db.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+        return row[0] if row else None
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._db.execute(
+            "INSERT INTO kv (k, v) VALUES (?, ?) ON CONFLICT(k) DO UPDATE SET v=excluded.v",
+            (key, value),
+        )
+
+    def delete(self, key: bytes) -> None:
+        self._db.execute("DELETE FROM kv WHERE k = ?", (key,))
+
+    def exists(self, key: bytes) -> bool:
+        return self.get(key) is not None
+
+    def write_batch(self, puts: dict[bytes, bytes], deletes: list[bytes] = (),
+                    sync: bool = False) -> None:
+        """CDBBatch + WriteBatch: all-or-nothing (one sqlite transaction)."""
+        cur = self._db.cursor()
+        cur.execute("BEGIN")
+        try:
+            if deletes:
+                cur.executemany("DELETE FROM kv WHERE k = ?", [(k,) for k in deletes])
+            if puts:
+                cur.executemany(
+                    "INSERT INTO kv (k, v) VALUES (?, ?) "
+                    "ON CONFLICT(k) DO UPDATE SET v=excluded.v",
+                    list(puts.items()),
+                )
+            cur.execute("COMMIT")
+        except BaseException:
+            cur.execute("ROLLBACK")
+            raise
+        if sync:
+            self._db.execute("PRAGMA wal_checkpoint(FULL)")
+
+    def iterate(self, prefix: bytes = b"") -> Iterator[tuple[bytes, bytes]]:
+        """Ordered iteration over keys with the given prefix — CDBIterator."""
+        hi = _prefix_upper_bound(prefix) if prefix else None
+        if prefix and hi is not None:
+            cur = self._db.execute(
+                "SELECT k, v FROM kv WHERE k >= ? AND k < ? ORDER BY k", (prefix, hi)
+            )
+        elif prefix:  # all-0xFF prefix: no finite upper bound
+            cur = self._db.execute(
+                "SELECT k, v FROM kv WHERE k >= ? ORDER BY k", (prefix,)
+            )
+        else:
+            cur = self._db.execute("SELECT k, v FROM kv ORDER BY k")
+        yield from cur
+
+    def close(self) -> None:
+        self._db.close()
+
+
+def _prefix_upper_bound(prefix: bytes) -> Optional[bytes]:
+    """Smallest byte string greater than every key starting with `prefix`
+    (carry-increment, dropping trailing 0xFF bytes); None if prefix is all
+    0xFF, which has no finite bound."""
+    trimmed = prefix.rstrip(b"\xff")
+    if not trimmed:
+        return None
+    return trimmed[:-1] + bytes([trimmed[-1] + 1])

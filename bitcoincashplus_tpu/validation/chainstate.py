@@ -1,0 +1,531 @@
+"""The consensus state machine — ChainstateManager.
+
+Reference: src/validation.cpp. Function-by-function parity (SURVEY.md §3.1):
+ProcessNewBlock (:~3100), AcceptBlock (:~3000), AcceptBlockHeader,
+CheckBlock, ConnectBlock (:~1700), DisconnectBlock, ActivateBestChain
+(:~2500), InvalidateBlock, FlushStateToDisk (:~1900).
+
+Differences from the reference, by design (TPU-first, SURVEY.md §1):
+  - Single-threaded host orchestration (no cs_main; Python + asyncio).
+  - Script/signature checks are not fanned out to a thread pool
+    (CCheckQueue); they are *deferred* into per-block batch records and
+    dispatched to the TPU ECDSA kernel in one shot (ops/ecdsa_batch), with
+    a CPU fallback. The `script_verifier` hook owns that policy.
+  - Header PoW / Merkle recomputation can run batched on-chip.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Callable, Optional
+
+from ..consensus.block import CBlock, CBlockHeader
+from ..consensus.merkle import block_merkle_root
+from ..consensus.params import ChainParams, get_block_subsidy
+from ..consensus.pow import check_proof_of_work, get_next_work_required
+from ..consensus.serialize import hash_to_hex
+from ..consensus.tx import COutPoint, CTransaction, money_range
+from ..consensus.tx_check import TxValidationError, check_transaction
+from .chain import BlockStatus, CBlockIndex, CChain
+from .coins import BlockUndo, CoinsCache, CoinsView, TxUndo, add_coins
+
+MAX_FUTURE_BLOCK_TIME = 2 * 60 * 60  # src/chain.h (MAX_FUTURE_BLOCK_TIME)
+
+
+class BlockValidationError(TxValidationError):
+    """Block-level reject reason (shares the reason-string contract)."""
+
+
+# Type of the deferred script-verification hook: called once per block with
+# (block, index, spent_coins_per_input) and must raise BlockValidationError
+# on failure. Wired to the script interpreter + TPU sig batch in
+# validation/scriptcheck.py; None skips script checks entirely (pre-graft
+# slice / below-assumevalid behavior).
+ScriptVerifier = Callable[[CBlock, CBlockIndex, list], None]
+
+
+class ChainstateManager:
+    """Owns the block tree, the active chain, and the UTXO view stack."""
+
+    def __init__(
+        self,
+        params: ChainParams,
+        coins_base: CoinsView,
+        block_store,
+        script_verifier: Optional[ScriptVerifier] = None,
+        get_time: Callable[[], int] = lambda: int(_time.time()),
+    ):
+        self.params = params
+        self.chain = CChain()
+        self.block_index: dict[bytes, CBlockIndex] = {}
+        self.coins = CoinsCache(coins_base)
+        self.block_store = block_store
+        self.script_verifier = script_verifier
+        self.get_time = get_time
+        self._candidates: set[CBlockIndex] = set()  # setBlockIndexCandidates
+        self._seq = 0
+        self._invalid: set[CBlockIndex] = set()
+        # notification hooks (CMainSignals analogue — validationinterface)
+        self.on_block_connected: list[Callable] = []
+        self.on_block_disconnected: list[Callable] = []
+        self.on_tip_changed: list[Callable] = []
+        self._init_genesis()
+
+    # ------------------------------------------------------------------
+    # init
+    # ------------------------------------------------------------------
+
+    def _init_genesis(self):
+        genesis = self.params.genesis
+        gh = genesis.get_hash()
+        if self.block_store.get_block(gh) is None:
+            self.block_store.put_block(gh, genesis.serialize())
+        idx = CBlockIndex(genesis.header, gh, None)
+        idx.status = BlockStatus.VALID_SCRIPTS | BlockStatus.HAVE_DATA
+        idx.n_tx = len(genesis.vtx)
+        self.block_index[gh] = idx
+        best = self.coins.best_block()
+        if best == b"\x00" * 32:
+            # fresh chainstate: connect genesis outputs
+            self.chain.set_tip(idx)
+            for tx in genesis.vtx:
+                add_coins(self.coins, tx, 0)
+            self.coins.set_best_block(gh)
+        # (restart-resume via LoadBlockIndex lives in store/; node/ calls it)
+
+    # ------------------------------------------------------------------
+    # context-free checks
+    # ------------------------------------------------------------------
+
+    def check_block_header(self, header: CBlockHeader, check_pow: bool = True) -> None:
+        """CheckBlockHeader: proof of work only (src/validation.cpp)."""
+        if check_pow and not check_proof_of_work(
+            header.get_hash(), header.bits, self.params.consensus
+        ):
+            raise BlockValidationError("high-hash", "proof of work failed")
+
+    def check_block(self, block: CBlock, check_pow: bool = True,
+                    check_merkle: bool = True) -> None:
+        """CheckBlock (src/validation.cpp): header + merkle + tx sanity."""
+        self.check_block_header(block.header, check_pow)
+
+        if check_merkle:
+            root, mutated = block_merkle_root(block)
+            if root != block.header.hash_merkle_root:
+                raise BlockValidationError("bad-txnmrklroot", "hashMerkleRoot mismatch")
+            if mutated:
+                raise BlockValidationError("bad-txns-duplicate", "duplicate transaction")
+
+        if not block.vtx:
+            raise BlockValidationError("bad-blk-length", "block with no transactions")
+        if block.size() > self.params.max_block_size:
+            raise BlockValidationError("bad-blk-length", "size limits failed")
+        if not block.vtx[0].is_coinbase():
+            raise BlockValidationError("bad-cb-missing", "first tx is not coinbase")
+        for tx in block.vtx[1:]:
+            if tx.is_coinbase():
+                raise BlockValidationError("bad-cb-multiple", "more than one coinbase")
+        for tx in block.vtx:
+            try:
+                check_transaction(tx)
+            except TxValidationError as e:
+                raise BlockValidationError(e.reason, f"tx {tx.txid_hex}") from e
+
+    # ------------------------------------------------------------------
+    # contextual checks
+    # ------------------------------------------------------------------
+
+    def contextual_check_block_header(self, header: CBlockHeader,
+                                      prev: CBlockIndex) -> None:
+        """ContextualCheckBlockHeader: difficulty, timestamps, checkpoints."""
+        expected_bits = get_next_work_required(prev, header.time, self.params.consensus)
+        if header.bits != expected_bits:
+            raise BlockValidationError("bad-diffbits", "incorrect proof of work")
+        if header.time <= prev.get_median_time_past():
+            raise BlockValidationError("time-too-old", "block's timestamp is too early")
+        if header.time > self.get_time() + MAX_FUTURE_BLOCK_TIME:
+            raise BlockValidationError("time-too-new", "block timestamp too far in the future")
+        height = prev.height + 1
+        cp_hash = self.params.checkpoints.get(height)
+        if cp_hash is not None and header.get_hash() != cp_hash:
+            raise BlockValidationError("checkpoint-mismatch", f"height {height}")
+        # Reject forks below the last checkpoint we have on the active chain —
+        # GetLastCheckpoint + the bad-fork-prior-to-checkpoint rule.
+        last_cp = self._last_checkpoint_height()
+        if height < last_cp:
+            raise BlockValidationError(
+                "bad-fork-prior-to-checkpoint", f"height {height} < checkpoint {last_cp}"
+            )
+
+    def _last_checkpoint_height(self) -> int:
+        """Height of the highest checkpoint present on the active chain —
+        Checkpoints::GetLastCheckpoint (src/checkpoints.cpp)."""
+        for h in sorted(self.params.checkpoints, reverse=True):
+            idx = self.chain[h]
+            if idx is not None and idx.hash == self.params.checkpoints[h]:
+                return h
+        return 0
+
+    def contextual_check_block(self, block: CBlock, prev: CBlockIndex) -> None:
+        """ContextualCheckBlock: BIP34 height-in-coinbase, tx finality."""
+        height = prev.height + 1
+        mtp = prev.get_median_time_past()
+        for tx in block.vtx:
+            if not self._is_final_tx(tx, height, mtp):
+                raise BlockValidationError("bad-txns-nonfinal", "non-final transaction")
+        if height >= self.params.consensus.bip34_height:
+            expect = _script_int(height)
+            script_sig = block.vtx[0].vin[0].script_sig
+            if script_sig[: len(expect)] != expect:
+                raise BlockValidationError("bad-cb-height", "block height mismatch in coinbase")
+
+    @staticmethod
+    def _is_final_tx(tx: CTransaction, block_height: int, block_time: int) -> bool:
+        """IsFinalTx (src/consensus/tx_verify.cpp:~17)."""
+        if tx.locktime == 0:
+            return True
+        threshold = 500_000_000  # LOCKTIME_THRESHOLD
+        cutoff = block_height if tx.locktime < threshold else block_time
+        if tx.locktime < cutoff:
+            return True
+        return all(txin.sequence == 0xFFFFFFFF for txin in tx.vin)
+
+    # ------------------------------------------------------------------
+    # header / block acceptance into the tree
+    # ------------------------------------------------------------------
+
+    def accept_block_header(self, header: CBlockHeader) -> CBlockIndex:
+        """AcceptBlockHeader: check + insert into the block tree."""
+        h = header.get_hash()
+        existing = self.block_index.get(h)
+        if existing is not None:
+            if existing.status & BlockStatus.FAILED_MASK:
+                raise BlockValidationError("duplicate", "block is marked invalid")
+            return existing
+        self.check_block_header(header)
+        prev = self.block_index.get(header.hash_prev_block)
+        if prev is None:
+            raise BlockValidationError("prev-blk-not-found", hash_to_hex(header.hash_prev_block))
+        if prev.status & BlockStatus.FAILED_MASK:
+            raise BlockValidationError("bad-prevblk", "previous block invalid")
+        self.contextual_check_block_header(header, prev)
+        idx = CBlockIndex(header, h, prev)
+        self._seq += 1
+        idx.sequence_id = self._seq
+        idx.raise_validity(BlockStatus.VALID_TREE)
+        self.block_index[h] = idx
+        return idx
+
+    def accept_block(self, block: CBlock) -> CBlockIndex:
+        """AcceptBlock (src/validation.cpp:~3000): header + full block checks,
+        persist to the block store, mark HAVE_DATA, register candidate."""
+        idx = self.accept_block_header(block.header)
+        if idx.status & BlockStatus.HAVE_DATA:
+            return idx  # already have it
+        self.check_block(block)
+        self.contextual_check_block(block, idx.prev)
+        idx.n_tx = len(block.vtx)
+        idx.raise_validity(BlockStatus.VALID_TRANSACTIONS)
+        idx.status |= BlockStatus.HAVE_DATA
+        self.block_store.put_block(idx.hash, block.serialize())
+        self._try_add_candidate(idx)
+        return idx
+
+    def _try_add_candidate(self, idx: CBlockIndex):
+        tip = self.chain.tip()
+        if idx.is_valid(BlockStatus.VALID_TRANSACTIONS) and (
+            tip is None or idx.chain_work > tip.chain_work
+        ):
+            self._candidates.add(idx)
+
+    # ------------------------------------------------------------------
+    # connect / disconnect
+    # ------------------------------------------------------------------
+
+    def connect_block(self, block: CBlock, idx: CBlockIndex,
+                      check_scripts: bool = True,
+                      view: Optional[CoinsCache] = None) -> BlockUndo:
+        """ConnectBlock (src/validation.cpp:~1700).
+
+        Edits go to `view` when given (dry-runs pass a throwaway layer and
+        own it; _connect_tip passes a scratch it flushes itself). With no
+        view, edits build on an internal scratch layer that is merged into
+        self.coins ONLY on success — a failing connect can never corrupt the
+        live cache. Returns undo data.
+        """
+        merge_on_success = view is None
+        if view is None:
+            view = CoinsCache(self.coins)
+        coins_save, self.coins = self.coins, view
+        try:
+            undo = self._connect_block_inner(block, idx, check_scripts)
+        finally:
+            self.coins = coins_save
+        if merge_on_success:
+            view.flush()
+        return undo
+
+    def _connect_block_inner(self, block: CBlock, idx: CBlockIndex,
+                             check_scripts: bool) -> BlockUndo:
+        height = idx.height
+        consensus = self.params.consensus
+
+        # BIP30: no overwriting of existing unspent coins
+        for tx in block.vtx:
+            txid = tx.txid
+            for i in range(len(tx.vout)):
+                if self.coins.get_coin(COutPoint(txid, i)) is not None:
+                    raise BlockValidationError("bad-txns-BIP30", "tried to overwrite transaction")
+
+        undo = BlockUndo([])
+        fees = 0
+        spent_per_tx: list[list] = []  # per non-coinbase tx: spent Coins, input order
+        for tx in block.vtx:
+            if tx.is_coinbase():
+                add_coins(self.coins, tx, height)
+                continue
+            txundo = TxUndo([])
+            value_in = 0
+            for txin in tx.vin:
+                coin = self.coins.spend_coin(txin.prevout)
+                if coin is None:
+                    raise BlockValidationError(
+                        "bad-txns-inputs-missingorspent", f"tx {tx.txid_hex}"
+                    )
+                if coin.is_coinbase and height - coin.height < consensus.coinbase_maturity:
+                    raise BlockValidationError(
+                        "bad-txns-premature-spend-of-coinbase",
+                        f"{height - coin.height} of {consensus.coinbase_maturity}",
+                    )
+                value_in += coin.out.value
+                txundo.prevouts.append(coin)
+            if not money_range(value_in):
+                raise BlockValidationError("bad-txns-inputvalues-outofrange")
+            value_out = tx.total_output_value()
+            if value_in < value_out:
+                raise BlockValidationError("bad-txns-in-belowout", f"tx {tx.txid_hex}")
+            fee = value_in - value_out
+            if not money_range(fee):
+                raise BlockValidationError("bad-txns-fee-outofrange")
+            fees += fee
+            undo.vtxundo.append(txundo)
+            spent_per_tx.append(txundo.prevouts)
+            add_coins(self.coins, tx, height)
+
+        reward = fees + get_block_subsidy(height, consensus)
+        if block.vtx[0].total_output_value() > reward:
+            raise BlockValidationError(
+                "bad-cb-amount",
+                f"coinbase pays too much ({block.vtx[0].total_output_value()} > {reward})",
+            )
+
+        if check_scripts and self.script_verifier is not None:
+            # Deferred batch verification — the CCheckQueue replacement:
+            # one call, one TPU dispatch (SURVEY.md §4.2 graft point).
+            self.script_verifier(block, idx, spent_per_tx)
+
+        self.coins.set_best_block(idx.hash)
+        return undo
+
+    def disconnect_block(self, block: CBlock, idx: CBlockIndex,
+                         undo: BlockUndo,
+                         view: Optional[CoinsCache] = None) -> None:
+        """DisconnectBlock: remove created coins, restore spent ones."""
+        if view is not None:
+            coins_save, self.coins = self.coins, view
+            try:
+                return self.disconnect_block(block, idx, undo)
+            finally:
+                self.coins = coins_save
+        if len(undo.vtxundo) != len(block.vtx) - 1:
+            raise BlockValidationError("bad-undo", "undo tx count mismatch")
+        for tx in reversed(block.vtx):
+            txid = tx.txid
+            for i in range(len(tx.vout)):
+                self.coins.spend_coin(COutPoint(txid, i))
+        for tx, txundo in zip(reversed(block.vtx[1:]), reversed(undo.vtxundo)):
+            if len(txundo.prevouts) != len(tx.vin):
+                raise BlockValidationError("bad-undo", "undo input count mismatch")
+            for txin, coin in zip(tx.vin, txundo.prevouts):
+                self.coins.add_coin(txin.prevout, coin, overwrite=True)
+        self.coins.set_best_block(idx.prev.hash)
+
+    # ------------------------------------------------------------------
+    # chain activation (reorg engine)
+    # ------------------------------------------------------------------
+
+    def _find_most_work_chain(self) -> Optional[CBlockIndex]:
+        """FindMostWorkChain: best candidate not known to be invalid."""
+        best = None
+        for idx in self._candidates:
+            if idx.status & BlockStatus.FAILED_MASK:
+                continue
+            if best is None or (idx.chain_work, -idx.sequence_id) > (
+                best.chain_work, -best.sequence_id
+            ):
+                best = idx
+        return best
+
+    def activate_best_chain(self) -> None:
+        """ActivateBestChain (src/validation.cpp:~2500): step toward the
+        most-work valid chain, disconnecting/connecting as needed."""
+        while True:
+            tip = self.chain.tip()
+            target = self._find_most_work_chain()
+            if target is None or (tip is not None and target.chain_work <= tip.chain_work):
+                self._prune_candidates()
+                return
+            if not self._activate_step(target):
+                # target (or an ancestor) failed validation; loop to retry
+                # with the next-best candidate
+                continue
+            self._prune_candidates()
+            for cb in self.on_tip_changed:
+                cb(self.chain.tip())
+            # loop again in case an even better candidate appeared meanwhile
+
+    def _activate_step(self, target: CBlockIndex) -> bool:
+        """One ActivateBestChainStep: reorg from current tip to target."""
+        fork = self.chain.find_fork(target)
+        # disconnect to the fork point
+        while self.chain.tip() is not None and self.chain.tip() is not fork:
+            if not self._disconnect_tip():
+                return False
+        # connect the path fork -> target
+        path = []
+        idx = target
+        while idx is not fork:
+            path.append(idx)
+            idx = idx.prev
+        for idx in reversed(path):
+            if not self._connect_tip(idx):
+                return False
+        return True
+
+    def _connect_tip(self, idx: CBlockIndex) -> bool:
+        """ConnectTip: load block, connect, update chain; on failure mark
+        the subtree invalid and return False."""
+        raw = self.block_store.get_block(idx.hash)
+        assert raw is not None, "candidate without block data"
+        block = CBlock.from_bytes(raw)
+        scratch = CoinsCache(self.coins)
+        try:
+            undo = self.connect_block(block, idx, view=scratch)
+        except BlockValidationError:
+            self._mark_invalid(idx)
+            return False  # scratch layer dropped; earlier edits untouched
+        scratch.flush()  # merge into the long-lived cache
+        self.block_store.put_undo(idx.hash, undo.serialize())
+        idx.status |= BlockStatus.HAVE_UNDO
+        idx.raise_validity(
+            BlockStatus.VALID_SCRIPTS if self.script_verifier else BlockStatus.VALID_CHAIN
+        )
+        self.chain.set_tip(idx)
+        for cb in self.on_block_connected:
+            cb(block, idx)
+        return True
+
+    def _disconnect_tip(self) -> bool:
+        tip = self.chain.tip()
+        raw = self.block_store.get_block(tip.hash)
+        undo_raw = self.block_store.get_undo(tip.hash)
+        assert raw is not None and undo_raw is not None
+        block = CBlock.from_bytes(raw)
+        scratch = CoinsCache(self.coins)
+        self.disconnect_block(block, tip, BlockUndo.from_bytes(undo_raw), view=scratch)
+        scratch.flush()
+        self.chain.set_tip(tip.prev)
+        self._try_add_candidate(tip)  # it may become best again later
+        for cb in self.on_block_disconnected:
+            cb(block, tip)
+        return True
+
+    def _mark_invalid(self, idx: CBlockIndex):
+        """InvalidBlockFound: FAILED_VALID on idx, FAILED_CHILD on descendants."""
+        idx.status |= BlockStatus.FAILED_VALID
+        self._invalid.add(idx)
+        self._candidates.discard(idx)
+        for other in self.block_index.values():
+            walk = other
+            while walk is not None and walk.height >= idx.height:
+                if walk is idx and other is not idx:
+                    other.status |= BlockStatus.FAILED_CHILD
+                    self._candidates.discard(other)
+                    break
+                walk = walk.prev
+
+    def _prune_candidates(self):
+        tip = self.chain.tip()
+        if tip is None:
+            return
+        self._candidates = {
+            c for c in self._candidates
+            if c.chain_work > tip.chain_work and not (c.status & BlockStatus.FAILED_MASK)
+        }
+
+    # ------------------------------------------------------------------
+    # public entry points
+    # ------------------------------------------------------------------
+
+    def process_new_block(self, block: CBlock) -> bool:
+        """ProcessNewBlock (src/validation.cpp:~3100). Returns True if the
+        block was accepted into the tree (not necessarily the active chain).
+        Raises BlockValidationError for invalid blocks (callers that need
+        the reference's bool-only contract catch it)."""
+        self.accept_block(block)
+        self.activate_best_chain()
+        return True
+
+    def invalidate_block(self, idx: CBlockIndex) -> None:
+        """InvalidateBlock RPC backend: mark invalid and walk the tip back."""
+        self._mark_invalid(idx)
+        # disconnect while the invalid block is on the active chain
+        while self.chain.tip() is not None and (
+            self.chain[idx.height] is idx
+        ):
+            self._disconnect_tip()
+        # re-seed candidates from scratch (conservative, matches semantics)
+        for other in self.block_index.values():
+            self._try_add_candidate(other)
+        self.activate_best_chain()
+
+    def reconsider_block(self, idx: CBlockIndex) -> None:
+        """ResetBlockFailureFlags analogue."""
+        for other in list(self.block_index.values()):
+            walk = other
+            while walk is not None:
+                if walk is idx:
+                    other.status &= ~BlockStatus.FAILED_MASK
+                    self._invalid.discard(other)
+                    self._try_add_candidate(other)
+                    break
+                walk = walk.prev
+        self.activate_best_chain()
+
+    def flush(self) -> None:
+        """FlushStateToDisk: batch-write the coins cache + best-block marker."""
+        self.coins.flush()
+        self.block_store.flush()
+
+    # -- queries used by RPC / mining --
+
+    def tip(self) -> Optional[CBlockIndex]:
+        return self.chain.tip()
+
+    def get_block(self, block_hash: bytes) -> Optional[CBlock]:
+        raw = self.block_store.get_block(block_hash)
+        return CBlock.from_bytes(raw) if raw is not None else None
+
+
+def _script_int(n: int) -> bytes:
+    """Minimal CScript integer push (BIP34 height encoding) — CScriptNum."""
+    if n == 0:
+        return b"\x00"
+    out = bytearray()
+    v = n
+    while v:
+        out.append(v & 0xFF)
+        v >>= 8
+    if out[-1] & 0x80:
+        out.append(0)
+    return bytes([len(out)]) + bytes(out)

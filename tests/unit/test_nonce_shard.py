@@ -33,7 +33,7 @@ def test_sharded_sweep_finds_valid_nonce():
     hdr = _regtest_header()
     target, _ = compact_to_target(hdr.bits)
     nonce, hashes = sweep_header_sharded(
-        hdr.serialize(), target, nonces_per_chip=1 << 13, tile=1 << 12
+        hdr.serialize(), target, max_nonces=1 << 16, tile=1 << 12
     )
     assert nonce is not None
     assert int.from_bytes(hdr.with_nonce(nonce).get_hash(), "little") <= target
@@ -49,7 +49,7 @@ def test_sharded_sweep_matches_single_chip_result():
     hdr = _regtest_header()
     target, _ = compact_to_target(hdr.bits)
     n_multi, _ = sweep_header_sharded(
-        hdr.serialize(), target, nonces_per_chip=1 << 13, tile=1 << 12
+        hdr.serialize(), target, max_nonces=1 << 16, tile=1 << 12
     )
     n_single, _ = sweep_header(
         hdr.serialize(), target, tile=1 << 12, max_nonces=1 << 13
@@ -58,10 +58,32 @@ def test_sharded_sweep_matches_single_chip_result():
     assert n_multi == n_single
 
 
+def test_mine_block_with_sharded_sweep():
+    """mine_block's documented sweep-injection hook must accept the sharded
+    sweep (regression: kwarg contract mismatch)."""
+    from bitcoincashplus_tpu.consensus.params import regtest_params
+    from bitcoincashplus_tpu.mining.assembler import BlockAssembler
+    from bitcoincashplus_tpu.mining.generate import mine_block
+    from bitcoincashplus_tpu.store.blockstore import MemoryBlockStore
+    from bitcoincashplus_tpu.validation.chainstate import ChainstateManager
+    from bitcoincashplus_tpu.validation.coins import MemoryCoinsView
+
+    cs = ChainstateManager(
+        regtest_params(), MemoryCoinsView(), MemoryBlockStore(),
+        get_time=lambda: 1_600_000_000,
+    )
+    block = mine_block(
+        BlockAssembler(cs), b"\x51", tile=1 << 12, sweep=sweep_header_sharded
+    )
+    assert block is not None
+    cs.process_new_block(block)
+    assert cs.chain.height() == 1
+
+
 def test_sharded_not_found():
     hdr = _regtest_header()
     nonce, hashes = sweep_header_sharded(
-        hdr.serialize(), target=0, nonces_per_chip=1 << 12, tile=1 << 12
+        hdr.serialize(), target=0, max_nonces=1 << 15, tile=1 << 12
     )
     assert nonce is None
     assert hashes == 8 * (1 << 12)
