@@ -1,0 +1,73 @@
+"""Headline benchmark — prints ONE JSON line.
+
+Metric: SHA-256d proof-of-work throughput of the single-chip nonce-sweep
+kernel (the graft's headline number, BASELINE.json: target >=500 GH/s/chip
+on TPU v5e). vs_baseline is value/500.
+
+Method: sweep a fixed header template against an impossible target (no
+early exit) for a fixed tile count entirely on-device (one dispatch,
+lax.while_loop over tiles), timed after a warmup dispatch that absorbs
+compile time. Each nonce costs two SHA-256 compressions (midstate path);
+a "hash" below = one full SHA-256d of an 80-byte header.
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bitcoincashplus_tpu.crypto.hashes import header_midstate
+from bitcoincashplus_tpu.ops.miner import sweep_jit
+from bitcoincashplus_tpu.ops.sha256 import bytes_to_words_np, target_to_limbs_np
+
+BASELINE_GHS = 500.0  # BASELINE.json north star, per chip
+
+
+def main():
+    on_cpu = jax.default_backend() == "cpu" and "axon" not in str(jax.devices())
+    header = bytes(range(80))
+    midstate = jnp.asarray(np.array(header_midstate(header), dtype=np.uint32))
+    tail = jnp.asarray(bytes_to_words_np(np.frombuffer(header[64:76], np.uint8)))
+    target = jnp.asarray(target_to_limbs_np(0))  # impossible: full sweep
+
+    tile = 1 << 14 if on_cpu else 1 << 20
+    n_tiles = 4 if on_cpu else 128
+
+    # warmup / compile
+    jax.block_until_ready(
+        sweep_jit(midstate, tail, target, jnp.uint32(0), jnp.uint32(1), tile=tile)
+    )
+
+    rates = []
+    for _ in range(4):
+        # random start nonce: the serving layer memoizes identical
+        # (program, args) dispatches, which would fake the timing
+        start = jnp.uint32(random.getrandbits(32))
+        t0 = time.perf_counter()
+        found, nonce, tiles = jax.block_until_ready(
+            sweep_jit(midstate, tail, target, start, jnp.uint32(n_tiles), tile=tile)
+        )
+        dt = time.perf_counter() - t0
+        rates.append(int(tiles) * tile / dt)
+
+    # the first post-warmup dispatch returns anomalously fast through the
+    # serving tunnel; median of the remaining runs is the honest figure
+    rates = sorted(rates[1:])
+    ghs = rates[len(rates) // 2] / 1e9
+    print(json.dumps({
+        "metric": "sha256d_sweep_throughput_per_chip",
+        "value": round(ghs, 4),
+        "unit": "GH/s",
+        "vs_baseline": round(ghs / BASELINE_GHS, 6),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -71,6 +71,12 @@ def _use_unrolled() -> bool:
     override = os.environ.get("BCP_SHA_UNROLL")
     if override is not None:
         return override not in ("0", "false", "")
+    # JAX_PLATFORMS=cpu (driver dryrun / CI) beats backend autodetection —
+    # the axon TPU plugin wins default-backend selection even then, but
+    # meshes built by parallel/mesh.local_devices honor the env var, so the
+    # computation really runs on CPU.
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() == "cpu":
+        return False
     dd = jax.config.jax_default_device
     if dd is not None:
         return dd.platform != "cpu"
