@@ -1,0 +1,217 @@
+"""secp256k1 — CPU reference implementation (Python ints).
+
+Reference: src/secp256k1/ (secp256k1_ecdsa_verify at src/secp256k1.c:~340,
+secp256k1_ecmult at ecmult_impl.h, group law in group_impl.h, RFC6979
+nonces in secp256k1_nonce_function_rfc6979). This module is:
+  (a) the correctness oracle for the TPU batch kernel (ops/secp256k1.py),
+  (b) the scalar fallback path for non-batchable checks,
+  (c) the wallet's signer.
+
+Python ints make the field/scalar arithmetic exact and readable; this path
+is never the block-validation hot loop (that's the TPU batch).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+from typing import Optional
+
+# Curve: y^2 = x^3 + 7 over F_p
+P = 2**256 - 2**32 - 977
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+B = 7
+
+# Affine points as (x, y) tuples; None is the point at infinity.
+G = (GX, GY)
+
+
+def is_on_curve(pt) -> bool:
+    if pt is None:
+        return True
+    x, y = pt
+    return (y * y - x * x * x - B) % P == 0
+
+
+def point_add(p1, p2):
+    """Affine group law (group_impl.h secp256k1_gej_add_var semantics)."""
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2:
+        if (y1 + y2) % P == 0:
+            return None  # inverses
+        return point_double(p1)
+    lam = (y2 - y1) * pow(x2 - x1, P - 2, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    return (x3, (lam * (x1 - x3) - y1) % P)
+
+
+def point_double(pt):
+    if pt is None:
+        return None
+    x, y = pt
+    if y == 0:
+        return None
+    lam = 3 * x * x * pow(2 * y, P - 2, P) % P
+    x3 = (lam * lam - 2 * x) % P
+    return (x3, (lam * (x - x3) - y) % P)
+
+
+def point_mul(k: int, pt):
+    """Double-and-add (the constant-time wNAF machinery of ecmult_impl.h is
+    irrelevant off the hot path; verification needs no side-channel armor)."""
+    k %= N
+    result = None
+    addend = pt
+    while k:
+        if k & 1:
+            result = point_add(result, addend)
+        addend = point_double(addend)
+        k >>= 1
+    return result
+
+
+def point_neg(pt):
+    if pt is None:
+        return None
+    x, y = pt
+    return (x, (-y) % P)
+
+
+# ---- key / pubkey codecs (src/pubkey.cpp CPubKey) ----
+
+def pubkey_serialize(pt, compressed: bool = True) -> bytes:
+    x, y = pt
+    if compressed:
+        return bytes([2 + (y & 1)]) + x.to_bytes(32, "big")
+    return b"\x04" + x.to_bytes(32, "big") + y.to_bytes(32, "big")
+
+
+def pubkey_parse(data: bytes) -> Optional[tuple]:
+    """CPubKey decompression — secp256k1_ec_pubkey_parse. Returns None for
+    anything malformed or off-curve."""
+    if len(data) == 33 and data[0] in (2, 3):
+        x = int.from_bytes(data[1:], "big")
+        if x >= P:
+            return None
+        y2 = (x * x * x + B) % P
+        y = pow(y2, (P + 1) // 4, P)
+        if y * y % P != y2:
+            return None
+        if (y & 1) != (data[0] & 1):
+            y = P - y
+        return (x, y)
+    if len(data) == 65 and data[0] in (4, 6, 7):
+        x = int.from_bytes(data[1:33], "big")
+        y = int.from_bytes(data[33:], "big")
+        if x >= P or y >= P:
+            return None
+        # hybrid forms (6/7) must have matching parity
+        if data[0] in (6, 7) and (y & 1) != (data[0] & 1):
+            return None
+        pt = (x, y)
+        return pt if is_on_curve(pt) else None
+    return None
+
+
+def privkey_to_pubkey(secret: int, compressed: bool = True) -> bytes:
+    return pubkey_serialize(point_mul(secret, G), compressed)
+
+
+# ---- ECDSA (secp256k1.c secp256k1_ecdsa_verify / _sign) ----
+
+def ecdsa_verify(pubkey, r: int, s: int, e: int) -> bool:
+    """Raw ECDSA verify: pubkey affine point, (r, s) signature scalars,
+    e = message hash as integer. Matches secp256k1_ecdsa_sig_verify
+    (ecdsa_impl.h): accepts any s in [1, n-1] (low-s policy is enforced at
+    the script layer, not here — like the reference library)."""
+    if pubkey is None or not (1 <= r < N) or not (1 <= s < N):
+        return False
+    w = pow(s, N - 2, N)
+    u1 = e * w % N
+    u2 = r * w % N
+    pt = point_add(point_mul(u1, G), point_mul(u2, pubkey))
+    if pt is None:
+        return False
+    # r == x_R mod n (x_R in [0, p); the x_R >= n wraparound folds in here)
+    return (pt[0] - r) % N == 0
+
+
+def ecdsa_sign(secret: int, e: int, nonce: Optional[int] = None) -> tuple[int, int]:
+    """Returns (r, s) with low-s normalization (the reference signer's
+    secp256k1_ecdsa_sig_sign + secp256k1_scalar_cond_negate)."""
+    if nonce is None:
+        nonce = rfc6979_nonce(secret, e)
+    k = nonce
+    pt = point_mul(k, G)
+    r = pt[0] % N
+    assert r != 0
+    s = pow(k, N - 2, N) * (e + r * secret) % N
+    assert s != 0
+    if s > N // 2:
+        s = N - s
+    return r, s
+
+
+def rfc6979_nonce(secret: int, e: int, extra: bytes = b"") -> int:
+    """RFC6979 deterministic nonce (secp256k1_nonce_function_rfc6979),
+    HMAC-SHA256 variant, as the reference library uses."""
+    x = secret.to_bytes(32, "big")
+    msg = (e % (1 << 256)).to_bytes(32, "big")
+    v = b"\x01" * 32
+    k = b"\x00" * 32
+    k = hmac.new(k, v + b"\x00" + x + msg + extra, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    k = hmac.new(k, v + b"\x01" + x + msg + extra, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    while True:
+        v = hmac.new(k, v, hashlib.sha256).digest()
+        candidate = int.from_bytes(v, "big")
+        if 1 <= candidate < N:
+            return candidate
+        k = hmac.new(k, v + b"\x00", hashlib.sha256).digest()
+        v = hmac.new(k, v, hashlib.sha256).digest()
+
+
+# ---- DER (src/pubkey.cpp CPubKey::CheckLowS / ecdsa_signature_parse_der_lax) ----
+
+def sig_der_encode(r: int, s: int) -> bytes:
+    def enc_int(v: int) -> bytes:
+        b = v.to_bytes((v.bit_length() + 7) // 8 or 1, "big")
+        if b[0] & 0x80:
+            b = b"\x00" + b
+        return b"\x02" + bytes([len(b)]) + b
+
+    body = enc_int(r) + enc_int(s)
+    return b"\x30" + bytes([len(body)]) + body
+
+
+def sig_der_decode(sig: bytes) -> Optional[tuple[int, int]]:
+    """Permissive BER-ish parse mirroring ecdsa_signature_parse_der_lax
+    (the consensus behavior pre-BIP66 strictness; strict DER enforcement is
+    a script-flag check done on the raw bytes, not here)."""
+    try:
+        if len(sig) < 2 or sig[0] != 0x30:
+            return None
+        pos = 2
+        if sig[1] & 0x80:
+            nlen = sig[1] & 0x7F
+            pos = 2 + nlen
+        if pos >= len(sig) or sig[pos] != 0x02:
+            return None
+        rlen = sig[pos + 1]
+        r = int.from_bytes(sig[pos + 2 : pos + 2 + rlen], "big")
+        pos += 2 + rlen
+        if pos >= len(sig) or sig[pos] != 0x02:
+            return None
+        slen = sig[pos + 1]
+        s = int.from_bytes(sig[pos + 2 : pos + 2 + slen], "big")
+        return (r, s)
+    except (IndexError, ValueError):
+        return None
