@@ -7,7 +7,7 @@ whose tx list ends in a duplicated pair hashes to the same root) — the
 `mutated` out-flag detects identical adjacent nodes exactly like the
 reference's comment block describes.
 
-The TPU tree-reduction kernel (ops/merkle_kernel.py) is differential-tested
+The TPU tree-reduction kernel (ops/merkle.py) is differential-tested
 against this implementation.
 """
 

@@ -34,6 +34,10 @@ class Consensus:
     subsidy_halving_interval: int = 210_000
     coinbase_maturity: int = 100  # COINBASE_MATURITY (src/consensus/consensus.h)
     bip34_height: int = 0  # height-in-coinbase activation
+    bip16_time: int = 1333238400  # P2SH switch time (nBIP16SwitchTime)
+    bip65_height: int = -1  # CHECKLOCKTIMEVERIFY (-1 = never)
+    bip66_height: int = -1  # strict DER
+    csv_height: int = -1  # BIP68/112/113 CHECKSEQUENCEVERIFY bundle
     # BCH-family deltas [fork-delta, hedged — SURVEY.md §0]:
     uahf_height: int = -1  # SIGHASH_FORKID activation (-1 = never)
     use_cash_daa: bool = False
@@ -116,6 +120,9 @@ def main_params() -> ChainParams:
     consensus = Consensus(
         pow_limit=0x00000000FFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFF,
         bip34_height=227_931,
+        bip65_height=388_381,  # v4 blocks (BIP65 deployment height)
+        bip66_height=363_725,  # v3 blocks (BIP66)
+        csv_height=419_328,  # CSV softfork activation
         uahf_height=478_559,  # [fork-delta, hedged] BCH-family split height
         use_cash_daa=False,  # enabled per-run via -cashdaa once height rules land
     )
@@ -143,6 +150,9 @@ def testnet_params() -> ChainParams:
         pow_limit=0x00000000FFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFF,
         pow_allow_min_difficulty_blocks=True,
         bip34_height=21_111,
+        bip65_height=581_885,
+        bip66_height=330_776,
+        csv_height=770_112,
     )
     genesis = create_genesis_block(1296688602, 414098458, 0x1D00FFFF, 1, 50 * COIN)
     return ChainParams(
@@ -169,6 +179,10 @@ def regtest_params() -> ChainParams:
         pow_no_retargeting=True,
         subsidy_halving_interval=150,
         bip34_height=0,
+        bip16_time=0,  # P2SH always on (regtest, like the reference)
+        bip65_height=0,
+        bip66_height=0,
+        csv_height=0,
         uahf_height=0,
     )
     genesis = create_genesis_block(1296688602, 2, 0x207FFFFF, 1, 50 * COIN)

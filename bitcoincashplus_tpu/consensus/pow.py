@@ -6,7 +6,7 @@ src/arith_uint256.cpp:~190 (arith_uint256::SetCompact / GetCompact).
 
 Python ints replace arith_uint256 (exact 256-bit arithmetic is native here —
 no limb code needed on the host; the on-chip target compare in the miner
-kernel uses 8×u32 limbs, see ops/sha256_kernel.py).
+kernel uses 8×u32 limbs, see ops/sha256.py).
 
 The BCH-family lineage adds EDA / cw-144 DAA difficulty rules
 [fork-delta, hedged — SURVEY.md §0]; those are gated behind

@@ -2,7 +2,7 @@
 
 Reference: src/hash.h:~22 (CHash256 = double-SHA256), src/crypto/sha256.cpp
 (CSHA256), src/crypto/ripemd160.cpp, src/crypto/hmac_sha512.cpp. Here the CPU
-path delegates to OpenSSL via hashlib (the TPU path in ops/sha256_kernel.py is
+path delegates to OpenSSL via hashlib (the TPU path in ops/sha256.py is
 the performance path; this is the correctness oracle and small-input path).
 
 Also exposes the SHA-256 midstate utilities the mining kernel needs: the
@@ -17,7 +17,7 @@ import hashlib
 import hmac as _hmac
 import struct
 
-# SHA-256 initial state (FIPS 180-4) — shared with ops/sha256_kernel.py.
+# SHA-256 initial state (FIPS 180-4) — shared with ops/sha256.py.
 SHA256_INIT = (
     0x6A09E667, 0xBB67AE85, 0x3C6EF372, 0xA54FF53A,
     0x510E527F, 0x9B05688C, 0x1F83D9AB, 0x5BE0CD19,

@@ -1,0 +1,175 @@
+"""ECDSA batch dispatch — the host side of the TPU signature graft.
+
+Reference: this layer replaces CCheckQueue (src/checkqueue.h:~30) +
+ThreadScriptCheck (src/validation.cpp): instead of fanning CScriptCheck
+closures to worker threads, the block's deferred sigcheck records are
+packed SoA (scalar decomposition on host, bit-planes + 13-bit limbs) and
+verified in ONE device dispatch via ops/secp256k1.ecdsa_verify_batch_jit
+(SURVEY.md §3.2 P1, §8.4 "ECDSA batch").
+
+Pipeline per batch:
+  1. host: w = s⁻¹ mod n, u1 = e·w, u2 = r·w  (Python ints, µs per sig)
+  2. pack: u1/u2 → (256, B) MSB-first bit planes; qx/qy/r/rn → (20, B)
+     13-bit limbs; wrap_ok = (r + n < p) per lane (the kernel gates the
+     x-wraparound candidate on it — see ecdsa_verify_batch_device)
+  3. pad B up to a bucket size (bounds XLA recompiles to len(BUCKETS))
+  4. one jit dispatch; padded lanes are poisoned (q_inf) and ignored
+  5. device returns a (B,) validity mask; caller attributes failures
+
+CPU fallback (``backend="cpu"`` or batches below the dispatch floor) runs
+the Python-int oracle — the reference's single-threaded VerifyScript path.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+from ..crypto import secp256k1 as oracle
+
+# Pad-to-bucket sizes (SURVEY.md §8.4 dispatch layer). One compiled
+# executable per bucket; persistent across blocks via jit cache.
+BUCKETS = (32, 128, 512, 2048, 8192, 32768)
+# Below this lane count a device round-trip costs more than host verify.
+CPU_FLOOR = 8
+
+
+@dataclass
+class BatchStats:
+    """Per-dispatch metrics surfaced via gettpuinfo (SURVEY.md §6.5)."""
+
+    dispatches: int = 0
+    sigs_verified: int = 0
+    sigs_padded: int = 0
+    cpu_fallback_sigs: int = 0
+    device_seconds: float = 0.0
+    last_batch: int = 0
+    buckets_used: dict = field(default_factory=dict)
+
+    def snapshot(self) -> dict:
+        d = self.__dict__.copy()
+        d["buckets_used"] = dict(self.buckets_used)
+        return d
+
+
+STATS = BatchStats()
+
+
+def _bucket_for(n: int) -> int:
+    for b in BUCKETS:
+        if n <= b:
+            return b
+    return ((n + BUCKETS[-1] - 1) // BUCKETS[-1]) * BUCKETS[-1]
+
+
+def decompose_scalars(records: Sequence) -> list[tuple[int, int]]:
+    """Step 1: (u1, u2) per record. Records carry (r, s, msg_hash)."""
+    out = []
+    for rec in records:
+        w = pow(rec.s, oracle.N - 2, oracle.N)
+        out.append((rec.msg_hash * w % oracle.N, rec.r * w % oracle.N))
+    return out
+
+
+def pack_records(records: Sequence, bucket: int):
+    """Step 2+3: SoA arrays padded to ``bucket`` lanes.
+
+    Padded lanes get q_inf=True (poisoned: kernel reports False) and are
+    masked out by the caller — they can never turn a bad batch good or a
+    good batch bad."""
+    from . import secp256k1 as dev
+
+    n = len(records)
+    u1b = np.zeros((256, bucket), np.uint32)
+    u2b = np.zeros((256, bucket), np.uint32)
+    qx = np.zeros((dev.N_LIMBS, bucket), np.uint32)
+    qy = np.zeros((dev.N_LIMBS, bucket), np.uint32)
+    r0 = np.zeros((dev.N_LIMBS, bucket), np.uint32)
+    rn = np.zeros((dev.N_LIMBS, bucket), np.uint32)
+    q_inf = np.ones(bucket, bool)  # default poisoned (padding)
+    wrap_ok = np.zeros(bucket, bool)
+
+    scalars = decompose_scalars(records)
+    # bit-planes, MSB first (the kernel's fori_loop order): unpackbits on
+    # the 32-byte big-endian scalars — vectorized, not a 256·B Python loop
+    # (host packing must stay negligible next to the device dispatch)
+    u1_bytes = np.frombuffer(
+        b"".join(u1.to_bytes(32, "big") for u1, _ in scalars), np.uint8
+    ).reshape(n, 32)
+    u2_bytes = np.frombuffer(
+        b"".join(u2.to_bytes(32, "big") for _, u2 in scalars), np.uint8
+    ).reshape(n, 32)
+    u1b[:, :n] = np.unpackbits(u1_bytes, axis=1).T
+    u2b[:, :n] = np.unpackbits(u2_bytes, axis=1).T
+    for j, rec in enumerate(records):
+        qx[:, j] = dev.to_limbs_np(rec.pubkey[0])
+        qy[:, j] = dev.to_limbs_np(rec.pubkey[1])
+        r0[:, j] = dev.to_limbs_np(rec.r)
+        wrap = rec.r + oracle.N < oracle.P
+        rn[:, j] = dev.to_limbs_np(rec.r + oracle.N if wrap else rec.r)
+        wrap_ok[j] = wrap
+        q_inf[j] = False
+    return u1b, u2b, qx, qy, q_inf, r0, rn, wrap_ok
+
+
+def _verify_cpu(records: Sequence) -> np.ndarray:
+    return np.array(
+        [
+            oracle.ecdsa_verify(rec.pubkey, rec.r, rec.s, rec.msg_hash)
+            for rec in records
+        ],
+        dtype=bool,
+    )
+
+
+def _device_available() -> bool:
+    if os.environ.get("BCP_NO_DEVICE"):
+        return False
+    try:
+        import jax
+
+        return len(jax.devices()) > 0
+    except Exception:
+        return False
+
+
+def verify_batch(records: Sequence, backend: str = "auto") -> np.ndarray:
+    """Verify all records; returns (len(records),) bool.
+
+    backend: "auto" (device if available and batch >= CPU_FLOOR),
+    "device" (force), "cpu" (force oracle)."""
+    if not records:
+        return np.zeros(0, bool)
+    use_device = backend == "device" or (
+        backend == "auto"
+        and len(records) >= CPU_FLOOR
+        and _device_available()
+    )
+    if not use_device:
+        STATS.cpu_fallback_sigs += len(records)
+        return _verify_cpu(records)
+
+    import jax
+
+    from . import secp256k1 as dev
+
+    bucket = _bucket_for(len(records))
+    arrays = pack_records(records, bucket)
+    t0 = time.monotonic()
+    ok = np.asarray(
+        jax.block_until_ready(
+            dev.ecdsa_verify_batch_jit(*map(np.asarray, arrays))
+        )
+    )
+    dt = time.monotonic() - t0
+    STATS.dispatches += 1
+    STATS.sigs_verified += len(records)
+    STATS.sigs_padded += bucket - len(records)
+    STATS.device_seconds += dt
+    STATS.last_batch = len(records)
+    STATS.buckets_used[bucket] = STATS.buckets_used.get(bucket, 0) + 1
+    return ok[: len(records)]

@@ -93,7 +93,9 @@ def _sweep(limbs):
         v = row + carry
         return v >> np.uint32(LIMB_BITS), v & MASK
 
-    carry, out = jax.lax.scan(body, jnp.zeros_like(limbs[0]), limbs)
+    # init derived from the input so it stays chip-varying under shard_map
+    # (an invariant jnp.zeros init trips the scan carry-vma check there)
+    carry, out = jax.lax.scan(body, limbs[0] * U32_0, limbs)
     return out, carry
 
 
@@ -150,7 +152,8 @@ def f_mul(a, b) -> jnp.ndarray:
     20-term column sums < 2^31. Output weak."""
     width = 2 * N_LIMBS - 1
     shape = (width,) + tuple(np.broadcast_shapes(a.shape[1:], b.shape[1:]))
-    cols0 = jnp.zeros(shape, dtype=jnp.uint32)
+    # varying-safe zero init (see _sweep)
+    cols0 = jnp.zeros(shape, dtype=jnp.uint32) + (a[0] * b[0] * U32_0)
 
     def body(i, cols):
         ai = jax.lax.dynamic_index_in_dim(a, i, 0, keepdims=True)  # (1, B)
@@ -195,7 +198,7 @@ def _f_ge(a, b):
         eq = eq & (ai == bi)
         return (gt, eq), None
 
-    init = (jnp.zeros(a.shape[1:], bool), jnp.ones(a.shape[1:], bool))
+    init = (a[0] > a[0], a[0] == a[0])  # varying-safe (False…, True…)
     (gt, eq), _ = jax.lax.scan(body, init, (a[::-1], b[::-1]))
     return gt | eq
 
@@ -210,7 +213,7 @@ def _f_sub_exact(a, b):
         out = jnp.where(under, v + np.uint32(1 << LIMB_BITS), v)
         return under.astype(jnp.uint32), out
 
-    _, out = jax.lax.scan(body, jnp.zeros(a.shape[1:], jnp.uint32), (a, b))
+    _, out = jax.lax.scan(body, a[0] * U32_0, (a, b))
     return out
 
 
@@ -325,9 +328,16 @@ _GX_CONST = _const(GX)
 _GY_CONST = _const(GY)
 
 
-def ecdsa_verify_batch_device(u1_bits, u2_bits, qx, qy, q_inf, r0, rn):
+def ecdsa_verify_batch_device(u1_bits, u2_bits, qx, qy, q_inf, r0, rn,
+                              wrap_ok):
     """u1_bits/u2_bits: (256, B) uint32 in {0,1}, MSB first. qx/qy/r0/rn:
     (20, B) weak limbs. q_inf: (B,) poison mask (malformed pubkey lanes).
+    wrap_ok: (B,) bool — True iff r + n < p, i.e. the x-coordinate
+    wraparound candidate rn = r + n is admissible. The reference
+    (secp256k1_ecdsa_sig_verify, ecdsa_impl.h) only retries the +n
+    candidate under that bound; accepting X == rn·Z² without the gate
+    would falsely accept signatures with x_R = r + n - p. The gate is
+    enforced HERE, in-kernel, so a host layer cannot mis-use rn.
     Returns (B,) bool validity.
 
     MSB-first joint double-and-add: 256 x (double + 2 select-merged mixed
@@ -346,14 +356,25 @@ def ecdsa_verify_batch_device(u1_bits, u2_bits, qx, qy, q_inf, r0, rn):
         acc = pt_select(u2_bits[i].astype(bool) & ~q_inf, with_q, acc)
         return acc
 
-    acc = jax.lax.fori_loop(0, 256, step, pt_infinity(batch))
+    # infinity init derived from qx/q_inf so the fori_loop carry stays
+    # chip-varying under shard_map (parallel/sig_shard)
+    zero_v = qx * U32_0
+    acc0 = {
+        "X": zero_v + _const(1),
+        "Y": zero_v + _const(1),
+        "Z": zero_v,
+        "inf": q_inf | (q_inf == q_inf),  # all True, varying
+    }
+    acc = jax.lax.fori_loop(0, 256, step, acc0)
 
     ZZ = f_sqr(acc["Z"])
     ok0 = f_eq(acc["X"], f_mul(r0, ZZ))
-    ok1 = f_eq(acc["X"], f_mul(rn, ZZ))
+    ok1 = f_eq(acc["X"], f_mul(rn, ZZ)) & wrap_ok
     return ~acc["inf"] & ~q_inf & (ok0 | ok1)
 
 
 @jax.jit
-def ecdsa_verify_batch_jit(u1_bits, u2_bits, qx, qy, q_inf, r0, rn):
-    return ecdsa_verify_batch_device(u1_bits, u2_bits, qx, qy, q_inf, r0, rn)
+def ecdsa_verify_batch_jit(u1_bits, u2_bits, qx, qy, q_inf, r0, rn, wrap_ok):
+    return ecdsa_verify_batch_device(
+        u1_bits, u2_bits, qx, qy, q_inf, r0, rn, wrap_ok
+    )

@@ -59,6 +59,7 @@ class CBlockIndex:
         "chain_work",
         "status",
         "n_tx",
+        "chain_tx",
         "sequence_id",
     )
 
@@ -76,6 +77,11 @@ class CBlockIndex:
         )
         self.status = BlockStatus.VALIDITY_UNKNOWN
         self.n_tx = 0
+        # nChainTx analogue: cumulative tx count genesis..here; 0 means some
+        # ancestor (or this block) is missing data — such indexes must NOT
+        # become connect candidates (the reference gates
+        # setBlockIndexCandidates on nChainTx, src/validation.cpp).
+        self.chain_tx = 0
         self.sequence_id = 0  # tie-break: earlier-received wins (validation.cpp)
 
     # -- reference accessors --

@@ -26,6 +26,7 @@ from ..consensus.pow import check_proof_of_work, get_next_work_required
 from ..consensus.serialize import hash_to_hex
 from ..consensus.tx import COutPoint, CTransaction, money_range
 from ..consensus.tx_check import TxValidationError, check_transaction
+from ..script.script import script_int
 from .chain import BlockStatus, CBlockIndex, CChain
 from .coins import BlockUndo, CoinsCache, CoinsView, TxUndo, add_coins
 
@@ -39,9 +40,13 @@ class BlockValidationError(TxValidationError):
 # Type of the deferred script-verification hook: called once per block with
 # (block, index, spent_coins_per_input) and must raise BlockValidationError
 # on failure. Wired to the script interpreter + TPU sig batch in
-# validation/scriptcheck.py; None skips script checks entirely (pre-graft
-# slice / below-assumevalid behavior).
+# validation/scriptcheck.py. The DEFAULT is fail-closed: a
+# BlockScriptVerifier is constructed unless the caller explicitly passes
+# None (below-assumevalid / trusted-reindex behavior — the reference's
+# fScriptChecks=false path, src/validation.cpp ConnectBlock).
 ScriptVerifier = Callable[[CBlock, CBlockIndex, list], None]
+
+_DEFAULT = object()  # sentinel: "build the real verifier"
 
 
 class ChainstateManager:
@@ -52,9 +57,13 @@ class ChainstateManager:
         params: ChainParams,
         coins_base: CoinsView,
         block_store,
-        script_verifier: Optional[ScriptVerifier] = None,
+        script_verifier=_DEFAULT,
         get_time: Callable[[], int] = lambda: int(_time.time()),
     ):
+        if script_verifier is _DEFAULT:
+            from .scriptcheck import BlockScriptVerifier
+
+            script_verifier = BlockScriptVerifier(params)
         self.params = params
         self.chain = CChain()
         self.block_index: dict[bytes, CBlockIndex] = {}
@@ -65,6 +74,9 @@ class ChainstateManager:
         self._candidates: set[CBlockIndex] = set()  # setBlockIndexCandidates
         self._seq = 0
         self._invalid: set[CBlockIndex] = set()
+        # mapBlocksUnlinked analogue: children with data whose ancestor path
+        # is missing data; relinked when the gap block arrives.
+        self._unlinked: dict[CBlockIndex, list[CBlockIndex]] = {}
         # notification hooks (CMainSignals analogue — validationinterface)
         self.on_block_connected: list[Callable] = []
         self.on_block_disconnected: list[Callable] = []
@@ -83,6 +95,7 @@ class ChainstateManager:
         idx = CBlockIndex(genesis.header, gh, None)
         idx.status = BlockStatus.VALID_SCRIPTS | BlockStatus.HAVE_DATA
         idx.n_tx = len(genesis.vtx)
+        idx.chain_tx = idx.n_tx
         self.block_index[gh] = idx
         best = self.coins.best_block()
         if best == b"\x00" * 32:
@@ -228,13 +241,30 @@ class ChainstateManager:
         idx.raise_validity(BlockStatus.VALID_TRANSACTIONS)
         idx.status |= BlockStatus.HAVE_DATA
         self.block_store.put_block(idx.hash, block.serialize())
-        self._try_add_candidate(idx)
+        self._link_chain_tx(idx)
         return idx
+
+    def _link_chain_tx(self, idx: CBlockIndex):
+        """ReceivedBlockTransactions (src/validation.cpp): propagate the
+        nChainTx analogue down any now-complete subtree; blocks whose
+        ancestry still lacks data park in _unlinked until the gap fills."""
+        if idx.prev is not None and idx.prev.chain_tx == 0:
+            self._unlinked.setdefault(idx.prev, []).append(idx)
+            return
+        queue = [idx]
+        while queue:
+            cur = queue.pop()
+            base = cur.prev.chain_tx if cur.prev is not None else 0
+            cur.chain_tx = base + cur.n_tx
+            self._try_add_candidate(cur)
+            queue.extend(self._unlinked.pop(cur, ()))
 
     def _try_add_candidate(self, idx: CBlockIndex):
         tip = self.chain.tip()
-        if idx.is_valid(BlockStatus.VALID_TRANSACTIONS) and (
-            tip is None or idx.chain_work > tip.chain_work
+        if (
+            idx.chain_tx > 0  # whole ancestor path has block data
+            and idx.is_valid(BlockStatus.VALID_TRANSACTIONS)
+            and (tip is None or idx.chain_work > tip.chain_work)
         ):
             self._candidates.add(idx)
 
@@ -406,7 +436,12 @@ class ChainstateManager:
         """ConnectTip: load block, connect, update chain; on failure mark
         the subtree invalid and return False."""
         raw = self.block_store.get_block(idx.hash)
-        assert raw is not None, "candidate without block data"
+        if raw is None:
+            # Should be unreachable (chain_tx gating), but recover rather
+            # than assert: drop the candidate and let the activation loop
+            # pick the next-best chain.
+            self._candidates.discard(idx)
+            return False
         block = CBlock.from_bytes(raw)
         scratch = CoinsCache(self.coins)
         try:
@@ -517,15 +552,9 @@ class ChainstateManager:
         return CBlock.from_bytes(raw) if raw is not None else None
 
 
-def _script_int(n: int) -> bytes:
-    """Minimal CScript integer push (BIP34 height encoding) — CScriptNum."""
-    if n == 0:
-        return b"\x00"
-    out = bytearray()
-    v = n
-    while v:
-        out.append(v & 0xFF)
-        v >>= 8
-    if out[-1] & 0x80:
-        out.append(0)
-    return bytes([len(out)]) + bytes(out)
+# BIP34 height encoding = CScript() << nHeight. Delegates to the script
+# layer's script_int, which emits OP_1..OP_16/OP_0 single-byte opcodes for
+# small values exactly as the reference's CScript operator<< does — a raw
+# pushdata for 1..16 would make early regtest coinbases (bip34_height=0)
+# incompatible with reference nodes.
+_script_int = script_int

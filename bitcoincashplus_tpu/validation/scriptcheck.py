@@ -1,0 +1,154 @@
+"""Per-block script verification — the ConnectBlock sigcheck graft point.
+
+Reference: src/validation.cpp:~1250 (CScriptCheck::operator()), :~1300
+(CheckInputs), and the CCheckQueue fan-out in ConnectBlock (:~1700,
+control.Add/Wait). The thread-pool barrier becomes: run the (cheap,
+branchy) script interpreter on host with a DeferringSignatureChecker,
+accumulate every OP_CHECKSIG into SigCheckRecords, then settle the whole
+block in ONE ops/ecdsa_batch dispatch (SURVEY.md §4.2 graft point).
+Failure attribution maps the failing lane back to (tx, input).
+
+Sigcache-verified records are skipped before packing (sigcache.cpp:~70
+semantics); fresh records are inserted after a successful batch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..consensus.params import ChainParams
+from ..ops import ecdsa_batch
+from ..script.interpreter import (
+    SCRIPT_ENABLE_SIGHASH_FORKID,
+    SCRIPT_VERIFY_NONE,
+    SCRIPT_VERIFY_P2SH,
+    SCRIPT_VERIFY_STRICTENC,
+    SCRIPT_VERIFY_CHECKLOCKTIMEVERIFY,
+    SCRIPT_VERIFY_CHECKSEQUENCEVERIFY,
+    SCRIPT_VERIFY_DERSIG,
+    SCRIPT_VERIFY_LOW_S,
+    SCRIPT_VERIFY_NULLDUMMY,
+    SCRIPT_VERIFY_NULLFAIL,
+    DeferringSignatureChecker,
+    ScriptError,
+    SigCheckRecord,
+    TransactionSignatureChecker,
+    VerifyScript,
+)
+from ..script.sighash import SighashCache
+from .sigcache import SignatureCache
+
+
+def block_script_flags(height: int, block_time: int,
+                       params: ChainParams) -> int:
+    """Consensus flags for a block at (height, time) — the reference
+    derives these era-by-era in ConnectBlock (validation.cpp:~1700):
+    P2SH by the BIP16 switch TIME, strict DER at BIP66, CLTV at BIP65,
+    CSV at its height, and the fork's UAHF bundle [fork-delta, hedged].
+    Historical blocks MUST get historical flags — applying today's
+    STRICTENC to 2011 blocks (hybrid pubkeys, loose DER) would reject
+    the real chain during reindex."""
+    flags = SCRIPT_VERIFY_NONE
+    c = params.consensus
+    if block_time >= c.bip16_time:
+        flags |= SCRIPT_VERIFY_P2SH
+    if c.bip66_height >= 0 and height >= c.bip66_height:
+        flags |= SCRIPT_VERIFY_DERSIG
+    if c.bip65_height >= 0 and height >= c.bip65_height:
+        flags |= SCRIPT_VERIFY_CHECKLOCKTIMEVERIFY
+    if c.csv_height >= 0 and height >= c.csv_height:
+        flags |= SCRIPT_VERIFY_CHECKSEQUENCEVERIFY
+    if c.uahf_height >= 0 and height >= c.uahf_height:
+        # post-fork: replay-protected sighash, strict encodings, and the
+        # batch-soundness pair (NULLFAIL enables sig deferral)
+        flags |= (
+            SCRIPT_ENABLE_SIGHASH_FORKID
+            | SCRIPT_VERIFY_STRICTENC
+            | SCRIPT_VERIFY_NULLFAIL
+            | SCRIPT_VERIFY_LOW_S
+            | SCRIPT_VERIFY_NULLDUMMY
+        )
+    return flags
+
+
+class BlockScriptVerifier:
+    """The ChainstateManager ``script_verifier`` hook (chainstate.py).
+
+    Call contract: (block, idx, spent_per_tx) — spent_per_tx[i] is the
+    list of spent Coins for block.vtx[i+1]'s inputs, input order. Raises
+    BlockValidationError (via chainstate's exception type) on any failure.
+    """
+
+    def __init__(self, params: ChainParams, backend: str = "auto",
+                 sigcache: Optional[SignatureCache] = None):
+        self.params = params
+        self.backend = backend
+        self.sigcache = sigcache if sigcache is not None else SignatureCache()
+
+    def __call__(self, block, idx, spent_per_tx) -> None:
+        from .chainstate import BlockValidationError
+
+        flags = block_script_flags(
+            idx.height, block.header.time, self.params
+        )
+        defer = bool(flags & SCRIPT_VERIFY_NULLFAIL)
+
+        records: list[SigCheckRecord] = []
+        rec_attr: list[tuple[int, int]] = []  # (tx_index, input_index)
+
+        assert len(spent_per_tx) == len(block.vtx) - 1, "spent coins mismatch"
+        for t, (tx, spent) in enumerate(
+            zip(block.vtx[1:], spent_per_tx), start=1
+        ):
+            cache = SighashCache(tx)
+            for i, (txin, coin) in enumerate(zip(tx.vin, spent)):
+                if defer:
+                    n_before = len(records)
+                    checker = DeferringSignatureChecker(
+                        tx, i, coin.out.value, records, cache
+                    )
+                else:
+                    # pre-NULLFAIL blocks: deferral unsound, verify inline
+                    checker = TransactionSignatureChecker(
+                        tx, i, coin.out.value, cache
+                    )
+                try:
+                    VerifyScript(
+                        txin.script_sig, coin.out.script_pubkey, flags, checker
+                    )
+                except ScriptError as e:
+                    raise BlockValidationError(
+                        "blk-bad-inputs",
+                        f"script failure ({e.code}) tx {tx.txid_hex} input {i}",
+                    ) from e
+                if defer:
+                    rec_attr.extend(
+                        (t, i) for _ in range(len(records) - n_before)
+                    )
+
+        if not records:
+            return
+
+        # sigcache probe: drop already-known-valid records from the batch
+        keys = [
+            SignatureCache.entry_key(r.msg_hash, r.r, r.s, r.pubkey)
+            for r in records
+        ]
+        fresh = [
+            k for k, key in enumerate(keys) if not self.sigcache.contains(key)
+        ]
+        if fresh:
+            ok = ecdsa_batch.verify_batch(
+                [records[k] for k in fresh], backend=self.backend
+            )
+            for lane, k in enumerate(fresh):
+                if not ok[lane]:
+                    t, i = rec_attr[k]
+                    tx = block.vtx[t]
+                    raise BlockValidationError(
+                        "blk-bad-inputs",
+                        "signature verification failed "
+                        f"tx {tx.txid_hex} input {i}",
+                    )
+            for k in fresh:
+                self.sigcache.add(keys[k])

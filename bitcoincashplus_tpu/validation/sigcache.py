@@ -1,0 +1,48 @@
+"""Signature cache.
+
+Reference: src/script/sigcache.cpp:~70 (CSignatureCache) — memoizes
+(sighash, pubkey, signature) triples so signatures verified at mempool
+acceptance skip re-verification in ConnectBlock. Keyed identically;
+consulted BEFORE building the TPU batch (SURVEY.md §3.1 sigcache row),
+so steady-state block connects dispatch only never-seen signatures.
+
+Bounded FIFO eviction via an ordered dict (the reference uses randomized
+eviction / a cuckoo table; FIFO preserves the same contract — presence
+implies validity — without the tuning surface)."""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+
+
+class SignatureCache:
+    def __init__(self, max_entries: int = 1 << 16):
+        self.max_entries = max_entries
+        self._set: OrderedDict[bytes, None] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def entry_key(msg_hash: int, r: int, s: int, pubkey: tuple) -> bytes:
+        return (
+            msg_hash.to_bytes(32, "big")
+            + r.to_bytes(32, "big")
+            + s.to_bytes(32, "big")
+            + pubkey[0].to_bytes(32, "big")
+            + (pubkey[1] & 1).to_bytes(1, "big")
+        )
+
+    def contains(self, key: bytes) -> bool:
+        if key in self._set:
+            self.hits += 1
+            return True
+        self.misses += 1
+        return False
+
+    def add(self, key: bytes) -> None:
+        self._set[key] = None
+        while len(self._set) > self.max_entries:
+            self._set.popitem(last=False)
+
+    def __len__(self) -> int:
+        return len(self._set)

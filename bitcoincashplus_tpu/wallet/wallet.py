@@ -1,0 +1,163 @@
+"""Wallet — key store + owned-coin tracking + spend builder.
+
+Reference: src/wallet/wallet.cpp (CWallet::AddToWallet via the
+BlockConnected signal, CWallet::CreateTransaction, AvailableCoins,
+coin selection). Simplified: keypool is generate-on-demand, coin
+selection is largest-first (the reference's knapsack is a policy
+optimization, not consensus), storage is the node's kvstore.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..consensus.params import ChainParams
+from ..consensus.tx import COutPoint, CTransaction, CTxIn, CTxOut
+from ..script.script import classify_script, get_script_ops
+from ..script.sighash import SIGHASH_ALL
+from .keys import CKey, address_to_script
+from .signing import sign_transaction
+
+
+class WalletCoin:
+    __slots__ = ("outpoint", "txout", "height", "is_coinbase")
+
+    def __init__(self, outpoint: COutPoint, txout: CTxOut, height: int,
+                 is_coinbase: bool):
+        self.outpoint = outpoint
+        self.txout = txout
+        self.height = height
+        self.is_coinbase = is_coinbase
+
+
+class Wallet:
+    """In-memory wallet; persistence via export_keys/import_keys (WIF)."""
+
+    def __init__(self, params: ChainParams):
+        self.params = params
+        self.keys_by_pkh: dict[bytes, CKey] = {}
+        self.keys_by_pubkey: dict[bytes, CKey] = {}
+        self.coins: dict[COutPoint, WalletCoin] = {}
+        self.spent: set[COutPoint] = set()
+
+    # -- keys --
+
+    def add_key(self, key: CKey) -> None:
+        self.keys_by_pkh[key.pubkey_hash] = key
+        self.keys_by_pubkey[key.pubkey] = key
+
+    def get_new_address(self) -> str:
+        key = CKey.generate()
+        self.add_key(key)
+        return key.p2pkh_address(self.params)
+
+    def key_for_id(self, ident: bytes) -> Optional[CKey]:
+        """Solver callback: 20-byte pubkey hash or raw pubkey."""
+        if len(ident) == 20:
+            return self.keys_by_pkh.get(ident)
+        return self.keys_by_pubkey.get(ident)
+
+    def _is_mine(self, script_pubkey: bytes) -> bool:
+        """IsMine (src/script/ismine.cpp) for the templates we hold keys to."""
+        kind = classify_script(script_pubkey)
+        try:
+            if kind == "pubkeyhash":
+                ops = list(get_script_ops(script_pubkey))
+                return ops[2][1] in self.keys_by_pkh
+            if kind == "pubkey":
+                ops = list(get_script_ops(script_pubkey))
+                return ops[0][1] in self.keys_by_pubkey
+        except Exception:
+            return False
+        return False
+
+    # -- chain notifications (validationinterface analogues) --
+
+    def block_connected(self, block, idx) -> None:
+        for tx in block.vtx:
+            self.add_tx_if_mine(tx, idx.height, tx.is_coinbase())
+
+    def block_disconnected(self, block, idx) -> None:
+        for tx in block.vtx:
+            txid = tx.txid
+            for i in range(len(tx.vout)):
+                self.coins.pop(COutPoint(txid, i), None)
+            for txin in tx.vin:
+                self.spent.discard(txin.prevout)
+
+    def add_tx_if_mine(self, tx: CTransaction, height: int,
+                       is_coinbase: bool) -> None:
+        for txin in tx.vin:
+            if txin.prevout in self.coins:
+                self.spent.add(txin.prevout)
+        txid = tx.txid
+        for i, out in enumerate(tx.vout):
+            if self._is_mine(out.script_pubkey):
+                op = COutPoint(txid, i)
+                self.coins[op] = WalletCoin(op, out, height, is_coinbase)
+
+    # -- balance / spend --
+
+    def available_coins(self, tip_height: int) -> list[WalletCoin]:
+        """AvailableCoins: unspent, mature."""
+        maturity = self.params.consensus.coinbase_maturity
+        out = []
+        for op, coin in self.coins.items():
+            if op in self.spent:
+                continue
+            if coin.is_coinbase and tip_height - coin.height + 1 < maturity:
+                continue
+            out.append(coin)
+        return out
+
+    def balance(self, tip_height: int) -> int:
+        return sum(c.txout.value for c in self.available_coins(tip_height))
+
+    def create_transaction(
+        self,
+        address: str,
+        amount: int,
+        tip_height: int,
+        fee: int = 1000,
+        enable_forkid: bool = False,
+    ) -> CTransaction:
+        """CWallet::CreateTransaction: select coins (largest-first), build,
+        sign, with change back to a fresh key."""
+        script_pubkey = address_to_script(address, self.params)
+        if script_pubkey is None:
+            raise ValueError(f"bad address {address}")
+        coins = sorted(
+            self.available_coins(tip_height),
+            key=lambda c: c.txout.value, reverse=True,
+        )
+        selected, total = [], 0
+        for coin in coins:
+            selected.append(coin)
+            total += coin.txout.value
+            if total >= amount + fee:
+                break
+        if total < amount + fee:
+            raise ValueError(f"insufficient funds: {total} < {amount + fee}")
+
+        vout = [CTxOut(amount, script_pubkey)]
+        change = total - amount - fee
+        if change > 546:  # dust threshold (policy)
+            change_key = CKey.generate()
+            self.add_key(change_key)
+            vout.append(CTxOut(change, change_key.p2pkh_script()))
+
+        unsigned = CTransaction(
+            vin=tuple(CTxIn(c.outpoint) for c in selected),
+            vout=tuple(vout),
+        )
+        signed = sign_transaction(
+            unsigned,
+            [(c.txout.script_pubkey, c.txout.value) for c in selected],
+            self.key_for_id,
+            SIGHASH_ALL,
+            enable_forkid=enable_forkid,
+        )
+        for c in selected:
+            self.spent.add(c.outpoint)
+        self.add_tx_if_mine(signed, -1, False)
+        return signed

@@ -21,6 +21,17 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import tempfile  # noqa: E402
+
 import jax  # noqa: E402  (env must be set first)
 
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
+
+# Persistent compilation cache: the ECDSA batch kernel costs ~90s of XLA
+# compile on the CPU backend; caching it keeps the default suite fast
+# after the first run while still exercising the real kernel every run.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(tempfile.gettempdir(), "bcp-jax-test-cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)

@@ -1,0 +1,540 @@
+"""Script layer tests.
+
+Mirrors the reference's script_tests.cpp / sighash_tests.cpp strategy
+(SURVEY.md §5.1) — but the reference's JSON vector files are unavailable
+offline, so vectors are generated from our own signer and cross-checked
+through two independent paths (SURVEY.md §8.5.3 mitigation): the
+interpreter with immediate CPU verification, and the deferred-batch
+checker settled by the CPU oracle.
+"""
+
+import hashlib
+
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from bitcoincashplus_tpu.consensus.tx import COutPoint, CTransaction, CTxIn, CTxOut
+from bitcoincashplus_tpu.crypto import secp256k1 as secp
+from bitcoincashplus_tpu.crypto.hashes import hash160, ripemd160, sha256, sha256d
+from bitcoincashplus_tpu.script import script as S
+from bitcoincashplus_tpu.script.interpreter import (
+    SCRIPT_ENABLE_SIGHASH_FORKID,
+    SCRIPT_VERIFY_CLEANSTACK,
+    SCRIPT_VERIFY_DERSIG,
+    SCRIPT_VERIFY_LOW_S,
+    SCRIPT_VERIFY_MINIMALDATA,
+    SCRIPT_VERIFY_NULLDUMMY,
+    SCRIPT_VERIFY_NULLFAIL,
+    SCRIPT_VERIFY_P2SH,
+    SCRIPT_VERIFY_STRICTENC,
+    DeferringSignatureChecker,
+    EvalScript,
+    BaseSignatureChecker,
+    ScriptError,
+    TransactionSignatureChecker,
+    VerifyScript,
+    cast_to_bool,
+    is_valid_signature_encoding,
+)
+from bitcoincashplus_tpu.script.script import CScriptNum, ScriptNumError
+from bitcoincashplus_tpu.script.sighash import (
+    SIGHASH_ALL,
+    SIGHASH_ANYONECANPAY,
+    SIGHASH_NONE,
+    SIGHASH_SINGLE,
+    signature_hash_legacy,
+)
+from bitcoincashplus_tpu.wallet.keys import CKey
+from bitcoincashplus_tpu.wallet.signing import sign_transaction
+
+FLAGS = (
+    SCRIPT_VERIFY_P2SH | SCRIPT_VERIFY_STRICTENC | SCRIPT_VERIFY_DERSIG
+    | SCRIPT_VERIFY_LOW_S | SCRIPT_VERIFY_NULLDUMMY | SCRIPT_VERIFY_NULLFAIL
+)
+FLAGS_FORKID = FLAGS | SCRIPT_ENABLE_SIGHASH_FORKID
+
+
+# ---- CScriptNum ----
+
+@given(st.integers(min_value=-(2**31) + 1, max_value=2**31 - 1))
+def test_scriptnum_roundtrip(n):
+    enc = CScriptNum.encode(n)
+    assert CScriptNum.decode(enc, require_minimal=True) == n
+
+
+def test_scriptnum_minimality():
+    # 0x0100 is 1 with a trailing zero byte: non-minimal
+    with pytest.raises(ScriptNumError):
+        CScriptNum.decode(b"\x01\x00", require_minimal=True)
+    assert CScriptNum.decode(b"\x01\x00") == 1
+    # negative zero
+    with pytest.raises(ScriptNumError):
+        CScriptNum.decode(b"\x80", require_minimal=True)
+    assert CScriptNum.decode(b"\x80") == 0
+    with pytest.raises(ScriptNumError):
+        CScriptNum.decode(b"\x01\x02\x03\x04\x05")  # > 4 bytes
+
+
+def test_scriptnum_negative_encoding():
+    assert CScriptNum.encode(-1) == b"\x81"
+    assert CScriptNum.encode(-127) == b"\xff"
+    assert CScriptNum.encode(-128) == b"\x80\x80"
+    assert CScriptNum.encode(255) == b"\xff\x00"
+    assert CScriptNum.decode(b"\x80\x80") == -128
+
+
+# ---- push / parse ----
+
+@given(st.binary(max_size=600))
+def test_pushdata_roundtrip(data):
+    script = S.push_data_raw(data)
+    ops = list(S.get_script_ops(script))
+    assert len(ops) == 1
+    assert ops[0][1] == data
+
+
+def test_truncated_push_raises():
+    with pytest.raises(S.ScriptParseError):
+        list(S.get_script_ops(bytes([10, 1, 2])))  # claims 10, has 2
+    with pytest.raises(S.ScriptParseError):
+        list(S.get_script_ops(bytes([S.OP_PUSHDATA1])))
+
+
+def test_classify_templates():
+    key = CKey(12345)
+    assert S.classify_script(S.p2pkh_script(key.pubkey_hash)) == "pubkeyhash"
+    assert S.classify_script(S.p2pk_script(key.pubkey)) == "pubkey"
+    redeem = S.multisig_script(1, [key.pubkey])
+    assert S.classify_script(redeem) == "multisig"
+    assert S.classify_script(S.p2sh_script_for_redeem(redeem)) == "scripthash"
+    assert S.classify_script(S.null_data_script(b"hello")) == "nulldata"
+    assert S.classify_script(b"\x51") == "nonstandard"
+
+
+def test_sigop_counting():
+    key = CKey(7)
+    assert S.count_sigops(S.p2pkh_script(key.pubkey_hash)) == 1
+    ms = S.multisig_script(2, [key.pubkey] * 3)
+    assert S.count_sigops(ms) == 20  # inaccurate mode
+    assert S.count_sigops(ms, accurate=True) == 3
+    spk = S.p2sh_script_for_redeem(ms)
+    script_sig = b"\x00" + S.push_data_raw(ms)
+    assert S.count_p2sh_sigops(spk, script_sig) == 3
+
+
+# ---- EvalScript basics ----
+
+def run_script(script: bytes, flags: int = 0, stack=None):
+    stack = stack if stack is not None else []
+    EvalScript(stack, script, flags, BaseSignatureChecker())
+    return stack
+
+
+def test_arithmetic_ops():
+    # 2 3 ADD 5 EQUAL
+    out = run_script(bytes([S.OP_2, S.OP_3, S.OP_ADD, S.OP_5, S.OP_EQUAL]))
+    assert cast_to_bool(out[-1])
+    out = run_script(bytes([S.OP_10, S.OP_3, S.OP_SUB]))
+    assert CScriptNum.decode(out[-1]) == 7
+    out = run_script(bytes([S.OP_1NEGATE, S.OP_ABS]))
+    assert CScriptNum.decode(out[-1]) == 1
+    out = run_script(bytes([S.OP_5, S.OP_3, S.OP_MIN, S.OP_2, S.OP_MAX]))
+    assert CScriptNum.decode(out[-1]) == 3
+    out = run_script(bytes([S.OP_3, S.OP_2, S.OP_5, S.OP_WITHIN]))
+    assert cast_to_bool(out[-1])
+
+
+def test_stack_ops():
+    out = run_script(bytes([S.OP_1, S.OP_2, S.OP_SWAP]))
+    assert [CScriptNum.decode(x) for x in out] == [2, 1]
+    out = run_script(bytes([S.OP_1, S.OP_2, S.OP_3, S.OP_ROT]))
+    assert [CScriptNum.decode(x) for x in out] == [2, 3, 1]
+    out = run_script(bytes([S.OP_1, S.OP_2, S.OP_TUCK]))
+    assert [CScriptNum.decode(x) for x in out] == [2, 1, 2]
+    out = run_script(bytes([S.OP_1, S.OP_2, S.OP_2DUP, S.OP_DEPTH]))
+    assert CScriptNum.decode(out[-1]) == 4
+    out = run_script(bytes([S.OP_1, S.OP_2, S.OP_3, S.OP_2, S.OP_PICK]))
+    assert CScriptNum.decode(out[-1]) == 1
+
+
+def test_if_else():
+    # IF 2 ELSE 3 ENDIF on true
+    body = bytes([S.OP_IF, S.OP_2, S.OP_ELSE, S.OP_3, S.OP_ENDIF])
+    out = run_script(bytes([S.OP_1]) + body)
+    assert CScriptNum.decode(out[-1]) == 2
+    out = run_script(bytes([S.OP_0]) + body)
+    assert CScriptNum.decode(out[-1]) == 3
+    with pytest.raises(ScriptError, match="unbalanced"):
+        run_script(bytes([S.OP_1, S.OP_IF]))
+    with pytest.raises(ScriptError, match="unbalanced"):
+        run_script(bytes([S.OP_ENDIF]))
+    # unexecuted branch may hold unknown opcodes but not disabled ones
+    run_script(bytes([S.OP_0, S.OP_IF, 0xBA, S.OP_ENDIF]))
+    with pytest.raises(ScriptError, match="disabled"):
+        run_script(bytes([S.OP_0, S.OP_IF, S.OP_CAT, S.OP_ENDIF]))
+
+
+def test_hash_ops():
+    data = b"graft"
+    out = run_script(S.push_data(data) + bytes([S.OP_SHA256]))
+    assert out[-1] == sha256(data)
+    out = run_script(S.push_data(data) + bytes([S.OP_HASH160]))
+    assert out[-1] == hash160(data)
+    out = run_script(S.push_data(data) + bytes([S.OP_HASH256]))
+    assert out[-1] == sha256d(data)
+    out = run_script(S.push_data(data) + bytes([S.OP_RIPEMD160]))
+    assert out[-1] == ripemd160(data)
+    out = run_script(S.push_data(data) + bytes([S.OP_SHA1]))
+    assert out[-1] == hashlib.sha1(data).digest()
+
+
+def test_op_return_and_verify():
+    with pytest.raises(ScriptError, match="op-return"):
+        run_script(bytes([S.OP_RETURN]))
+    with pytest.raises(ScriptError, match="verify"):
+        run_script(bytes([S.OP_0, S.OP_VERIFY]))
+    run_script(bytes([S.OP_1, S.OP_VERIFY]))
+
+
+def test_minimaldata_flag():
+    # push of 1 via PUSHDATA1 is non-minimal
+    script = bytes([S.OP_PUSHDATA1, 1, 5])
+    run_script(script)  # fine without the flag
+    with pytest.raises(ScriptError, match="minimaldata"):
+        run_script(script, SCRIPT_VERIFY_MINIMALDATA)
+
+
+def test_op_count_limit():
+    ok = bytes([S.OP_1] + [S.OP_NOP] * 201)
+    run_script(ok)
+    with pytest.raises(ScriptError, match="op-count"):
+        run_script(bytes([S.OP_1] + [S.OP_NOP] * 202))
+
+
+def test_stack_size_limit():
+    run_script(bytes([S.OP_1] * 1000))  # exactly at the limit
+    with pytest.raises(ScriptError, match="stack-size"):
+        run_script(bytes([S.OP_1] * 1001))
+
+
+# ---- sighash ----
+
+def _dummy_tx(n_in=2, n_out=2):
+    vin = tuple(
+        CTxIn(COutPoint(bytes([i + 1]) * 32, i), b"", 0xFFFFFFFE)
+        for i in range(n_in)
+    )
+    vout = tuple(CTxOut(50000 * (i + 1), bytes([S.OP_1])) for i in range(n_out))
+    return CTransaction(vin=vin, vout=vout, locktime=0)
+
+
+def test_sighash_single_bug():
+    tx = _dummy_tx(n_in=3, n_out=1)
+    # input 2 with SIGHASH_SINGLE and no output 2 -> the "one" constant
+    h = signature_hash_legacy(b"\x51", tx, 2, SIGHASH_SINGLE)
+    assert h == (1).to_bytes(32, "little")
+
+
+def test_sighash_variants_differ():
+    tx = _dummy_tx()
+    code = bytes([S.OP_DUP])
+    hashes = {
+        signature_hash_legacy(code, tx, 0, t)
+        for t in (
+            SIGHASH_ALL, SIGHASH_NONE, SIGHASH_SINGLE,
+            SIGHASH_ALL | SIGHASH_ANYONECANPAY,
+        )
+    }
+    assert len(hashes) == 4  # all distinct
+
+
+def test_sighash_anyonecanpay_ignores_other_inputs():
+    tx1 = _dummy_tx(n_in=2)
+    # same tx but different OTHER input
+    vin = (tx1.vin[0], CTxIn(COutPoint(b"\xAA" * 32, 9), b"", 1))
+    tx2 = CTransaction(vin=vin, vout=tx1.vout, locktime=0)
+    t = SIGHASH_ALL | SIGHASH_ANYONECANPAY
+    assert signature_hash_legacy(b"\x51", tx1, 0, t) == signature_hash_legacy(
+        b"\x51", tx2, 0, t
+    )
+    assert signature_hash_legacy(b"\x51", tx1, 0, SIGHASH_ALL) != (
+        signature_hash_legacy(b"\x51", tx2, 0, SIGHASH_ALL)
+    )
+
+
+# ---- end-to-end P2PKH / P2PK / P2SH ----
+
+def _spend_fixture(key: CKey, script_pubkey: bytes, amount=50000):
+    """A 1-in-1-out tx spending `script_pubkey`."""
+    tx = CTransaction(
+        vin=(CTxIn(COutPoint(b"\x11" * 32, 0)),),
+        vout=(CTxOut(amount - 1000, bytes([S.OP_1])),),
+    )
+    return tx
+
+
+@pytest.mark.parametrize("forkid", [False, True])
+def test_p2pkh_spend_verifies(forkid):
+    key = CKey(0xC0FFEE)
+    spk = S.p2pkh_script(key.pubkey_hash)
+    amount = 50000
+    tx = _spend_fixture(key, spk, amount)
+    signed = sign_transaction(
+        tx, [(spk, amount)], lambda i: key if i == key.pubkey_hash else None,
+        enable_forkid=forkid,
+    )
+    flags = FLAGS_FORKID if forkid else FLAGS
+    checker = TransactionSignatureChecker(signed, 0, amount)
+    VerifyScript(signed.vin[0].script_sig, spk, flags, checker)
+
+
+def test_p2pkh_wrong_key_fails():
+    key, wrong = CKey(0xC0FFEE), CKey(0xBADBAD)
+    spk = S.p2pkh_script(key.pubkey_hash)
+    tx = _spend_fixture(key, spk)
+    signed = sign_transaction(
+        tx, [(spk, 50000)], lambda i: wrong,  # signs with the wrong key
+    )
+    checker = TransactionSignatureChecker(signed, 0, 50000)
+    with pytest.raises(ScriptError):
+        VerifyScript(signed.vin[0].script_sig, spk, FLAGS, checker)
+
+
+def test_p2pkh_tampered_output_fails():
+    key = CKey(0xC0FFEE)
+    spk = S.p2pkh_script(key.pubkey_hash)
+    tx = _spend_fixture(key, spk)
+    signed = sign_transaction(tx, [(spk, 50000)], lambda i: key)
+    # attacker redirects the output after signing
+    tampered = CTransaction(
+        signed.version, signed.vin,
+        (CTxOut(49000, bytes([S.OP_2])),), signed.locktime,
+    )
+    checker = TransactionSignatureChecker(tampered, 0, 50000)
+    with pytest.raises(ScriptError, match="nullfail|eval-false"):
+        VerifyScript(tampered.vin[0].script_sig, spk, FLAGS, checker)
+
+
+def test_forkid_amount_commitment():
+    """FORKID digests commit to the spent amount; legacy does not."""
+    key = CKey(0xABCDEF)
+    spk = S.p2pkh_script(key.pubkey_hash)
+    tx = _spend_fixture(key, spk)
+    signed = sign_transaction(
+        tx, [(spk, 50000)], lambda i: key, enable_forkid=True
+    )
+    # verifier believes a different amount -> must fail
+    checker = TransactionSignatureChecker(signed, 0, 99999)
+    with pytest.raises(ScriptError):
+        VerifyScript(signed.vin[0].script_sig, spk, FLAGS_FORKID, checker)
+    # legacy signature ignores amount
+    signed_legacy = sign_transaction(tx, [(spk, 50000)], lambda i: key)
+    checker = TransactionSignatureChecker(signed_legacy, 0, 99999)
+    VerifyScript(signed_legacy.vin[0].script_sig, spk, FLAGS, checker)
+
+
+def test_p2pk_spend():
+    key = CKey(0x1234)
+    spk = S.p2pk_script(key.pubkey)
+    tx = _spend_fixture(key, spk)
+    signed = sign_transaction(
+        tx, [(spk, 50000)], lambda i: key if i == key.pubkey else None
+    )
+    checker = TransactionSignatureChecker(signed, 0, 50000)
+    VerifyScript(signed.vin[0].script_sig, spk, FLAGS, checker)
+
+
+def test_p2sh_multisig_2of3():
+    keys = [CKey(1000 + i) for i in range(3)]
+    redeem = S.multisig_script(2, [k.pubkey for k in keys])
+    spk = S.p2sh_script_for_redeem(redeem)
+    tx = _spend_fixture(keys[0], spk)
+
+    by_pub = {k.pubkey: k for k in keys[:2]}  # only 2 of 3 known
+    signed = sign_transaction(
+        tx, [(spk, 50000)], lambda i: by_pub.get(i),
+        redeem_scripts={hash160(redeem): redeem},
+    )
+    checker = TransactionSignatureChecker(signed, 0, 50000)
+    VerifyScript(
+        signed.vin[0].script_sig, spk,
+        FLAGS | SCRIPT_VERIFY_CLEANSTACK, checker,
+    )
+    # and 1 key is not enough
+    one = {keys[1].pubkey: keys[1]}
+    with pytest.raises(Exception):
+        sign_transaction(
+            tx, [(spk, 50000)], lambda i: one.get(i),
+            redeem_scripts={hash160(redeem): redeem},
+        )
+
+
+def test_multisig_sig_order_matters():
+    keys = [CKey(2000 + i) for i in range(3)]
+    redeem = S.multisig_script(2, [k.pubkey for k in keys])
+    spk = S.p2sh_script_for_redeem(redeem)
+    tx = _spend_fixture(keys[0], spk)
+    by_pub = {k.pubkey: k for k in keys}
+    signed = sign_transaction(
+        tx, [(spk, 50000)], lambda i: by_pub.get(i) if i != keys[1].pubkey else None,
+        redeem_scripts={hash160(redeem): redeem},
+    )  # signs with keys 0 and 2, in key order
+    checker = TransactionSignatureChecker(signed, 0, 50000)
+    VerifyScript(signed.vin[0].script_sig, spk, FLAGS, checker)
+
+    # swap the two sigs: order violates the in-key-order rule -> fail
+    ops = list(S.get_script_ops(signed.vin[0].script_sig))
+    sig_a, sig_b, redeem_push = ops[1][1], ops[2][1], ops[3][1]
+    swapped = (
+        b"\x00" + S.push_data_raw(sig_b) + S.push_data_raw(sig_a)
+        + S.push_data_raw(redeem_push)
+    )
+    with pytest.raises(ScriptError):
+        VerifyScript(swapped, spk, FLAGS, checker)
+
+
+def test_nulldummy():
+    keys = [CKey(3000)]
+    redeem = S.multisig_script(1, [k.pubkey for k in keys])
+    spk = S.p2sh_script_for_redeem(redeem)
+    tx = _spend_fixture(keys[0], spk)
+    signed = sign_transaction(
+        tx, [(spk, 50000)], lambda i: keys[0],
+        redeem_scripts={hash160(redeem): redeem},
+    )
+    # replace the OP_0 dummy with OP_1
+    sig_part = signed.vin[0].script_sig[1:]
+    bad = bytes([S.OP_1]) + sig_part
+    checker = TransactionSignatureChecker(signed, 0, 50000)
+    with pytest.raises(ScriptError, match="nulldummy"):
+        VerifyScript(bad, spk, FLAGS, checker)
+    VerifyScript(bad, spk, FLAGS & ~SCRIPT_VERIFY_NULLDUMMY, checker)
+
+
+# ---- deferred batch checker ----
+
+def test_deferring_checker_records_and_oracle_settles():
+    key = CKey(0x5EED)
+    spk = S.p2pkh_script(key.pubkey_hash)
+    tx = _spend_fixture(key, spk)
+    signed = sign_transaction(tx, [(spk, 50000)], lambda i: key)
+
+    records = []
+    checker = DeferringSignatureChecker(signed, 0, 50000, records)
+    VerifyScript(signed.vin[0].script_sig, spk, FLAGS, checker)
+    assert len(records) == 1
+    rec = records[0]
+    assert secp.ecdsa_verify(rec.pubkey, rec.r, rec.s, rec.msg_hash)
+    assert rec.txid == signed.txid and rec.in_idx == 0
+
+
+def test_deferring_checker_bad_sig_caught_by_batch():
+    """The deferral contract: interpreter says OK, batch says no."""
+    key = CKey(0x5EED)
+    spk = S.p2pkh_script(key.pubkey_hash)
+    tx = _spend_fixture(key, spk)
+    signed = sign_transaction(tx, [(spk, 50000)], lambda i: key)
+    # flip a bit mid-signature (keeps DER valid: flip inside s value)
+    ss = bytearray(signed.vin[0].script_sig)
+    ss[40] ^= 0x01
+    tampered_sig = bytes(ss)
+
+    records = []
+    checker = DeferringSignatureChecker(signed, 0, 50000, records)
+    try:
+        VerifyScript(tampered_sig, spk, FLAGS, checker)
+    except ScriptError:
+        return  # DER/low-s encoding may reject outright: also correct
+    assert len(records) == 1
+    rec = records[0]
+    assert not secp.ecdsa_verify(rec.pubkey, rec.r, rec.s, rec.msg_hash)
+
+
+def test_deferring_requires_nullfail():
+    key = CKey(0x5EED)
+    spk = S.p2pkh_script(key.pubkey_hash)
+    signed = sign_transaction(
+        _spend_fixture(key, spk), [(spk, 50000)], lambda i: key
+    )
+    checker = DeferringSignatureChecker(signed, 0, 50000, [])
+    with pytest.raises(AssertionError):
+        VerifyScript(
+            signed.vin[0].script_sig, spk,
+            FLAGS & ~SCRIPT_VERIFY_NULLFAIL, checker,
+        )
+
+
+# ---- signature encoding ----
+
+def test_der_encoding_checks():
+    key = CKey(42)
+    sig = key.sign(b"\x01" * 32) + bytes([SIGHASH_ALL])
+    assert is_valid_signature_encoding(sig)
+    assert not is_valid_signature_encoding(sig[:-2])  # truncated
+    assert not is_valid_signature_encoding(b"")
+    # high-S rejected under LOW_S
+    r, s = secp.sig_der_decode(sig[:-1])
+    high_s = secp.sig_der_encode(r, secp.N - s) + bytes([SIGHASH_ALL])
+    spk = S.p2pk_script(key.pubkey)
+    stack = [high_s]
+    checker = BaseSignatureChecker()
+    with pytest.raises(ScriptError, match="high-s"):
+        EvalScript(stack, spk, FLAGS | SCRIPT_VERIFY_LOW_S, checker)
+
+
+def test_forkid_flag_gating():
+    """STRICTENC: FORKID bit required iff the fork flag is on."""
+    key = CKey(0xF0F0)
+    spk = S.p2pkh_script(key.pubkey_hash)
+    tx = _spend_fixture(key, spk)
+    signed_fork = sign_transaction(
+        tx, [(spk, 50000)], lambda i: key, enable_forkid=True
+    )
+    checker = TransactionSignatureChecker(signed_fork, 0, 50000)
+    with pytest.raises(ScriptError, match="illegal-forkid"):
+        VerifyScript(signed_fork.vin[0].script_sig, spk, FLAGS, checker)
+    signed_legacy = sign_transaction(tx, [(spk, 50000)], lambda i: key)
+    checker = TransactionSignatureChecker(signed_legacy, 0, 50000)
+    with pytest.raises(ScriptError, match="must-use-forkid"):
+        VerifyScript(
+            signed_legacy.vin[0].script_sig, spk, FLAGS_FORKID, checker
+        )
+
+
+# ---- randomized differential: immediate vs deferred+oracle ----
+
+@settings(max_examples=20, deadline=None)
+@given(st.integers(min_value=1, max_value=secp.N - 1), st.booleans())
+def test_immediate_vs_deferred_equivalence(secret, forkid):
+    key = CKey(secret)
+    spk = S.p2pkh_script(key.pubkey_hash)
+    tx = _spend_fixture(key, spk)
+    signed = sign_transaction(
+        tx, [(spk, 50000)], lambda i: key, enable_forkid=forkid
+    )
+    flags = FLAGS_FORKID if forkid else FLAGS
+
+    ok_immediate = True
+    try:
+        VerifyScript(
+            signed.vin[0].script_sig, spk, flags,
+            TransactionSignatureChecker(signed, 0, 50000),
+        )
+    except ScriptError:
+        ok_immediate = False
+
+    records = []
+    ok_deferred = True
+    try:
+        VerifyScript(
+            signed.vin[0].script_sig, spk, flags,
+            DeferringSignatureChecker(signed, 0, 50000, records),
+        )
+    except ScriptError:
+        ok_deferred = False
+    if ok_deferred:
+        ok_deferred = all(
+            secp.ecdsa_verify(r.pubkey, r.r, r.s, r.msg_hash) for r in records
+        )
+    assert ok_immediate == ok_deferred == True  # noqa: E712

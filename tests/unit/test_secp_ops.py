@@ -111,6 +111,7 @@ def _scalar_mult_device(ks, pts):
     return out
 
 
+@pytest.mark.slow
 class TestPointOps:
     def test_scalar_mult_matches_oracle(self):
         ks = [1, 2, 3, 0, oracle.N - 1, rng.randrange(oracle.N), rng.randrange(oracle.N)]
@@ -138,7 +139,7 @@ class TestPointOps:
 
 
 def _make_sig_batch(n_valid, n_invalid):
-    """Returns (u1b, u2b, qx, qy, qinf, r0, rn, expected)."""
+    """Returns (u1b, u2b, qx, qy, qinf, r0, rn, wrap_ok, expected)."""
     entries = []
     for i in range(n_valid + n_invalid):
         d = rng.randrange(1, oracle.N)
@@ -168,29 +169,33 @@ def _make_sig_batch(n_valid, n_invalid):
             u1b[i, j] = (u1 >> (255 - i)) & 1
             u2b[i, j] = (u2 >> (255 - i)) & 1
         r0v.append(r)
-        rnv.append(r + oracle.N if r + oracle.N < oracle.P else r)
+        rnv.append(r + oracle.N)  # kernel's wrap_ok mask gates admissibility
         qxv.append(pub[0])
         qyv.append(pub[1])
         expected.append(valid)
     qinf = jnp.zeros((B,), bool)
+    wrap_ok = jnp.asarray(
+        np.array([r + oracle.N < oracle.P for r in r0v])
+    )
     return (
         jnp.asarray(u1b), jnp.asarray(u2b), limbs(qxv), limbs(qyv), qinf,
-        limbs(r0v), limbs(rnv), expected,
+        limbs(r0v), limbs(rnv), wrap_ok, expected,
     )
 
 
+@pytest.mark.slow
 class TestVerifyBatch:
     def test_valid_and_invalid_lanes(self):
-        u1b, u2b, qx, qy, qinf, r0, rn, expected = _make_sig_batch(5, 4)
+        u1b, u2b, qx, qy, qinf, r0, rn, wrap, expected = _make_sig_batch(5, 4)
         got = np.asarray(
-            S.ecdsa_verify_batch_jit(u1b, u2b, qx, qy, qinf, r0, rn)
+            S.ecdsa_verify_batch_jit(u1b, u2b, qx, qy, qinf, r0, rn, wrap)
         )
         assert got.tolist() == expected
 
     def test_poisoned_lane_reports_false(self):
-        u1b, u2b, qx, qy, _, r0, rn, expected = _make_sig_batch(2, 0)
+        u1b, u2b, qx, qy, _, r0, rn, wrap, expected = _make_sig_batch(2, 0)
         qinf = jnp.asarray(np.array([False, True]))
         got = np.asarray(
-            S.ecdsa_verify_batch_jit(u1b, u2b, qx, qy, qinf, r0, rn)
+            S.ecdsa_verify_batch_jit(u1b, u2b, qx, qy, qinf, r0, rn, wrap)
         )
         assert got.tolist() == [True, False]

@@ -104,7 +104,12 @@ class TestMiningSlice:
         _mine_on(chainstate, 2)
         block = chainstate.get_block(chainstate.tip().hash)
         sig = block.vtx[0].vin[0].script_sig
-        assert sig[0] == 1 and sig[1] == 2  # push of height 2
+        # CScript() << 2 emits the OP_2 single-byte opcode (reference
+        # CScriptNum push semantics; ADVICE r1 low finding)
+        assert sig[0] == 0x52
+        _mine_on(chainstate, 15)
+        block = chainstate.get_block(chainstate.tip().hash)
+        assert block.vtx[0].vin[0].script_sig[:2] == bytes([1, 17])  # 17 > OP_16
 
 
 class TestRejection:
