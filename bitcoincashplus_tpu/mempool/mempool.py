@@ -1,0 +1,345 @@
+"""CTxMemPool — the fee-ordered pool with ancestor/descendant tracking.
+
+Reference: src/txmempool.{h,cpp}. The multi_index container becomes plain
+dicts; the consensus-relevant invariants are preserved exactly:
+
+* mapNextTx: every in-pool outpoint spend is unique (no conflicts enter).
+* CTxMemPoolEntry caches {count, size, fees} aggregates over BOTH the
+  ancestor and descendant sets, updated incrementally on add/remove —
+  these drive ancestor-feerate mining scores and descendant-score
+  eviction, the same quantities addPackageTxs / TrimToSize use.
+* remove_for_block prunes confirmed txs and (recursively) conflicts.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Iterable, Optional
+
+from ..consensus.tx import COutPoint, CTransaction
+
+
+class MempoolError(Exception):
+    """Reject reason carrier (the reference's CValidationState)."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        self.reason = reason
+        super().__init__(f"{reason}{': ' + detail if detail else ''}")
+
+
+class MempoolEntry:
+    """CTxMemPoolEntry (src/txmempool.h:~60)."""
+
+    __slots__ = (
+        "tx", "fee", "time", "entry_height", "size", "sigops",
+        "spends_coinbase",
+        # cached aggregates (IncludeSelf): reference's nCountWithAncestors…
+        "count_with_ancestors", "size_with_ancestors", "fees_with_ancestors",
+        "count_with_descendants", "size_with_descendants",
+        "fees_with_descendants",
+    )
+
+    def __init__(self, tx: CTransaction, fee: int, entry_time: int,
+                 entry_height: int, sigops: int = 0,
+                 spends_coinbase: bool = False):
+        self.tx = tx
+        self.fee = fee
+        self.time = entry_time
+        self.entry_height = entry_height
+        self.size = tx.size()
+        self.sigops = sigops
+        self.spends_coinbase = spends_coinbase
+        self.count_with_ancestors = 1
+        self.size_with_ancestors = self.size
+        self.fees_with_ancestors = fee
+        self.count_with_descendants = 1
+        self.size_with_descendants = self.size
+        self.fees_with_descendants = fee
+
+    @property
+    def txid(self) -> bytes:
+        return self.tx.txid
+
+    def fee_rate(self) -> float:
+        return self.fee / self.size
+
+    def ancestor_fee_rate(self) -> float:
+        """The addPackageTxs mining score: package feerate."""
+        return self.fees_with_ancestors / self.size_with_ancestors
+
+    def descendant_fee_rate(self) -> float:
+        """The TrimToSize eviction score."""
+        return self.fees_with_descendants / self.size_with_descendants
+
+
+# default policy limits (DEFAULT_ANCESTOR_LIMIT etc., src/validation.h)
+DEFAULT_ANCESTOR_LIMIT = 25
+DEFAULT_ANCESTOR_SIZE_LIMIT = 101_000  # bytes
+DEFAULT_DESCENDANT_LIMIT = 25
+DEFAULT_DESCENDANT_SIZE_LIMIT = 101_000
+DEFAULT_MEMPOOL_EXPIRY = 336 * 60 * 60  # 2 weeks, seconds
+DEFAULT_MAX_MEMPOOL_SIZE = 300 * 1_000_000  # -maxmempool (bytes, approx)
+
+
+class CTxMemPool:
+    def __init__(self, max_size_bytes: int = DEFAULT_MAX_MEMPOOL_SIZE,
+                 expiry_seconds: int = DEFAULT_MEMPOOL_EXPIRY):
+        self.entries: dict[bytes, MempoolEntry] = {}
+        self.map_next_tx: dict[COutPoint, bytes] = {}  # outpoint -> spender
+        self.max_size_bytes = max_size_bytes
+        self.expiry_seconds = expiry_seconds
+        self.total_size = 0
+        self.total_fee = 0
+        # bumped on every mutation; getblocktemplate longpoll + caching key
+        self.sequence = 0
+
+    # ------------------------------------------------------------------
+    # queries
+    # ------------------------------------------------------------------
+
+    def __contains__(self, txid: bytes) -> bool:
+        return txid in self.entries
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    def get(self, txid: bytes) -> Optional[MempoolEntry]:
+        return self.entries.get(txid)
+
+    def get_tx(self, txid: bytes) -> Optional[CTransaction]:
+        e = self.entries.get(txid)
+        return e.tx if e else None
+
+    def get_spender(self, outpoint: COutPoint) -> Optional[bytes]:
+        return self.map_next_tx.get(outpoint)
+
+    def get_output(self, outpoint: COutPoint):
+        """CCoinsViewMemPool leg: an in-pool tx's output, or None."""
+        e = self.entries.get(outpoint.hash)
+        if e is not None and outpoint.n < len(e.tx.vout):
+            return e.tx.vout[outpoint.n]
+        return None
+
+    def parents_in_pool(self, tx: CTransaction) -> set[bytes]:
+        return {
+            txin.prevout.hash
+            for txin in tx.vin
+            if txin.prevout.hash in self.entries
+        }
+
+    def calculate_ancestors(self, tx: CTransaction) -> set[bytes]:
+        """CalculateMemPoolAncestors: transitive in-pool ancestor txids."""
+        out: set[bytes] = set()
+        stack = list(self.parents_in_pool(tx))
+        while stack:
+            txid = stack.pop()
+            if txid in out:
+                continue
+            out.add(txid)
+            stack.extend(self.parents_in_pool(self.entries[txid].tx))
+        return out
+
+    def calculate_descendants(self, txid: bytes) -> set[bytes]:
+        """CalculateDescendants: txid + everything depending on it."""
+        out: set[bytes] = set()
+        stack = [txid]
+        while stack:
+            cur = stack.pop()
+            if cur in out or cur not in self.entries:
+                continue
+            out.add(cur)
+            e = self.entries[cur]
+            for i in range(len(e.tx.vout)):
+                spender = self.map_next_tx.get(COutPoint(cur, i))
+                if spender is not None:
+                    stack.append(spender)
+        return out
+
+    def check_ancestor_limits(
+        self, tx: CTransaction, fee: int,
+        limit_count: int = DEFAULT_ANCESTOR_LIMIT,
+        limit_size: int = DEFAULT_ANCESTOR_SIZE_LIMIT,
+        limit_desc: int = DEFAULT_DESCENDANT_LIMIT,
+        limit_desc_size: int = DEFAULT_DESCENDANT_SIZE_LIMIT,
+    ) -> set[bytes]:
+        """CalculateMemPoolAncestors' limit-enforcing form; returns the
+        ancestor set or raises MempoolError (too-long-mempool-chain)."""
+        ancestors = self.calculate_ancestors(tx)
+        size = tx.size() + sum(self.entries[a].size for a in ancestors)
+        if len(ancestors) + 1 > limit_count:
+            raise MempoolError("too-long-mempool-chain", "ancestor count")
+        if size > limit_size:
+            raise MempoolError("too-long-mempool-chain", "ancestor size")
+        for a in ancestors:
+            e = self.entries[a]
+            if e.count_with_descendants + 1 > limit_desc:
+                raise MempoolError("too-long-mempool-chain", "descendant count")
+            if e.size_with_descendants + tx.size() > limit_desc_size:
+                raise MempoolError("too-long-mempool-chain", "descendant size")
+        return ancestors
+
+    # ------------------------------------------------------------------
+    # mutation
+    # ------------------------------------------------------------------
+
+    def add_unchecked(self, entry: MempoolEntry,
+                      ancestors: Optional[set[bytes]] = None) -> None:
+        """addUnchecked (txmempool.cpp:~350): caller has validated."""
+        txid = entry.txid
+        assert txid not in self.entries
+        if ancestors is None:
+            ancestors = self.calculate_ancestors(entry.tx)
+        self.entries[txid] = entry
+        for txin in entry.tx.vin:
+            assert txin.prevout not in self.map_next_tx, "conflicting spend"
+            self.map_next_tx[txin.prevout] = txid
+        # update aggregates: self's ancestor cache, ancestors' descendant
+        # caches (UpdateAncestorsOf / UpdateEntryForAncestors)
+        for a in ancestors:
+            ae = self.entries[a]
+            ae.count_with_descendants += 1
+            ae.size_with_descendants += entry.size
+            ae.fees_with_descendants += entry.fee
+            entry.count_with_ancestors += 1
+            entry.size_with_ancestors += ae.size
+            entry.fees_with_ancestors += ae.fee
+        self.total_size += entry.size
+        self.total_fee += entry.fee
+        self.sequence += 1
+
+    def _remove_one(self, txid: bytes) -> MempoolEntry:
+        entry = self.entries.pop(txid)
+        for txin in entry.tx.vin:
+            self.map_next_tx.pop(txin.prevout, None)
+        # fix aggregates on remaining relatives
+        for a in self.calculate_ancestors(entry.tx):
+            ae = self.entries[a]
+            ae.count_with_descendants -= 1
+            ae.size_with_descendants -= entry.size
+            ae.fees_with_descendants -= entry.fee
+        for d in self.calculate_descendants_of_outputs(entry.tx):
+            de = self.entries[d]
+            de.count_with_ancestors -= 1
+            de.size_with_ancestors -= entry.size
+            de.fees_with_ancestors -= entry.fee
+        self.total_size -= entry.size
+        self.total_fee -= entry.fee
+        self.sequence += 1
+        return entry
+
+    def calculate_descendants_of_outputs(self, tx: CTransaction) -> set[bytes]:
+        out: set[bytes] = set()
+        for i in range(len(tx.vout)):
+            spender = self.map_next_tx.get(COutPoint(tx.txid, i))
+            if spender is not None:
+                out |= self.calculate_descendants(spender)
+        return out
+
+    def remove_recursive(self, txid: bytes) -> list[bytes]:
+        """removeRecursive: tx + all descendants. Returns removed txids."""
+        removed = []
+        for victim in sorted(
+            self.calculate_descendants(txid),
+            key=lambda t: -self.entries[t].count_with_ancestors,
+        ):
+            if victim in self.entries:
+                self._remove_one(victim)
+                removed.append(victim)
+        return removed
+
+    def remove_for_block(self, block_txs: Iterable[CTransaction]) -> None:
+        """removeForBlock: drop confirmed txs, then conflicts (anything
+        spending an outpoint a block tx just spent)."""
+        for tx in block_txs:
+            if tx.is_coinbase():
+                continue
+            if tx.txid in self.entries:
+                # confirmed: remove JUST this tx (descendants re-anchor)
+                self._remove_one(tx.txid)
+            for txin in tx.vin:
+                conflict = self.map_next_tx.get(txin.prevout)
+                if conflict is not None and conflict != tx.txid:
+                    self.remove_recursive(conflict)
+
+    def expire(self, now: Optional[int] = None) -> int:
+        """Expire (txmempool.cpp:~600): drop entries older than the expiry
+        window, with their descendants."""
+        now = now if now is not None else int(_time.time())
+        cutoff = now - self.expiry_seconds
+        stale = [t for t, e in self.entries.items() if e.time < cutoff]
+        n = 0
+        for txid in stale:
+            if txid in self.entries:
+                n += len(self.remove_recursive(txid))
+        return n
+
+    def trim_to_size(self, max_bytes: Optional[int] = None) -> list[bytes]:
+        """TrimToSize: evict lowest descendant-score packages until the
+        pool fits. Returns removed txids."""
+        max_bytes = max_bytes if max_bytes is not None else self.max_size_bytes
+        removed = []
+        while self.total_size > max_bytes and self.entries:
+            worst = min(
+                self.entries.values(), key=lambda e: e.descendant_fee_rate()
+            )
+            removed.extend(self.remove_recursive(worst.txid))
+        return removed
+
+    # ------------------------------------------------------------------
+    # mining interface (BlockAssembler.addPackageTxs parity)
+    # ------------------------------------------------------------------
+
+    def select_for_block(self, max_size: int, height: int,
+                         block_time: int) -> list[MempoolEntry]:
+        """Greedy ancestor-feerate package selection — addPackageTxs
+        (src/miner.cpp:~300): repeatedly take the entry with the best
+        ancestor-package feerate, emit its not-yet-selected ancestors
+        first (topological order), and account the whole package; skip
+        packages that would overflow the block.
+        """
+        selected: list[MempoolEntry] = []
+        in_block: set[bytes] = set()
+        used = 0
+        # effective (fees, size) of each entry's package minus what's
+        # already in the block — recomputed lazily like the reference's
+        # mapModifiedTx rescoring
+        skipped: set[bytes] = set()
+        while True:
+            best: Optional[MempoolEntry] = None
+            best_rate = -1.0
+            best_pkg: Optional[list[bytes]] = None
+            for e in self.entries.values():
+                if e.txid in in_block or e.txid in skipped:
+                    continue
+                anc = [
+                    a for a in self.calculate_ancestors(e.tx)
+                    if a not in in_block
+                ]
+                pkg_size = e.size + sum(self.entries[a].size for a in anc)
+                pkg_fees = e.fee + sum(self.entries[a].fee for a in anc)
+                rate = pkg_fees / pkg_size
+                if rate > best_rate:
+                    best, best_rate, best_pkg = e, rate, anc + [e.txid]
+            if best is None:
+                return selected
+            pkg_size = sum(self.entries[t].size for t in best_pkg)
+            if used + pkg_size > max_size:
+                skipped.add(best.txid)
+                continue
+            # topological emit: parents before children
+            order = sorted(
+                best_pkg, key=lambda t: self.entries[t].count_with_ancestors
+            )
+            for txid in order:
+                selected.append(self.entries[txid])
+                in_block.add(txid)
+            used += pkg_size
+
+    def info(self) -> dict:
+        """getmempoolinfo backend."""
+        return {
+            "size": len(self.entries),
+            "bytes": self.total_size,
+            "total_fee": self.total_fee,
+            "maxmempool": self.max_size_bytes,
+        }
