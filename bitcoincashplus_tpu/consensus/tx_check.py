@@ -28,6 +28,20 @@ class TxValidationError(ValueError):
         self.debug = debug
 
 
+LOCKTIME_THRESHOLD = 500_000_000  # script.h: below = height, above = unix time
+
+
+def is_final_tx(tx: CTransaction, block_height: int, block_time: int) -> bool:
+    """IsFinalTx (src/consensus/tx_verify.cpp:~17). ``block_time`` is the
+    median-time-past under BIP113 semantics (callers pass MTP)."""
+    if tx.locktime == 0:
+        return True
+    cutoff = block_height if tx.locktime < LOCKTIME_THRESHOLD else block_time
+    if tx.locktime < cutoff:
+        return True
+    return all(txin.sequence == 0xFFFFFFFF for txin in tx.vin)
+
+
 def check_transaction(tx: CTransaction) -> None:
     """CheckTransaction (src/consensus/tx_verify.cpp:~160): context-free
     sanity. Raises TxValidationError with the reference's reject reason."""

@@ -192,26 +192,73 @@ def sig_der_encode(r: int, s: int) -> bytes:
     return b"\x30" + bytes([len(body)]) + body
 
 
+def _lax_len(sig: bytes, pos: int) -> Optional[tuple[int, int]]:
+    """One BER length field at ``pos``: returns (length, new_pos) or None.
+    Multi-byte (0x80-flagged) lengths are decoded after skipping leading
+    zero bytes, exactly as ecdsa_signature_parse_der_lax does."""
+    if pos >= len(sig):
+        return None
+    lenbyte = sig[pos]
+    pos += 1
+    if not lenbyte & 0x80:
+        return lenbyte, pos
+    lenbyte &= 0x7F
+    if lenbyte > len(sig) - pos:
+        return None
+    while lenbyte > 0 and sig[pos] == 0:
+        pos += 1
+        lenbyte -= 1
+    if lenbyte >= 8:  # sizeof(size_t) guard in the reference
+        return None
+    out = 0
+    while lenbyte > 0:
+        out = (out << 8) + sig[pos]
+        pos += 1
+        lenbyte -= 1
+    return out, pos
+
+
 def sig_der_decode(sig: bytes) -> Optional[tuple[int, int]]:
     """Permissive BER-ish parse mirroring ecdsa_signature_parse_der_lax
-    (the consensus behavior pre-BIP66 strictness; strict DER enforcement is
-    a script-flag check done on the raw bytes, not here)."""
-    try:
-        if len(sig) < 2 or sig[0] != 0x30:
-            return None
-        pos = 2
-        if sig[1] & 0x80:
-            nlen = sig[1] & 0x7F
-            pos = 2 + nlen
-        if pos >= len(sig) or sig[pos] != 0x02:
-            return None
-        rlen = sig[pos + 1]
-        r = int.from_bytes(sig[pos + 2 : pos + 2 + rlen], "big")
-        pos += 2 + rlen
-        if pos >= len(sig) or sig[pos] != 0x02:
-            return None
-        slen = sig[pos + 1]
-        s = int.from_bytes(sig[pos + 2 : pos + 2 + slen], "big")
-        return (r, s)
-    except (IndexError, ValueError):
+    (src/pubkey.cpp — the consensus behavior pre-BIP66 strictness; strict
+    DER enforcement is a script-flag check done on the raw bytes, not here).
+
+    Parity-critical details: an R/S length that overclaims the remaining
+    input REJECTS (reference nodes fail the parse, so accepting it here
+    would be a chain-split vector); an integer wider than 32 bytes after
+    stripping leading zeros "overflows" and yields (0, 0) — a parse
+    success whose verify then fails, matching the reference exactly."""
+    if len(sig) < 2 or sig[0] != 0x30:
         return None
+    got = _lax_len(sig, 1)
+    if got is None:
+        return None
+    _seq_len, pos = got  # sequence length value is ignored (lax), bounds aren't
+
+    def int_at(pos: int) -> Optional[tuple[int, int]]:
+        if pos >= len(sig) or sig[pos] != 0x02:
+            return None
+        got = _lax_len(sig, pos + 1)
+        if got is None:
+            return None
+        vlen, vpos = got
+        if vlen > len(sig) - vpos:
+            return None  # length exceeds input: reject, don't truncate
+        start, end = vpos, vpos + vlen
+        while start < end and sig[start] == 0:
+            start += 1
+        if end - start > 32:
+            return -1, end  # overflow marker
+        return int.from_bytes(sig[start:end], "big"), end
+
+    got = int_at(pos)
+    if got is None:
+        return None
+    r, pos = got
+    got = int_at(pos)
+    if got is None:
+        return None
+    s, _pos = got
+    if r < 0 or s < 0:  # overflow: reference zeroes the whole signature
+        return (0, 0)
+    return (r, s)

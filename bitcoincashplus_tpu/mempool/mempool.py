@@ -17,6 +17,7 @@ import time as _time
 from typing import Iterable, Optional
 
 from ..consensus.tx import COutPoint, CTransaction
+from ..consensus.tx_check import is_final_tx
 
 
 class MempoolError(Exception):
@@ -304,6 +305,11 @@ class CTxMemPool:
         # already in the block — recomputed lazily like the reference's
         # mapModifiedTx rescoring
         skipped: set[bytes] = set()
+        # IsFinalTx gate (addPackageTxs → TestBlockValidity parity): a
+        # non-final tx poisons its whole descendant subtree for this block.
+        for txid, e in self.entries.items():
+            if txid not in skipped and not is_final_tx(e.tx, height, block_time):
+                skipped |= self.calculate_descendants(txid)
         while True:
             best: Optional[MempoolEntry] = None
             best_rate = -1.0

@@ -20,7 +20,6 @@ MAX_STANDARD_TX_SIZE = 100_000  # MAX_STANDARD_TX_SIZE (policy.h)
 MAX_STANDARD_SCRIPTSIG_SIZE = 1650
 MAX_P2SH_SIGOPS = 15
 MAX_OP_RETURN_RELAY = 83  # nMaxDatacarrierBytes
-DUST_THRESHOLD = 546  # satoshis (derived from minRelayTxFee in reference)
 DEFAULT_MIN_RELAY_FEE_RATE = 1000  # sat/kB (DEFAULT_MIN_RELAY_TX_FEE)
 
 
@@ -31,6 +30,16 @@ def get_min_relay_fee(tx_size: int,
     if fee == 0 and rate > 0:
         fee = rate
     return fee
+
+
+def get_dust_threshold(txout,
+                       rate: int = DEFAULT_MIN_RELAY_FEE_RATE) -> int:
+    """GetDustThreshold (policy.h IsDust): an output is dust when spending
+    it would cost more than 1/3 of its value — threshold = 3 × relay fee on
+    (serialized output + 148 bytes of spending input). 546 sat for P2PKH at
+    the default rate; larger scripts scale up."""
+    size = len(txout.serialize()) + 148
+    return 3 * get_min_relay_fee(size, rate)
 
 
 def is_standard_tx(tx: CTransaction) -> tuple[bool, str]:
@@ -53,7 +62,7 @@ def is_standard_tx(tx: CTransaction) -> tuple[bool, str]:
             n_data += 1
             if len(txout.script_pubkey) > MAX_OP_RETURN_RELAY:
                 return False, "oversize-op-return"
-        elif txout.value < DUST_THRESHOLD:
+        elif txout.value < get_dust_threshold(txout):
             return False, "dust"
     if n_data > 1:
         return False, "multi-op-return"

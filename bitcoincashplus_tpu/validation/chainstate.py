@@ -25,7 +25,7 @@ from ..consensus.params import ChainParams, get_block_subsidy
 from ..consensus.pow import check_proof_of_work, get_next_work_required
 from ..consensus.serialize import hash_to_hex
 from ..consensus.tx import COutPoint, CTransaction, money_range
-from ..consensus.tx_check import TxValidationError, check_transaction
+from ..consensus.tx_check import TxValidationError, check_transaction, is_final_tx
 from ..script.script import script_int
 from .chain import BlockStatus, CBlockIndex, CChain
 from .coins import BlockUndo, CoinsCache, CoinsView, TxUndo, add_coins
@@ -192,16 +192,7 @@ class ChainstateManager:
             if script_sig[: len(expect)] != expect:
                 raise BlockValidationError("bad-cb-height", "block height mismatch in coinbase")
 
-    @staticmethod
-    def _is_final_tx(tx: CTransaction, block_height: int, block_time: int) -> bool:
-        """IsFinalTx (src/consensus/tx_verify.cpp:~17)."""
-        if tx.locktime == 0:
-            return True
-        threshold = 500_000_000  # LOCKTIME_THRESHOLD
-        cutoff = block_height if tx.locktime < threshold else block_time
-        if tx.locktime < cutoff:
-            return True
-        return all(txin.sequence == 0xFFFFFFFF for txin in tx.vin)
+    _is_final_tx = staticmethod(is_final_tx)
 
     # ------------------------------------------------------------------
     # header / block acceptance into the tree

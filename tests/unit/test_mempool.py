@@ -1,0 +1,352 @@
+"""Mempool tests — CTxMemPool invariants + AcceptToMemoryPool e2e.
+
+Mirrors src/test/mempool_tests.cpp (aggregate bookkeeping, removal,
+eviction ordering) and the ATMP acceptance/reject matrix that
+qa/rpc-tests exercises via sendrawtransaction.
+"""
+
+import pytest
+
+from bitcoincashplus_tpu.consensus.params import regtest_params
+from bitcoincashplus_tpu.consensus.tx import COutPoint, CTransaction, CTxIn, CTxOut
+from bitcoincashplus_tpu.mempool import (
+    CTxMemPool,
+    MempoolEntry,
+    MempoolError,
+    accept_to_memory_pool,
+)
+from bitcoincashplus_tpu.mining.assembler import BlockAssembler
+from bitcoincashplus_tpu.mining.generate import generate_blocks
+from bitcoincashplus_tpu.store.blockstore import MemoryBlockStore
+from bitcoincashplus_tpu.validation.chainstate import ChainstateManager
+from bitcoincashplus_tpu.validation.coins import MemoryCoinsView
+from bitcoincashplus_tpu.validation.scriptcheck import BlockScriptVerifier
+from bitcoincashplus_tpu.validation.sigcache import SignatureCache
+from bitcoincashplus_tpu.wallet.keys import CKey
+from bitcoincashplus_tpu.wallet.signing import sign_transaction
+
+from test_validation import TILE, _hand_mine
+
+KEY = CKey(0xDEADBEEFCAFE)
+SPK_KEY = KEY.p2pkh_script()
+
+
+# ----------------------------------------------------------------------
+# pure pool mechanics (no chainstate): mempool_tests.cpp analogues
+# ----------------------------------------------------------------------
+
+
+def _fake_tx(inputs, n_out=1, value=10_000, salt=0):
+    """A structurally-valid unsigned tx for pool bookkeeping tests."""
+    return CTransaction(
+        vin=tuple(CTxIn(op, bytes([salt % 256])) for op in inputs),
+        vout=tuple(CTxOut(value, b"\x51") for _ in range(n_out)),
+    )
+
+
+def _entry(tx, fee=1000, t=0, height=1):
+    return MempoolEntry(tx, fee, t, height)
+
+
+def _root_tx(salt, n_out=1):
+    return _fake_tx([COutPoint(bytes([salt]) * 32, 0)], n_out=n_out, salt=salt)
+
+
+class TestPoolAggregates:
+    def test_chain_aggregates(self):
+        pool = CTxMemPool()
+        parent = _root_tx(1, n_out=2)
+        child = _fake_tx([COutPoint(parent.txid, 0)], salt=2)
+        grandchild = _fake_tx([COutPoint(child.txid, 0)], salt=3)
+        pool.add_unchecked(_entry(parent, fee=1000))
+        pool.add_unchecked(_entry(child, fee=2000))
+        pool.add_unchecked(_entry(grandchild, fee=4000))
+
+        pe, ce, ge = pool.get(parent.txid), pool.get(child.txid), pool.get(grandchild.txid)
+        assert pe.count_with_descendants == 3
+        assert ce.count_with_descendants == 2
+        assert ge.count_with_descendants == 1
+        assert ge.count_with_ancestors == 3
+        assert ce.count_with_ancestors == 2
+        assert pe.count_with_ancestors == 1
+        assert pe.fees_with_descendants == 7000
+        assert ge.fees_with_ancestors == 7000
+        assert pool.total_size == pe.size + ce.size + ge.size
+
+    def test_remove_middle_fixes_aggregates(self):
+        pool = CTxMemPool()
+        parent = _root_tx(1, n_out=2)
+        child = _fake_tx([COutPoint(parent.txid, 0)], salt=2)
+        pool.add_unchecked(_entry(parent, fee=1000))
+        pool.add_unchecked(_entry(child, fee=2000))
+        pool.remove_recursive(child.txid)
+        pe = pool.get(parent.txid)
+        assert pe.count_with_descendants == 1
+        assert pe.fees_with_descendants == 1000
+        assert child.txid not in pool
+        # child's input spend is released
+        assert pool.get_spender(COutPoint(parent.txid, 0)) is None
+
+    def test_remove_recursive_takes_descendants(self):
+        pool = CTxMemPool()
+        parent = _root_tx(1, n_out=2)
+        c1 = _fake_tx([COutPoint(parent.txid, 0)], salt=2)
+        c2 = _fake_tx([COutPoint(parent.txid, 1)], salt=3)
+        for tx, fee in ((parent, 1000), (c1, 1000), (c2, 1000)):
+            pool.add_unchecked(_entry(tx, fee=fee))
+        removed = pool.remove_recursive(parent.txid)
+        assert set(removed) == {parent.txid, c1.txid, c2.txid}
+        assert len(pool) == 0 and pool.total_size == 0 and pool.total_fee == 0
+
+    def test_conflict_assertion(self):
+        pool = CTxMemPool()
+        a = _root_tx(1)
+        op = COutPoint(bytes([1]) * 32, 0)  # same prevout as a
+        b = _fake_tx([op], salt=9)
+        pool.add_unchecked(_entry(a))
+        with pytest.raises(AssertionError):
+            pool.add_unchecked(_entry(b))
+
+    def test_expiry(self):
+        pool = CTxMemPool(expiry_seconds=100)
+        old = _root_tx(1, n_out=2)
+        child = _fake_tx([COutPoint(old.txid, 0)], salt=2)
+        fresh = _root_tx(3)
+        pool.add_unchecked(_entry(old, t=0))
+        pool.add_unchecked(_entry(child, t=150))  # young but descends from old
+        pool.add_unchecked(_entry(fresh, t=150))
+        n = pool.expire(now=200)
+        assert n == 2  # old + its descendant
+        assert fresh.txid in pool
+
+    def test_trim_to_size_evicts_lowest_descendant_score(self):
+        pool = CTxMemPool()
+        cheap = _root_tx(1)
+        rich = _root_tx(2)
+        pool.add_unchecked(_entry(cheap, fee=100))
+        pool.add_unchecked(_entry(rich, fee=100_000))
+        pool.trim_to_size(max_bytes=pool.get(rich.txid).size)
+        assert rich.txid in pool and cheap.txid not in pool
+
+
+class TestSelectForBlock:
+    def test_parent_emitted_before_child(self):
+        pool = CTxMemPool()
+        parent = _root_tx(1, n_out=2)
+        child = _fake_tx([COutPoint(parent.txid, 0)], salt=2)
+        pool.add_unchecked(_entry(parent, fee=100))
+        pool.add_unchecked(_entry(child, fee=100_000))  # high child fee
+        sel = pool.select_for_block(max_size=1_000_000, height=10, block_time=0)
+        txids = [e.txid for e in sel]
+        assert txids.index(parent.txid) < txids.index(child.txid)
+
+    def test_package_feerate_orders_selection(self):
+        pool = CTxMemPool()
+        solo_hi = _root_tx(1)
+        solo_lo = _root_tx(2)
+        pool.add_unchecked(_entry(solo_hi, fee=50_000))
+        pool.add_unchecked(_entry(solo_lo, fee=10))
+        sel = pool.select_for_block(max_size=1_000_000, height=10, block_time=0)
+        assert [e.txid for e in sel] == [solo_hi.txid, solo_lo.txid]
+
+    def test_size_cap_respected(self):
+        pool = CTxMemPool()
+        a, b = _root_tx(1), _root_tx(2)
+        pool.add_unchecked(_entry(a, fee=1000))
+        pool.add_unchecked(_entry(b, fee=999))
+        one_size = pool.get(a.txid).size
+        sel = pool.select_for_block(max_size=one_size, height=10, block_time=0)
+        assert [e.txid for e in sel] == [a.txid]
+
+    def test_nonfinal_excluded_with_descendants(self):
+        """ADVICE r2 #3: a future-locktime tx (and its child) must not be
+        selected into a template."""
+        pool = CTxMemPool()
+        locked = CTransaction(
+            vin=(CTxIn(COutPoint(bytes([1]) * 32, 0), b"", 0),),  # seq != final
+            vout=(CTxOut(10_000, b"\x51"), CTxOut(10_000, b"\x51")),
+            locktime=500,  # height-locked above current height
+        )
+        child = _fake_tx([COutPoint(locked.txid, 0)], salt=2)
+        ok = _root_tx(3)
+        pool.add_unchecked(_entry(locked))
+        pool.add_unchecked(_entry(child))
+        pool.add_unchecked(_entry(ok))
+        sel = pool.select_for_block(max_size=1_000_000, height=100, block_time=0)
+        assert [e.txid for e in sel] == [ok.txid]
+        # at height 501 it becomes final and selectable
+        sel = pool.select_for_block(max_size=1_000_000, height=501, block_time=0)
+        assert {e.txid for e in sel} == {locked.txid, child.txid, ok.txid}
+
+
+# ----------------------------------------------------------------------
+# AcceptToMemoryPool e2e on a real regtest chain
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture
+def node():
+    """chainstate + mempool + sigcache trio with 103 mined blocks."""
+    params = regtest_params()
+    t = [1_600_000_000]
+
+    def fake_time():
+        t[0] += 60
+        return t[0]
+
+    sigcache = SignatureCache()
+    cs = ChainstateManager(
+        params, MemoryCoinsView(), MemoryBlockStore(),
+        script_verifier=BlockScriptVerifier(params, backend="cpu",
+                                            sigcache=sigcache),
+        get_time=fake_time,
+    )
+    generate_blocks(cs, SPK_KEY, 103, tile=TILE)
+    pool = CTxMemPool()
+    cs.on_block_connected.append(lambda blk, idx: pool.remove_for_block(blk.vtx))
+    return cs, pool, sigcache
+
+
+def _coinbase_out(cs, height):
+    blk = cs.get_block(cs.chain[height].hash)
+    return COutPoint(blk.vtx[0].txid, 0), blk.vtx[0].vout[0].value
+
+
+def _spend(op, value, fee=10_000, n_out=1, locktime=0, sequence=0xFFFFFFFF):
+    per_out = (value - fee) // n_out
+    tx = CTransaction(
+        vin=(CTxIn(op, b"", sequence),),
+        vout=tuple(CTxOut(per_out, SPK_KEY) for _ in range(n_out)),
+        locktime=locktime,
+    )
+    return sign_transaction(
+        tx, [(SPK_KEY, value)], lambda i: KEY if i == KEY.pubkey_hash else None,
+        enable_forkid=True,
+    )
+
+
+class TestATMP:
+    def test_accept_and_mine(self, node):
+        cs, pool, sigcache = node
+        op, value = _coinbase_out(cs, 1)
+        tx = _spend(op, value)
+        entry = accept_to_memory_pool(pool, cs, tx, sigcache=sigcache)
+        assert entry.txid == tx.txid and tx.txid in pool
+        assert len(sigcache) == 1  # ATMP populated the cache
+        # template picks it up, block mines, pool drains
+        hits_before = sigcache.hits
+        generate_blocks(cs, SPK_KEY, 1, mempool=pool, tile=TILE)
+        blk = cs.get_block(cs.tip().hash)
+        assert any(t.txid == tx.txid for t in blk.vtx[1:])
+        assert len(pool) == 0
+        # connect re-used the ATMP-verified sig via the cache
+        assert sigcache.hits > hits_before
+        # miner collected the fee
+        assert blk.vtx[0].total_output_value() > 50 * 10**8 // 2
+
+    def test_duplicate_rejected(self, node):
+        cs, pool, sigcache = node
+        op, value = _coinbase_out(cs, 1)
+        tx = _spend(op, value)
+        accept_to_memory_pool(pool, cs, tx, sigcache=sigcache)
+        with pytest.raises(MempoolError, match="already-in-mempool"):
+            accept_to_memory_pool(pool, cs, tx, sigcache=sigcache)
+
+    def test_conflict_rejected(self, node):
+        cs, pool, sigcache = node
+        op, value = _coinbase_out(cs, 1)
+        accept_to_memory_pool(pool, cs, _spend(op, value), sigcache=sigcache)
+        double = _spend(op, value, fee=20_000)  # same prevout, different tx
+        with pytest.raises(MempoolError, match="mempool-conflict"):
+            accept_to_memory_pool(pool, cs, double, sigcache=sigcache)
+
+    def test_coinbase_rejected(self, node):
+        cs, pool, sigcache = node
+        blk = cs.get_block(cs.chain[1].hash)
+        with pytest.raises(MempoolError, match="coinbase"):
+            accept_to_memory_pool(pool, cs, blk.vtx[0], sigcache=sigcache)
+
+    def test_missing_inputs(self, node):
+        cs, pool, sigcache = node
+        ghost = COutPoint(b"\xaa" * 32, 0)
+        tx = CTransaction(
+            vin=(CTxIn(ghost, b"\x51"),), vout=(CTxOut(1000, SPK_KEY),)
+        )
+        with pytest.raises(MempoolError, match="missing-inputs"):
+            accept_to_memory_pool(pool, cs, tx, sigcache=sigcache)
+
+    def test_premature_coinbase_spend(self, node):
+        cs, pool, sigcache = node
+        op, value = _coinbase_out(cs, cs.tip().height)  # freshly mined
+        with pytest.raises(MempoolError, match="premature-spend-of-coinbase"):
+            accept_to_memory_pool(pool, cs, _spend(op, value), sigcache=sigcache)
+
+    def test_low_fee_rejected(self, node):
+        cs, pool, sigcache = node
+        op, value = _coinbase_out(cs, 1)
+        with pytest.raises(MempoolError, match="min-fee-not-met"):
+            accept_to_memory_pool(pool, cs, _spend(op, value, fee=10),
+                                  sigcache=sigcache)
+
+    def test_bad_signature_rejected(self, node):
+        cs, pool, sigcache = node
+        op, value = _coinbase_out(cs, 1)
+        tx = _spend(op, value)
+        ss = bytearray(tx.vin[0].script_sig)
+        ss[40] ^= 1
+        bad = CTransaction(tx.version, (CTxIn(op, bytes(ss)),), tx.vout, tx.locktime)
+        with pytest.raises(MempoolError, match="script-verify"):
+            accept_to_memory_pool(pool, cs, bad, sigcache=sigcache)
+        assert bad.txid not in pool
+
+    def test_nonfinal_rejected(self, node):
+        cs, pool, sigcache = node
+        op, value = _coinbase_out(cs, 1)
+        tx = _spend(op, value, locktime=cs.tip().height + 100, sequence=0)
+        with pytest.raises(MempoolError, match="non-final"):
+            accept_to_memory_pool(pool, cs, tx, sigcache=sigcache)
+
+    def test_unconfirmed_chain_accepted(self, node):
+        """Child spending an in-pool parent's output is admitted (the
+        CCoinsViewMemPool leg) and mined in parent-first order."""
+        cs, pool, sigcache = node
+        op, value = _coinbase_out(cs, 1)
+        parent = _spend(op, value, n_out=2)
+        accept_to_memory_pool(pool, cs, parent, sigcache=sigcache)
+        child_in = COutPoint(parent.txid, 0)
+        child = _spend(child_in, parent.vout[0].value)
+        accept_to_memory_pool(pool, cs, child, sigcache=sigcache)
+        assert pool.get(child.txid).count_with_ancestors == 2
+        generate_blocks(cs, SPK_KEY, 1, mempool=pool, tile=TILE)
+        blk = cs.get_block(cs.tip().hash)
+        txids = [t.txid for t in blk.vtx]
+        assert txids.index(parent.txid) < txids.index(child.txid)
+        assert len(pool) == 0
+
+    def test_ancestor_limit(self, node):
+        cs, pool, sigcache = node
+        op, value = _coinbase_out(cs, 1)
+        tx = _spend(op, value, fee=10_000)
+        accept_to_memory_pool(pool, cs, tx, sigcache=sigcache)
+        for _ in range(24):
+            nxt = _spend(COutPoint(tx.txid, 0), tx.vout[0].value, fee=10_000)
+            accept_to_memory_pool(pool, cs, nxt, sigcache=sigcache)
+            tx = nxt
+        over = _spend(COutPoint(tx.txid, 0), tx.vout[0].value, fee=10_000)
+        with pytest.raises(MempoolError, match="too-long-mempool-chain"):
+            accept_to_memory_pool(pool, cs, over, sigcache=sigcache)
+
+    def test_conflict_pruned_on_block_connect(self, node):
+        """A tx double-spent by a mined block is evicted as a conflict."""
+        cs, pool, sigcache = node
+        op, value = _coinbase_out(cs, 1)
+        pool_tx = _spend(op, value)
+        accept_to_memory_pool(pool, cs, pool_tx, sigcache=sigcache)
+        # mine a block containing a DIFFERENT spend of the same outpoint
+        rival = _spend(op, value, fee=20_000)
+        tip = cs.tip()
+        blk = _hand_mine(tip.hash, tip.height + 1, cs.get_time() + 10,
+                         tip.bits, (rival,))
+        cs.process_new_block(blk)
+        assert cs.tip().hash == blk.get_hash()
+        assert pool_tx.txid not in pool  # conflict removed

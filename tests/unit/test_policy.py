@@ -1,0 +1,122 @@
+"""Standardness policy matrix — IsStandardTx / AreInputsStandard / dust.
+
+Mirrors src/test/policy tests + policyestimator-adjacent checks in
+transaction_tests.cpp (the reference spreads these across suites).
+"""
+
+import pytest
+
+from bitcoincashplus_tpu.consensus.tx import COutPoint, CTransaction, CTxIn, CTxOut
+from bitcoincashplus_tpu.mempool.policy import (
+    MAX_OP_RETURN_RELAY,
+    are_inputs_standard,
+    get_dust_threshold,
+    get_min_relay_fee,
+    is_standard_tx,
+)
+from bitcoincashplus_tpu.script import script as S
+from bitcoincashplus_tpu.wallet.keys import CKey
+
+KEY = CKey(0x1234)
+P2PKH = KEY.p2pkh_script()
+P2PK = bytes([len(KEY.pubkey)]) + KEY.pubkey + bytes([S.OP_CHECKSIG])
+
+
+def _tx(vout, script_sig=b"\x51", version=1):
+    return CTransaction(
+        version=version,
+        vin=(CTxIn(COutPoint(b"\x11" * 32, 0), script_sig),),
+        vout=tuple(vout),
+    )
+
+
+class TestIsStandardTx:
+    def test_p2pkh_standard(self):
+        ok, reason = is_standard_tx(_tx([CTxOut(100_000, P2PKH)]))
+        assert ok, reason
+
+    def test_version_gate(self):
+        ok, reason = is_standard_tx(_tx([CTxOut(100_000, P2PKH)], version=3))
+        assert not ok and reason == "version"
+
+    def test_nonstandard_script(self):
+        # bare OP_TRUE output is not a standard template
+        ok, reason = is_standard_tx(_tx([CTxOut(100_000, b"\x51")]))
+        assert not ok and reason == "scriptpubkey"
+
+    def test_scriptsig_not_pushonly(self):
+        tx = _tx([CTxOut(100_000, P2PKH)], script_sig=bytes([S.OP_DUP]))
+        ok, reason = is_standard_tx(tx)
+        assert not ok and reason == "scriptsig-not-pushonly"
+
+    def test_op_return_standard_within_limit(self):
+        data = b"\x6a" + bytes([40]) + b"\xab" * 40  # OP_RETURN + push
+        ok, reason = is_standard_tx(_tx([CTxOut(0, data), CTxOut(100_000, P2PKH)]))
+        assert ok, reason
+
+    def test_oversize_op_return(self):
+        n = MAX_OP_RETURN_RELAY  # script longer than the cap
+        data = b"\x6a\x4c" + bytes([n]) + b"\xab" * n
+        ok, reason = is_standard_tx(_tx([CTxOut(0, data)]))
+        assert not ok and reason == "oversize-op-return"
+
+    def test_multi_op_return(self):
+        data = b"\x6a\x01\xab"
+        ok, reason = is_standard_tx(_tx([CTxOut(0, data), CTxOut(0, data)]))
+        assert not ok and reason == "multi-op-return"
+
+    def test_dust_rejected(self):
+        ok, reason = is_standard_tx(_tx([CTxOut(545, P2PKH)]))
+        assert not ok and reason == "dust"
+        ok, reason = is_standard_tx(_tx([CTxOut(546, P2PKH)]))
+        assert ok, reason
+
+
+class TestDustThreshold:
+    def test_p2pkh_is_546(self):
+        """ADVICE r2 #4: threshold must derive from serialized size — the
+        canonical 546 for a 34-byte P2PKH output at 1000 sat/kB."""
+        assert get_dust_threshold(CTxOut(0, P2PKH)) == 546
+
+    def test_larger_script_larger_threshold(self):
+        big = CTxOut(0, b"\x51" * 100)
+        assert get_dust_threshold(big) > get_dust_threshold(CTxOut(0, P2PKH))
+
+    def test_scales_with_rate(self):
+        out = CTxOut(0, P2PKH)
+        assert get_dust_threshold(out, rate=2000) == 2 * 546
+
+
+class TestMinRelayFee:
+    def test_fee_math(self):
+        assert get_min_relay_fee(1000) == 1000  # 1 sat/byte at default rate
+        assert get_min_relay_fee(250) == 250
+        # sub-1-sat truncation floors at the rate (CFeeRate::GetFee)
+        assert get_min_relay_fee(0) == 1000
+
+
+class TestAreInputsStandard:
+    def test_p2pkh_input_ok(self):
+        tx = _tx([CTxOut(100_000, P2PKH)])
+        assert are_inputs_standard(tx, [CTxOut(200_000, P2PKH)])
+
+    def test_nonstandard_prevout(self):
+        tx = _tx([CTxOut(100_000, P2PKH)])
+        assert not are_inputs_standard(tx, [CTxOut(200_000, b"\x51")])
+
+    def test_p2sh_sigop_cap(self):
+        from bitcoincashplus_tpu.crypto.hashes import hash160
+
+        # redeem script with 16 CHECKSIGs exceeds MAX_P2SH_SIGOPS=15
+        redeem = bytes([S.OP_CHECKSIG] * 16) + bytes([S.OP_TRUE])
+        p2sh = bytes([S.OP_HASH160, 20]) + hash160(redeem) + bytes([S.OP_EQUAL])
+        sig = bytes([len(redeem)]) + redeem
+        tx = _tx([CTxOut(100_000, P2PKH)], script_sig=sig)
+        assert not are_inputs_standard(tx, [CTxOut(200_000, p2sh)])
+
+        # 15 sigops is allowed
+        redeem_ok = bytes([S.OP_CHECKSIG] * 15) + bytes([S.OP_TRUE])
+        p2sh_ok = bytes([S.OP_HASH160, 20]) + hash160(redeem_ok) + bytes([S.OP_EQUAL])
+        tx_ok = _tx([CTxOut(100_000, P2PKH)],
+                    script_sig=bytes([len(redeem_ok)]) + redeem_ok)
+        assert are_inputs_standard(tx_ok, [CTxOut(200_000, p2sh_ok)])
