@@ -1,0 +1,189 @@
+"""Flag and configuration handling — the ArgsManager analogue.
+
+Reference: src/util.cpp:~400-600 (ParseParameters, ReadConfigFile, GetArg /
+GetBoolArg / GetArgs, SoftSetArg), src/chainparamsbase.cpp (network
+selection / datadir subdirectories), src/init.cpp:~350-600 (HelpMessage).
+
+Bitcoin-style flags: `-name=value` or bare `-name` (boolean true); a
+leading `-no` negates (`-nolisten` == `-listen=0`). Precedence is
+CLI > config file, matching the reference (config-file values are
+soft-set only where the CLI didn't supply the arg). `--name` is accepted
+as an alias for `-name` (the reference strips the extra dash too), which
+is how `--tpu` arrives.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import replace
+from typing import Optional
+
+from ..consensus.params import ChainParams, select_params
+from ..consensus.serialize import hex_to_hash
+
+DEFAULT_DATADIR = "~/.bitcoincashplus-tpu"
+
+HELP_MESSAGE = """\
+bcpd — TPU-native bitcoincashplus node daemon
+
+Options:
+  -?, -help              Print this help message and exit
+  -datadir=<dir>         Specify data directory (default: ~/.bitcoincashplus-tpu)
+  -conf=<file>           Config file name (default: bitcoin.conf in datadir)
+  -regtest               Use the regression test network
+  -testnet               Use the test network
+  -reindex               Rebuild chain state and block index from blk*.dat files
+  -txindex               Maintain a full transaction index (default: 0)
+  -par=<n>               Script verification batch backend threads; 0 = auto (default: 0)
+  -dbcache=<n>           Database cache size in MiB (default: 300)
+  -checkblocks=<n>       How many blocks to verify at startup (default: 6)
+  -checklevel=<n>        How thorough the startup block verification is (0-4, default: 3)
+  -assumevalid=<hex>     Skip script verification for ancestors of this block
+                         (0 = verify everything)
+  -debug=<category>      Enable debug logging (all|net|mempool|rpc|bench|db|validation|tpu)
+  -printtoconsole        Send trace/debug info to console instead of debug.log only
+  -maxmempool=<n>        Max transaction memory pool size in MiB (default: 300)
+  -mempoolexpiry=<n>     Do not keep transactions in mempool longer than <n> hours (default: 336)
+  -minrelaytxfee=<amt>   Minimum relay fee rate in satoshis/kB (default: 1000)
+  -tpu=<0|1>             Use the TPU batch backend for sig verification and
+                         mining sweeps (default: auto-detect)
+  -port=<port>           Listen for P2P connections on <port>
+  -listen                Accept P2P connections from outside (default: 1 when P2P enabled)
+  -connect=<ip:port>     Connect only to the specified node (may be repeated)
+  -rpcport=<port>        Listen for JSON-RPC connections on <port>
+  -rpcbind=<addr>        Bind RPC to address (default: 127.0.0.1)
+  -rpcuser=<user>        Username for JSON-RPC connections (default: cookie auth)
+  -rpcpassword=<pw>      Password for JSON-RPC connections
+  -server                Accept JSON-RPC commands (default: 1 for bcpd)
+  -flushinterval=<n>     Flush chainstate every <n> connected blocks (default: 64)
+"""
+
+
+class ConfigError(Exception):
+    pass
+
+
+class Config:
+    """Parsed arguments + config file, with typed accessors."""
+
+    def __init__(self, argv: Optional[list[str]] = None):
+        # name -> list of values; CLI wins over conf (soft-set semantics)
+        self.args: dict[str, list[str]] = {}
+        if argv:
+            self.parse_args(argv)
+
+    # -- parsing -------------------------------------------------------
+
+    @staticmethod
+    def _split(arg: str) -> tuple[str, str]:
+        key, _, value = arg.partition("=")
+        key = key.lstrip("-")
+        if not _:
+            value = "1"
+        if key.startswith("no"):  # -nofoo => -foo=0  (InterpretNegatedOption)
+            return key[2:], "0" if value == "1" else "1"
+        return key, value
+
+    def parse_args(self, argv: list[str]) -> None:
+        """ParseParameters. Raises ConfigError on non-flag positionals."""
+        for arg in argv:
+            if not arg.startswith("-"):
+                raise ConfigError(f"unexpected argument: {arg!r}")
+            key, value = self._split(arg)
+            self.args.setdefault(key, []).append(value)
+
+    def read_config_file(self, path: Optional[str] = None) -> None:
+        """ReadConfigFile — ini-style `name=value` lines, '#' comments.
+        Values soft-set: the CLI keeps precedence."""
+        if path is None:
+            path = os.path.join(self.datadir_base, self.get("conf", "bitcoin.conf"))
+        if not os.path.exists(path):
+            return
+        file_args: dict[str, list[str]] = {}
+        with open(path) as f:
+            for raw in f:
+                line = raw.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                if "=" not in line:
+                    raise ConfigError(f"parse error in {path}: {raw.strip()!r}")
+                key, value = line.split("=", 1)
+                file_args.setdefault(key.strip().lstrip("-"), []).append(value.strip())
+        for key, values in file_args.items():
+            if key not in self.args:
+                self.args[key] = values
+
+    # -- typed accessors (GetArg family) -------------------------------
+
+    def get(self, name: str, default: str = "") -> str:
+        values = self.args.get(name)
+        return values[0] if values else default
+
+    def get_multi(self, name: str) -> list[str]:
+        return list(self.args.get(name, ()))
+
+    def get_bool(self, name: str, default: bool = False) -> bool:
+        values = self.args.get(name)
+        if not values:
+            return default
+        return values[0] not in ("0", "false", "")
+
+    def get_int(self, name: str, default: int = 0) -> int:
+        values = self.args.get(name)
+        if not values:
+            return default
+        try:
+            return int(values[0])
+        except ValueError:
+            raise ConfigError(f"-{name}={values[0]!r}: not an integer") from None
+
+    def has(self, name: str) -> bool:
+        return name in self.args
+
+    # -- derived settings ----------------------------------------------
+
+    @property
+    def network(self) -> str:
+        if self.get_bool("regtest"):
+            return "regtest"
+        if self.get_bool("testnet"):
+            return "test"
+        return "main"
+
+    @property
+    def datadir_base(self) -> str:
+        return os.path.expanduser(self.get("datadir", DEFAULT_DATADIR))
+
+    @property
+    def datadir(self) -> str:
+        """Network subdirectory, as GetDataDir(fNetSpecific=true) lays out."""
+        sub = {"main": "", "test": "testnet3", "regtest": "regtest"}[self.network]
+        return os.path.join(self.datadir_base, sub) if sub else self.datadir_base
+
+    def chain_params(self) -> ChainParams:
+        """SelectParams + -assumevalid override (src/init.cpp AppInitMain)."""
+        params = select_params(self.network)
+        if self.has("assumevalid"):
+            raw = self.get("assumevalid")
+            av = None if raw in ("0", "") else hex_to_hash(raw)
+            params = replace(params, assume_valid=av)
+        if self.has("minimumchainwork"):
+            params = replace(
+                params, minimum_chain_work=int(self.get("minimumchainwork"), 16)
+            )
+        return params
+
+    @property
+    def tpu_backend(self) -> str:
+        """Backend policy for ecdsa_batch / the mining sweep: the `--tpu`
+        graft flag (SURVEY.md §6.6). Unset = 'auto' (use a device when one
+        is present), -tpu=1 forces device, -tpu=0 forces CPU."""
+        if not self.has("tpu"):
+            return "auto"
+        return "tpu" if self.get_bool("tpu") else "cpu"
+
+    def rpc_port(self, params: ChainParams) -> int:
+        return self.get_int("rpcport", params.rpc_port)
+
+    def p2p_port(self, params: ChainParams) -> int:
+        return self.get_int("port", params.default_port)

@@ -1,0 +1,392 @@
+"""The node runtime — AppInitMain and friends.
+
+Reference: src/init.cpp:~1200 (AppInitMain): logging, datadir, DB opens,
+LoadBlockIndex, optional -reindex import, CVerifyDB startup integrity check,
+mempool + validation-interface wiring, then servers (RPC here; P2P via
+p2p/connman). Shutdown = flush everything, close stores (Shutdown(),
+src/init.cpp:~150).
+
+The whole node shares one re-entrant lock (`cs_main`) — RPC worker threads
+and the P2P event loop serialize on it exactly like the reference's cs_main.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from ..consensus.block import CBlock
+from ..consensus.serialize import hash_to_hex
+from ..mempool.accept import accept_to_memory_pool
+from ..mempool.mempool import CTxMemPool, MempoolError
+from ..mining.assembler import BlockAssembler
+from ..mining.generate import MAX_TRIES_DEFAULT, mine_block
+from ..store.blockstore import BlockStore
+from ..store.chainstatedb import BlockIndexDB, CoinsDB
+from ..store.kvstore import KVStore
+from ..util.log import log_init, log_print, log_printf
+from ..validation.chain import BlockStatus
+from ..validation.chainstate import BlockValidationError, ChainstateManager
+from ..validation.scriptcheck import BlockScriptVerifier
+from ..validation.sigcache import SignatureCache
+from .config import Config
+
+DEFAULT_FLUSH_INTERVAL = 64  # blocks between periodic FlushStateToDisk calls
+
+
+class InitError(Exception):
+    pass
+
+
+class Node:
+    """One full node over a datadir. Construct → (optionally) start_rpc/start_p2p
+    → work → close(). Usable in-process (tests) or via bcpd (cli/)."""
+
+    def __init__(self, config: Optional[Config] = None, datadir: Optional[str] = None,
+                 network: Optional[str] = None):
+        if config is None:
+            config = Config()
+            if datadir:
+                config.args["datadir"] = [datadir]
+            if network == "regtest":
+                config.args["regtest"] = ["1"]
+            elif network in ("test", "testnet"):
+                config.args["testnet"] = ["1"]
+        self.config = config
+        self.params = config.chain_params()
+        self.datadir = config.datadir
+        os.makedirs(self.datadir, exist_ok=True)
+        log_init(
+            logfile_path=os.path.join(self.datadir, "debug.log"),
+            categories=config.get_multi("debug"),
+            print_to_console=config.get_bool("printtoconsole"),
+        )
+        log_printf("bcpd init: network=%s datadir=%s", self.params.network, self.datadir)
+
+        # cs_main — one lock serializing all chainstate/mempool access
+        self.cs_main = threading.RLock()
+        self.shutdown_event = threading.Event()
+        self.start_time = int(time.time())
+
+        reindex = config.get_bool("reindex")
+        blocks_dir = os.path.join(self.datadir, "blocks")
+        index_path = os.path.join(blocks_dir, "index.sqlite")
+        coins_path = os.path.join(self.datadir, "chainstate.sqlite")
+        if reindex:
+            # wipe the derived state; blk*.dat files are the source of truth
+            for p in (index_path, coins_path):
+                for suffix in ("", "-wal", "-shm"):
+                    if os.path.exists(p + suffix):
+                        os.remove(p + suffix)
+            log_printf("-reindex: wiped block index and chainstate")
+
+        os.makedirs(blocks_dir, exist_ok=True)
+        self._index_kv = KVStore(index_path)
+        self._coins_kv = KVStore(coins_path)
+        self.block_store = BlockStore(self.datadir, self.params.netmagic)
+        self.index_db = BlockIndexDB(self._index_kv)
+        self.coins_db = CoinsDB(self._coins_kv)
+
+        self.sigcache = SignatureCache()
+        backend = config.tpu_backend
+        self.backend = backend
+        verifier = BlockScriptVerifier(self.params, backend=backend,
+                                       sigcache=self.sigcache)
+        self.chainstate = ChainstateManager(
+            self.params, self.coins_db, self.block_store,
+            script_verifier=verifier, index_db=self.index_db,
+        )
+        loaded = self.chainstate.load_block_index()
+        if loaded:
+            log_printf("block index loaded: tip height %d",
+                       self.chainstate.tip().height)
+
+        if reindex:
+            n = self.import_block_files()
+            log_printf("-reindex: imported %d blocks, tip height %d",
+                       n, self.chainstate.tip().height)
+        else:
+            # pick up blocks whose index rows were flushed but that were not
+            # yet connected at crash time
+            self.chainstate.activate_best_chain()
+
+        self.verify_db(
+            n_blocks=config.get_int("checkblocks", 6),
+            level=config.get_int("checklevel", 3),
+        )
+
+        self.mempool = CTxMemPool(
+            max_size_bytes=config.get_int("maxmempool", 300) * 1_000_000,
+            expiry_seconds=config.get_int("mempoolexpiry", 336) * 3600,
+        )
+        self.min_relay_fee_rate = config.get_int("minrelaytxfee", 1000)
+        self.chainstate.on_block_connected.append(self._on_block_connected)
+        self.chainstate.on_block_disconnected.append(self._on_block_disconnected)
+
+        self.flush_interval = config.get_int("flushinterval", DEFAULT_FLUSH_INTERVAL)
+        self._blocks_since_flush = 0
+        self.txindex = config.get_bool("txindex")
+        if self.txindex:
+            self._build_txindex()
+        self.chainstate.flush()  # persist the (possibly fresh) index/genesis
+
+        self.rpc_server = None
+        self.connman = None  # set by start_p2p
+        self.wallet = None  # set by load_wallet
+
+    # -- validation-interface callbacks (CMainSignals analogues) --------
+
+    def _on_block_connected(self, block: CBlock, idx) -> None:
+        self.mempool.remove_for_block(block.vtx)
+        if self.txindex:
+            self._txindex_add(block, idx)
+        self._blocks_since_flush += 1
+        if self._blocks_since_flush >= self.flush_interval:
+            self.chainstate.flush()
+            self._blocks_since_flush = 0
+
+    def _on_block_disconnected(self, block: CBlock, idx) -> None:
+        # BlockDisconnected: return the block's transactions to the mempool
+        # (reference: DisconnectTip -> mempool resurrection)
+        for tx in block.vtx[1:]:
+            try:
+                self.accept_to_mempool(tx)
+            except MempoolError:
+                pass  # no-longer-valid txs just drop
+
+    # -- mempool entry point -------------------------------------------
+
+    def accept_to_mempool(self, tx, now: Optional[int] = None):
+        """AcceptToMemoryPool with this node's policy knobs; caller holds
+        cs_main (or is single-threaded)."""
+        return accept_to_memory_pool(
+            self.mempool, self.chainstate, tx,
+            sigcache=self.sigcache,
+            min_fee_rate=self.min_relay_fee_rate,
+            backend="cpu" if self.backend == "cpu" else "auto",
+            now=now,
+        )
+
+    # -- mining ---------------------------------------------------------
+
+    def assembler(self) -> BlockAssembler:
+        return BlockAssembler(self.chainstate, self.mempool)
+
+    def generate_to_script(self, script_pubkey: bytes, n_blocks: int,
+                           max_tries: int = MAX_TRIES_DEFAULT) -> list[bytes]:
+        """generatetoaddress backend (src/rpc/mining.cpp generateBlocks)."""
+        hashes: list[bytes] = []
+        asm = self.assembler()
+        for _ in range(n_blocks):
+            block = mine_block(asm, script_pubkey, max_tries=max_tries)
+            if block is None:
+                break
+            self.chainstate.process_new_block(block)
+            hashes.append(block.get_hash())
+        return hashes
+
+    def submit_block(self, block: CBlock) -> Optional[str]:
+        """submitblock semantics: None on accept, reject-reason string
+        otherwise ('duplicate' when we already have full data)."""
+        idx = self.chainstate.block_index.get(block.get_hash())
+        if idx is not None and (idx.status & BlockStatus.HAVE_DATA):
+            if idx.status & BlockStatus.FAILED_MASK:
+                return "duplicate-invalid"
+            return "duplicate"
+        try:
+            self.chainstate.process_new_block(block)
+        except BlockValidationError as e:
+            return e.reason
+        if self.connman is not None:
+            self.connman.relay_block(block.get_hash())
+        return None
+
+    # -- startup integrity + import ------------------------------------
+
+    def verify_db(self, n_blocks: int = 6, level: int = 3) -> bool:
+        """CVerifyDB::VerifyDB (src/validation.cpp:~3700): walk back from the
+        tip re-checking recent blocks. Level >=1 re-runs CheckBlock; >=2
+        checks undo data presence/decodability; >=3 replays
+        disconnect/reconnect on a scratch view checking UTXO consistency."""
+        cs = self.chainstate
+        tip = cs.tip()
+        if tip is None or tip.height == 0 or n_blocks <= 0:
+            return True
+        from ..validation.coins import BlockUndo, CoinsCache
+
+        checked = 0
+        idx = tip
+        scratch = CoinsCache(cs.coins)
+        to_reconnect = []
+        while idx is not None and idx.height > 0 and checked < n_blocks:
+            raw = cs.block_store.get_block(idx.hash)
+            if raw is None:
+                raise InitError(f"VerifyDB: missing block data at height {idx.height}")
+            block = CBlock.from_bytes(raw)
+            if level >= 1:
+                cs.check_block(block)
+            if level >= 2:
+                undo_raw = cs.block_store.get_undo(idx.hash)
+                if undo_raw is None:
+                    raise InitError(f"VerifyDB: missing undo data at height {idx.height}")
+                undo = BlockUndo.from_bytes(undo_raw)
+                if level >= 3:
+                    cs.disconnect_block(block, idx, undo, view=scratch)
+                    to_reconnect.append((block, idx))
+            checked += 1
+            idx = idx.prev
+        if level >= 4:
+            for block, bidx in reversed(to_reconnect):
+                cs.connect_block(block, bidx, check_scripts=False, view=scratch)
+        # scratch view is discarded — this was a read-only replay
+        log_print("db", "VerifyDB: %d blocks verified at level %d", checked, level)
+        return True
+
+    def import_block_files(self) -> int:
+        """LoadExternalBlockFile (src/validation.cpp:~4000) over every
+        blk?????.dat: scan (netmagic, size, block) records, re-register data
+        positions, and ProcessNewBlock each one. Out-of-order blocks park via
+        accept-header failure and are retried once their parent lands."""
+        import struct
+
+        magic = self.params.netmagic
+        n_imported = 0
+        pending: dict[bytes, list[CBlock]] = {}  # prev_hash -> blocks
+
+        def try_process(block: CBlock) -> bool:
+            nonlocal n_imported
+            try:
+                self.chainstate.process_new_block(block)
+            except BlockValidationError as e:
+                if e.reason == "prev-blk-not-found":
+                    pending.setdefault(block.header.hash_prev_block, []).append(block)
+                elif e.reason != "duplicate":
+                    log_printf("reindex: rejected %s: %s",
+                               hash_to_hex(block.get_hash())[:16], e.reason)
+                return False
+            n_imported += 1
+            # cascade any children that were waiting on this block
+            queue = [block.get_hash()]
+            while queue:
+                h = queue.pop()
+                for child in pending.pop(h, ()):
+                    try:
+                        self.chainstate.process_new_block(child)
+                    except BlockValidationError:
+                        continue
+                    n_imported += 1
+                    queue.append(child.get_hash())
+            return True
+
+        n_file = 0
+        while True:
+            path = os.path.join(self.datadir, "blocks", f"blk{n_file:05d}.dat")
+            if not os.path.exists(path):
+                break
+            with open(path, "rb") as f:
+                data = f.read()
+            pos = 0
+            while pos + 8 <= len(data):
+                if data[pos:pos + 4] != magic:
+                    pos += 1  # scan forward (reference tolerates garbage)
+                    continue
+                (size,) = struct.unpack_from("<I", data, pos + 4)
+                start = pos + 8
+                if start + size > len(data):
+                    break  # truncated tail record (crash mid-append)
+                try:
+                    block = CBlock.from_bytes(data[start:start + size])
+                except Exception:
+                    pos += 1
+                    continue
+                try_process(block)
+                pos = start + size
+            n_file += 1
+        self.chainstate.flush()
+        return n_imported
+
+    # -- txindex (-txindex) --------------------------------------------
+
+    _TXINDEX_PREFIX = b"t"
+
+    def _txindex_add(self, block: CBlock, idx) -> None:
+        puts = {
+            self._TXINDEX_PREFIX + tx.txid: idx.hash for tx in block.vtx
+        }
+        self._index_kv.write_batch(puts)
+
+    def _build_txindex(self) -> None:
+        """-txindex on a synced datadir: backfill from the active chain."""
+        if self.index_db.kv.get(b"Ftxindex") == b"1":
+            return
+        cs = self.chainstate
+        for height in range(cs.chain.height() + 1):
+            idx = cs.chain[height]
+            block = cs.get_block(idx.hash)
+            if block is not None:
+                self._txindex_add(block, idx)
+        self.index_db.put_flag(b"txindex", True)
+
+    def txindex_lookup(self, txid: bytes) -> Optional[bytes]:
+        """GetTransaction's txindex path: txid -> containing block hash."""
+        return self._index_kv.get(self._TXINDEX_PREFIX + txid)
+
+    # -- servers --------------------------------------------------------
+
+    def start_rpc(self) -> int:
+        """AppInitServers: bind the JSON-RPC server; returns the bound port."""
+        from ..rpc.server import RPCServer
+
+        port = self.config.rpc_port(self.params)
+        bind = self.config.get("rpcbind", "127.0.0.1")
+        self.rpc_server = RPCServer(self, bind, port)
+        self.rpc_server.start()
+        log_printf("RPC server listening on %s:%d", bind, self.rpc_server.port)
+        return self.rpc_server.port
+
+    def start_p2p(self) -> int:
+        """CConnman::Start: bind the P2P listener, dial -connect peers."""
+        from ..p2p.connman import CConnman
+
+        port = self.config.p2p_port(self.params)
+        listen = self.config.get_bool("listen", True)
+        self.connman = CConnman(self, "127.0.0.1", port if listen else 0)
+        self.connman.start()
+        for target in self.config.get_multi("connect"):
+            host, _, p = target.rpartition(":")
+            self.connman.connect_to(host or "127.0.0.1", int(p))
+        return self.connman.port
+
+    def load_wallet(self):
+        from ..wallet.wallet import Wallet
+
+        if self.wallet is None:
+            self.wallet = Wallet(params=self.params)
+            self.chainstate.on_block_connected.append(self.wallet.block_connected)
+            self.chainstate.on_block_disconnected.append(self.wallet.block_disconnected)
+        return self.wallet
+
+    # -- lifecycle ------------------------------------------------------
+
+    def wait_for_shutdown(self) -> None:
+        self.shutdown_event.wait()
+
+    def stop(self) -> None:
+        self.shutdown_event.set()
+
+    def close(self) -> None:
+        """Shutdown (src/init.cpp): stop servers, flush, close stores."""
+        if self.rpc_server is not None:
+            self.rpc_server.close()
+            self.rpc_server = None
+        if self.connman is not None:
+            self.connman.close()
+            self.connman = None
+        with self.cs_main:
+            self.chainstate.flush()
+            self.block_store.close()
+            self._index_kv.close()
+            self._coins_kv.close()
+        log_printf("bcpd shutdown complete")

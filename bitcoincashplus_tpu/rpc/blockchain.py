@@ -1,0 +1,298 @@
+"""Blockchain RPCs.
+
+Reference: src/rpc/blockchain.cpp (getblockchaininfo, getbestblockhash,
+getblockcount, getblockhash, getblock, getblockheader, getdifficulty,
+getrawmempool, getmempoolinfo, getmempoolentry, gettxout, gettxoutsetinfo,
+invalidateblock, reconsiderblock, verifychain).
+"""
+
+from __future__ import annotations
+
+from ..consensus.serialize import hash_to_hex
+from ..consensus.tx import COutPoint
+from ..validation.chain import BlockStatus
+from .rawtransaction import tx_to_json
+from .registry import (
+    RPC_INVALID_ADDRESS_OR_KEY,
+    RPC_INVALID_PARAMETER,
+    RPCError,
+    param_hash,
+    require_params,
+    rpc_method,
+)
+
+
+def difficulty_from_bits(bits: int) -> float:
+    """GetDifficulty (src/rpc/blockchain.cpp): ratio of the max target
+    (0x1d00ffff) to the current target."""
+    shift = (bits >> 24) & 0xFF
+    diff = 0x0000FFFF / (bits & 0x00FFFFFF)
+    while shift < 29:
+        diff *= 256.0
+        shift += 1
+    while shift > 29:
+        diff /= 256.0
+        shift -= 1
+    return diff
+
+
+def _block_index_or_raise(node, h: bytes):
+    idx = node.chainstate.block_index.get(h)
+    if idx is None:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Block not found")
+    return idx
+
+
+def header_to_json(node, idx) -> dict:
+    cs = node.chainstate
+    nxt = cs.chain.next(idx)
+    return {
+        "hash": hash_to_hex(idx.hash),
+        "confirmations": (cs.chain.height() - idx.height + 1)
+        if idx in cs.chain else -1,
+        "height": idx.height,
+        "version": idx.header.version,
+        "versionHex": f"{idx.header.version & 0xFFFFFFFF:08x}",
+        "merkleroot": hash_to_hex(idx.header.hash_merkle_root),
+        "time": idx.header.time,
+        "mediantime": idx.get_median_time_past(),
+        "nonce": idx.header.nonce,
+        "bits": f"{idx.header.bits:08x}",
+        "difficulty": difficulty_from_bits(idx.header.bits),
+        "chainwork": f"{idx.chain_work:064x}",
+        "previousblockhash": hash_to_hex(idx.prev.hash) if idx.prev else None,
+        "nextblockhash": hash_to_hex(nxt.hash) if nxt else None,
+    }
+
+
+@rpc_method("getblockchaininfo")
+def getblockchaininfo(node, params):
+    cs = node.chainstate
+    tip = cs.tip()
+    best_header = max(cs.block_index.values(), key=lambda i: i.chain_work)
+    return {
+        "chain": node.params.network,
+        "blocks": tip.height,
+        "headers": best_header.height,
+        "bestblockhash": hash_to_hex(tip.hash),
+        "difficulty": difficulty_from_bits(tip.header.bits),
+        "mediantime": tip.get_median_time_past(),
+        "verificationprogress": 1.0,
+        "chainwork": f"{tip.chain_work:064x}",
+        "pruned": False,
+        "softforks": [],
+    }
+
+
+@rpc_method("getbestblockhash")
+def getbestblockhash(node, params):
+    return hash_to_hex(node.chainstate.tip().hash)
+
+
+@rpc_method("getblockcount")
+def getblockcount(node, params):
+    return node.chainstate.tip().height
+
+
+@rpc_method("getblockhash")
+def getblockhash(node, params):
+    require_params(params, 1, 1, "getblockhash height")
+    idx = node.chainstate.chain[int(params[0])]
+    if idx is None:
+        raise RPCError(RPC_INVALID_PARAMETER, "Block height out of range")
+    return hash_to_hex(idx.hash)
+
+
+@rpc_method("getblockheader")
+def getblockheader(node, params):
+    require_params(params, 1, 2, "getblockheader \"hash\" ( verbose )")
+    h = param_hash(params, 0)
+    idx = _block_index_or_raise(node, h)
+    verbose = params[1] if len(params) > 1 else True
+    if not verbose:
+        return idx.header.serialize().hex()
+    return header_to_json(node, idx)
+
+
+@rpc_method("getblock")
+def getblock(node, params):
+    require_params(params, 1, 2, "getblock \"hash\" ( verbosity )")
+    h = param_hash(params, 0)
+    idx = _block_index_or_raise(node, h)
+    block = node.chainstate.get_block(h)
+    if block is None:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Block not available (no data)")
+    verbosity = params[1] if len(params) > 1 else 1
+    if isinstance(verbosity, bool):
+        verbosity = int(verbosity)
+    if verbosity == 0:
+        return block.serialize().hex()
+    out = header_to_json(node, idx)
+    out["size"] = block.size()
+    out["nTx"] = len(block.vtx)
+    if verbosity == 1:
+        out["tx"] = [tx.txid_hex for tx in block.vtx]
+    else:
+        out["tx"] = [tx_to_json(node, tx) for tx in block.vtx]
+    return out
+
+
+@rpc_method("getdifficulty")
+def getdifficulty(node, params):
+    return difficulty_from_bits(node.chainstate.tip().header.bits)
+
+
+@rpc_method("getchaintips")
+def getchaintips(node, params):
+    """getchaintips (src/rpc/blockchain.cpp): every fork tip + its status."""
+    cs = node.chainstate
+    has_child = {idx.prev for idx in cs.block_index.values() if idx.prev}
+    tips = [i for i in cs.block_index.values() if i not in has_child]
+    out = []
+    for idx in tips:
+        fork = cs.chain.find_fork(idx)
+        branch_len = idx.height - (fork.height if fork else 0)
+        if idx in cs.chain:
+            status = "active"
+        elif idx.status & BlockStatus.FAILED_MASK:
+            status = "invalid"
+        elif idx.chain_tx == 0:
+            status = "headers-only"
+        elif idx.is_valid(BlockStatus.VALID_SCRIPTS):
+            status = "valid-fork"
+        else:
+            status = "valid-headers"
+        out.append({
+            "height": idx.height,
+            "hash": hash_to_hex(idx.hash),
+            "branchlen": branch_len,
+            "status": status,
+        })
+    return out
+
+
+@rpc_method("getrawmempool")
+def getrawmempool(node, params):
+    verbose = params[0] if params else False
+    pool = node.mempool
+    if not verbose:
+        return [hash_to_hex(txid) for txid in pool.entries]
+    return {hash_to_hex(txid): _mempool_entry_json(pool, e)
+            for txid, e in pool.entries.items()}
+
+
+def _mempool_entry_json(pool, e) -> dict:
+    return {
+        "size": e.size,
+        "fee": e.fee / 1e8,
+        "time": e.time,
+        "height": e.entry_height,
+        "descendantcount": e.count_with_descendants,
+        "descendantsize": e.size_with_descendants,
+        "descendantfees": e.fees_with_descendants,
+        "ancestorcount": e.count_with_ancestors,
+        "ancestorsize": e.size_with_ancestors,
+        "ancestorfees": e.fees_with_ancestors,
+        "depends": [hash_to_hex(p) for p in pool.parents_in_pool(e.tx)],
+    }
+
+
+@rpc_method("getmempoolentry")
+def getmempoolentry(node, params):
+    require_params(params, 1, 1, "getmempoolentry \"txid\"")
+    txid = param_hash(params, 0)
+    e = node.mempool.get(txid)
+    if e is None:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Transaction not in mempool")
+    return _mempool_entry_json(node.mempool, e)
+
+
+@rpc_method("getmempoolinfo")
+def getmempoolinfo(node, params):
+    info = node.mempool.info()
+    info["mempoolminfee"] = node.min_relay_fee_rate / 1e8
+    return info
+
+
+@rpc_method("gettxout")
+def gettxout(node, params):
+    require_params(params, 2, 3, "gettxout \"txid\" n ( include_mempool )")
+    txid = param_hash(params, 0)
+    n = int(params[1])
+    include_mempool = params[2] if len(params) > 2 else True
+    op = COutPoint(txid, n)
+    if include_mempool and node.mempool.get_spender(op) is not None:
+        return None  # spent by an in-pool tx
+    coin = node.chainstate.coins.get_coin(op)
+    if coin is None and include_mempool:
+        out = node.mempool.get_output(op)
+        if out is not None:
+            from ..validation.coins import Coin
+
+            coin = Coin(out, 0x7FFFFFFF, False)
+    if coin is None:
+        return None
+    cs = node.chainstate
+    return {
+        "bestblock": hash_to_hex(cs.tip().hash),
+        "confirmations": 0 if coin.height == 0x7FFFFFFF
+        else cs.chain.height() - coin.height + 1,
+        "value": coin.out.value / 1e8,
+        "scriptPubKey": {"hex": coin.out.script_pubkey.hex()},
+        "coinbase": coin.is_coinbase,
+    }
+
+
+@rpc_method("gettxoutsetinfo")
+def gettxoutsetinfo(node, params):
+    cs = node.chainstate
+    cs.flush()  # count the persistent set, like the reference's FlushStateToDisk
+    total = 0
+    n = 0
+    for op, coin in _iterate_coins(node):
+        n += 1
+        total += coin.out.value
+    return {
+        "height": cs.chain.height(),
+        "bestblock": hash_to_hex(cs.tip().hash),
+        "txouts": n,
+        "total_amount": total / 1e8,
+    }
+
+
+def _iterate_coins(node):
+    from ..validation.coins import Coin
+
+    for k, v in node.coins_db.kv.iterate(b"C"):
+        import struct
+
+        op = COutPoint(k[1:33], struct.unpack("<I", k[33:37])[0])
+        yield op, Coin.deserialize(v)
+
+
+@rpc_method("invalidateblock")
+def invalidateblock(node, params):
+    require_params(params, 1, 1, "invalidateblock \"hash\"")
+    idx = _block_index_or_raise(node, param_hash(params, 0))
+    node.chainstate.invalidate_block(idx)
+    node.chainstate.flush()
+    return None
+
+
+@rpc_method("reconsiderblock")
+def reconsiderblock(node, params):
+    require_params(params, 1, 1, "reconsiderblock \"hash\"")
+    idx = _block_index_or_raise(node, param_hash(params, 0))
+    node.chainstate.reconsider_block(idx)
+    node.chainstate.flush()
+    return None
+
+
+@rpc_method("verifychain")
+def verifychain(node, params):
+    level = int(params[0]) if params else 3
+    n_blocks = int(params[1]) if len(params) > 1 else 6
+    try:
+        return node.verify_db(n_blocks=n_blocks, level=level)
+    except Exception:
+        return False

@@ -1,0 +1,94 @@
+"""Control / introspection RPCs.
+
+Reference: src/rpc/server.cpp (help, stop, uptime), src/rpc/misc.cpp
+(getmemoryinfo, validateaddress). `gettpuinfo` is this framework's own
+observability surface (SURVEY.md §6.5): per-dispatch TPU batch stats,
+ConnectBlock phase timings, and backend/device identity.
+"""
+
+from __future__ import annotations
+
+from ..util.log import uptime as _uptime
+from .registry import (
+    RPC_METHODS,
+    RPC_METHOD_NOT_FOUND,
+    RPCError,
+    require_params,
+    rpc_method,
+)
+
+
+@rpc_method("help")
+def help_(node, params):
+    if params:
+        name = params[0]
+        fn = RPC_METHODS.get(name)
+        if fn is None:
+            raise RPCError(RPC_METHOD_NOT_FOUND, f"help: unknown command: {name}")
+        return (fn.__doc__ or name).strip()
+    return "\n".join(sorted(RPC_METHODS))
+
+
+@rpc_method("stop")
+def stop(node, params):
+    node.stop()
+    return "bcpd stopping"
+
+
+@rpc_method("uptime")
+def uptime(node, params):
+    import time
+
+    return int(time.time()) - node.start_time
+
+
+@rpc_method("getmemoryinfo")
+def getmemoryinfo(node, params):
+    import resource
+
+    usage = resource.getrusage(resource.RUSAGE_SELF)
+    return {"locked": {"used": usage.ru_maxrss * 1024, "free": 0,
+                       "total": usage.ru_maxrss * 1024}}
+
+
+@rpc_method("validateaddress")
+def validateaddress(node, params):
+    require_params(params, 1, 1, "validateaddress \"address\"")
+    from ..wallet.keys import address_to_script
+
+    script = address_to_script(params[0], node.params)
+    if script is None:
+        return {"isvalid": False}
+    return {
+        "isvalid": True,
+        "address": params[0],
+        "scriptPubKey": script.hex(),
+    }
+
+
+@rpc_method("gettpuinfo")
+def gettpuinfo(node, params):
+    """TPU observability: ECDSA batch-dispatch stats (ops/ecdsa_batch.STATS),
+    sigcache hit rates, ConnectBlock phase timings (-debug=bench counters),
+    and the active backend/device."""
+    from ..ops import ecdsa_batch
+
+    stats = ecdsa_batch.STATS.snapshot()
+    devices = []
+    try:
+        import jax
+
+        devices = [str(d) for d in jax.devices()]
+    except Exception:
+        pass
+    return {
+        "backend": node.backend,
+        "devices": devices,
+        "batch": stats,
+        "sigcache": {
+            "entries": len(node.sigcache._set),
+            "hits": node.sigcache.hits,
+            "misses": node.sigcache.misses,
+        },
+        "connectblock": dict(node.chainstate.bench),
+    }

@@ -1,0 +1,127 @@
+"""Mining RPCs.
+
+Reference: src/rpc/mining.cpp (getblocktemplate :~350, submitblock,
+generatetoaddress :~200, getmininginfo, getnetworkhashps,
+prioritisetransaction). The nonce search behind generatetoaddress is the
+TPU sweep (ops/miner), not the reference's scalar while-loop (SURVEY.md
+§4.5) — the RPC surface is identical.
+"""
+
+from __future__ import annotations
+
+from ..consensus.block import CBlock
+from ..consensus.serialize import hash_to_hex
+from ..mining.generate import MAX_TRIES_DEFAULT
+from ..wallet.keys import address_to_script
+from .blockchain import difficulty_from_bits
+from .registry import (
+    RPC_DESERIALIZATION_ERROR,
+    RPC_INVALID_ADDRESS_OR_KEY,
+    RPCError,
+    require_params,
+    rpc_method,
+)
+
+
+@rpc_method("generatetoaddress")
+def generatetoaddress(node, params):
+    require_params(params, 2, 3, "generatetoaddress nblocks \"address\" ( maxtries )")
+    n_blocks = int(params[0])
+    script = address_to_script(params[1], node.params)
+    if script is None:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                       "Error: Invalid address or script")
+    max_tries = int(params[2]) if len(params) > 2 else MAX_TRIES_DEFAULT
+    hashes = node.generate_to_script(script, n_blocks, max_tries)
+    return [hash_to_hex(h) for h in hashes]
+
+
+@rpc_method("getblocktemplate")
+def getblocktemplate(node, params):
+    """getblocktemplate (src/rpc/mining.cpp:~350) — BIP22 shape, no longpoll
+    blocking (template_request 'longpollid' returns the current template)."""
+    tmpl = node.assembler().create_new_block(script_pubkey=b"\x51")  # OP_TRUE placeholder
+    block = tmpl.block
+    cs = node.chainstate
+    tip = cs.tip()
+    txs = []
+    txid_to_pos = {}
+    for i, tx in enumerate(block.vtx[1:], start=1):
+        txid_to_pos[tx.txid] = i
+        depends = sorted(
+            txid_to_pos[vin.prevout.hash]
+            for vin in tx.vin
+            if vin.prevout.hash in txid_to_pos
+        )
+        txs.append({
+            "data": tx.serialize().hex(),
+            "txid": tx.txid_hex,
+            "hash": tx.txid_hex,
+            "depends": depends,
+            "fee": tmpl.fees[i],
+            "sigops": 0,
+        })
+    return {
+        "capabilities": ["proposal"],
+        "version": block.header.version,
+        "previousblockhash": hash_to_hex(tip.hash),
+        "transactions": txs,
+        "coinbaseaux": {"flags": ""},
+        "coinbasevalue": block.vtx[0].total_output_value(),
+        "longpollid": hash_to_hex(tip.hash) + f"{node.mempool.sequence}",
+        "target": f"{tmpl.target:064x}",
+        "mintime": tip.get_median_time_past() + 1,
+        "mutable": ["time", "transactions", "prevblock"],
+        "noncerange": "00000000ffffffff",
+        "sigoplimit": node.params.max_block_sigops,
+        "sizelimit": node.params.max_block_size,
+        "curtime": block.header.time,
+        "bits": f"{block.header.bits:08x}",
+        "height": tmpl.height,
+    }
+
+
+@rpc_method("submitblock")
+def submitblock(node, params):
+    require_params(params, 1, 2, "submitblock \"hexdata\" ( \"dummy\" )")
+    try:
+        block = CBlock.from_bytes(bytes.fromhex(params[0]))
+    except Exception:
+        raise RPCError(RPC_DESERIALIZATION_ERROR, "Block decode failed") from None
+    return node.submit_block(block)  # None on success, reason string otherwise
+
+
+@rpc_method("getmininginfo")
+def getmininginfo(node, params):
+    cs = node.chainstate
+    tip = cs.tip()
+    return {
+        "blocks": tip.height,
+        "currentblocksize": 0,
+        "currentblocktx": 0,
+        "difficulty": difficulty_from_bits(tip.header.bits),
+        "networkhashps": getnetworkhashps(node, []),
+        "pooledtx": len(node.mempool),
+        "chain": node.params.network,
+    }
+
+
+@rpc_method("getnetworkhashps")
+def getnetworkhashps(node, params):
+    """GetNetworkHashPS: work over the last nblocks' wall time."""
+    n_blocks = int(params[0]) if params else 120
+    cs = node.chainstate
+    tip = cs.tip()
+    if tip is None or tip.height == 0:
+        return 0
+    n_blocks = min(n_blocks if n_blocks > 0 else tip.height, tip.height)
+    first = cs.chain[tip.height - n_blocks]
+    time_diff = tip.time - first.time
+    if time_diff <= 0:
+        return 0
+    return (tip.chain_work - first.chain_work) / time_diff
+
+
+@rpc_method("prioritisetransaction")
+def prioritisetransaction(node, params):
+    return True  # accepted, no-op: fee deltas are not modelled

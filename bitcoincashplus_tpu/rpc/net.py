@@ -1,0 +1,73 @@
+"""Network RPCs.
+
+Reference: src/rpc/net.cpp (getconnectioncount, getpeerinfo, getnettotals,
+addnode, getnetworkinfo). Backed by p2p/connman when P2P is running; a
+node without P2P reports zero peers, like a -connect=0 reference node.
+"""
+
+from __future__ import annotations
+
+from .registry import RPC_INVALID_PARAMETER, RPCError, require_params, rpc_method
+
+PROTOCOL_VERSION = 70015
+SUBVERSION = "/bcpd-tpu:0.3.0/"
+
+
+@rpc_method("getconnectioncount")
+def getconnectioncount(node, params):
+    return len(node.connman.peers) if node.connman else 0
+
+
+@rpc_method("getpeerinfo")
+def getpeerinfo(node, params):
+    if node.connman is None:
+        return []
+    return [peer.info() for peer in node.connman.peers.values()]
+
+
+@rpc_method("getnettotals")
+def getnettotals(node, params):
+    cm = node.connman
+    return {
+        "totalbytesrecv": cm.bytes_recv if cm else 0,
+        "totalbytessent": cm.bytes_sent if cm else 0,
+    }
+
+
+@rpc_method("getnetworkinfo")
+def getnetworkinfo(node, params):
+    return {
+        "version": 30000,
+        "subversion": SUBVERSION,
+        "protocolversion": PROTOCOL_VERSION,
+        "localservices": "0000000000000001",
+        "timeoffset": 0,
+        "connections": len(node.connman.peers) if node.connman else 0,
+        "networkactive": node.connman is not None,
+        "relayfee": node.min_relay_fee_rate / 1e8,
+        "warnings": "",
+    }
+
+
+@rpc_method("addnode")
+def addnode(node, params):
+    require_params(params, 2, 2, "addnode \"node\" \"add|remove|onetry\"")
+    if node.connman is None:
+        raise RPCError(RPC_INVALID_PARAMETER, "P2P is not enabled")
+    target, cmd = params[0], params[1]
+    if cmd in ("add", "onetry"):
+        host, _, port = target.rpartition(":")
+        node.connman.connect_to(host or "127.0.0.1", int(port))
+    elif cmd == "remove":
+        node.connman.disconnect(target)
+    else:
+        raise RPCError(RPC_INVALID_PARAMETER, f"unknown command {cmd!r}")
+    return None
+
+
+@rpc_method("disconnectnode")
+def disconnectnode(node, params):
+    require_params(params, 1, 1, "disconnectnode \"address\"")
+    if node.connman is not None:
+        node.connman.disconnect(params[0])
+    return None

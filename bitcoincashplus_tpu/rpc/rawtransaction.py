@@ -1,0 +1,200 @@
+"""Raw-transaction RPCs.
+
+Reference: src/rpc/rawtransaction.cpp (sendrawtransaction,
+getrawtransaction, decoderawtransaction, createrawtransaction),
+src/core_io.h (TxToUniv / ScriptPubKeyToUniv decoding shapes).
+"""
+
+from __future__ import annotations
+
+from ..consensus.serialize import hash_to_hex, hex_to_hash
+from ..consensus.tx import COutPoint, CTransaction, CTxIn, CTxOut
+from ..mempool.mempool import MempoolError
+from ..script.script import classify_script, get_script_ops, push_data
+from ..wallet.keys import script_to_address
+from .registry import (
+    RPC_DESERIALIZATION_ERROR,
+    RPC_INVALID_ADDRESS_OR_KEY,
+    RPC_INVALID_PARAMETER,
+    RPC_VERIFY_ALREADY_IN_CHAIN,
+    RPC_VERIFY_REJECTED,
+    RPCError,
+    param_hash,
+    require_params,
+    rpc_method,
+)
+
+
+def script_asm(script: bytes) -> str:
+    """ScriptToAsmStr (src/core_io): best-effort opcode/data rendering."""
+    from ..script.script import OPCODE_NAMES
+
+    parts = []
+    try:
+        for op, data, _ in get_script_ops(script):
+            if data is not None:
+                parts.append(data.hex() if data else "0")
+            else:
+                parts.append(OPCODE_NAMES.get(op, f"OP_UNKNOWN_{op:#x}"))
+    except Exception:
+        parts.append("[error]")
+    return " ".join(parts)
+
+
+def script_pubkey_json(node, script: bytes) -> dict:
+    out = {
+        "asm": script_asm(script),
+        "hex": script.hex(),
+        "type": classify_script(script),
+    }
+    addr = script_to_address(script, node.params)
+    if addr is not None:
+        out["addresses"] = [addr]
+    return out
+
+
+def tx_to_json(node, tx: CTransaction, block_hash: bytes = None) -> dict:
+    out = {
+        "txid": tx.txid_hex,
+        "hash": tx.txid_hex,
+        "version": tx.version,
+        "size": tx.size(),
+        "locktime": tx.locktime,
+        "vin": [],
+        "vout": [],
+        "hex": tx.serialize().hex(),
+    }
+    for txin in tx.vin:
+        if tx.is_coinbase():
+            out["vin"].append({
+                "coinbase": txin.script_sig.hex(),
+                "sequence": txin.sequence,
+            })
+        else:
+            out["vin"].append({
+                "txid": hash_to_hex(txin.prevout.hash),
+                "vout": txin.prevout.n,
+                "scriptSig": {"asm": script_asm(txin.script_sig),
+                              "hex": txin.script_sig.hex()},
+                "sequence": txin.sequence,
+            })
+    for n, txout in enumerate(tx.vout):
+        out["vout"].append({
+            "value": txout.value / 1e8,
+            "n": n,
+            "scriptPubKey": script_pubkey_json(node, txout.script_pubkey),
+        })
+    if block_hash is not None:
+        idx = node.chainstate.block_index.get(block_hash)
+        if idx is not None and idx in node.chainstate.chain:
+            out["blockhash"] = hash_to_hex(block_hash)
+            out["confirmations"] = node.chainstate.chain.height() - idx.height + 1
+            out["time"] = out["blocktime"] = idx.header.time
+    return out
+
+
+def _parse_tx_hex(hex_str) -> CTransaction:
+    try:
+        return CTransaction.from_bytes(bytes.fromhex(hex_str))
+    except Exception:
+        raise RPCError(RPC_DESERIALIZATION_ERROR, "TX decode failed") from None
+
+
+@rpc_method("sendrawtransaction")
+def sendrawtransaction(node, params):
+    require_params(params, 1, 2, "sendrawtransaction \"hexstring\" ( allowhighfees )")
+    tx = _parse_tx_hex(params[0])
+    txid = tx.txid
+    if txid not in node.mempool:
+        # already confirmed? (reference: RPC_VERIFY_ALREADY_IN_CHAIN)
+        if node.chainstate.coins.get_coin(COutPoint(txid, 0)) is not None:
+            raise RPCError(RPC_VERIFY_ALREADY_IN_CHAIN,
+                           "transaction already in block chain")
+        try:
+            node.accept_to_mempool(tx)
+        except MempoolError as e:
+            raise RPCError(RPC_VERIFY_REJECTED,
+                           f"{e.reason} {e.detail}".strip()) from None
+    if node.connman is not None:
+        node.connman.relay_tx(txid)
+    return tx.txid_hex
+
+
+@rpc_method("getrawtransaction")
+def getrawtransaction(node, params):
+    require_params(params, 1, 2, "getrawtransaction \"txid\" ( verbose )")
+    txid = param_hash(params, 0)
+    verbose = params[1] if len(params) > 1 else False
+    tx = node.mempool.get_tx(txid)
+    block_hash = None
+    if tx is None:
+        block_hash = node.txindex_lookup(txid) if node.txindex else None
+        if block_hash is not None:
+            block = node.chainstate.get_block(block_hash)
+            if block is not None:
+                for cand in block.vtx:
+                    if cand.txid == txid:
+                        tx = cand
+                        break
+    if tx is None:
+        raise RPCError(
+            RPC_INVALID_ADDRESS_OR_KEY,
+            "No such mempool transaction. Use -txindex to enable "
+            "blockchain transaction queries.",
+        )
+    if not verbose:
+        return tx.serialize().hex()
+    return tx_to_json(node, tx, block_hash)
+
+
+@rpc_method("decoderawtransaction")
+def decoderawtransaction(node, params):
+    require_params(params, 1, 1, "decoderawtransaction \"hexstring\"")
+    tx = _parse_tx_hex(params[0])
+    out = tx_to_json(node, tx)
+    del out["hex"]
+    return out
+
+
+@rpc_method("createrawtransaction")
+def createrawtransaction(node, params):
+    """createrawtransaction [{"txid","vout"},...] {"address":amount,...}"""
+    require_params(params, 2, 3, "createrawtransaction inputs outputs ( locktime )")
+    inputs, outputs = params[0], params[1]
+    locktime = int(params[2]) if len(params) > 2 else 0
+    vin = []
+    for inp in inputs:
+        sequence = int(inp.get("sequence", 0xFFFFFFFF if locktime == 0 else 0xFFFFFFFE))
+        vin.append(CTxIn(COutPoint(hex_to_hash(inp["txid"]), int(inp["vout"])),
+                         b"", sequence))
+    vout = []
+    from ..wallet.keys import address_to_script
+
+    for addr, amount in outputs.items():
+        if addr == "data":
+            from ..script.script import null_data_script
+
+            vout.append(CTxOut(0, null_data_script(bytes.fromhex(amount))))
+            continue
+        script = address_to_script(addr, node.params)
+        if script is None:
+            raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, f"Invalid address: {addr}")
+        vout.append(CTxOut(int(round(float(amount) * 1e8)), script))
+    tx = CTransaction(version=1, vin=tuple(vin), vout=tuple(vout), locktime=locktime)
+    return tx.serialize().hex()
+
+
+@rpc_method("decodescript")
+def decodescript(node, params):
+    require_params(params, 1, 1, "decodescript \"hexstring\"")
+    try:
+        script = bytes.fromhex(params[0])
+    except ValueError:
+        raise RPCError(RPC_INVALID_PARAMETER, "argument must be hexadecimal string") from None
+    out = script_pubkey_json(node, script)
+    del out["hex"]  # reference omits hex in decodescript
+    from ..crypto.hashes import hash160
+    from ..script.script import p2sh_script
+
+    out["p2sh"] = script_to_address(p2sh_script(hash160(script)), node.params)
+    return out

@@ -472,3 +472,16 @@ def find_and_delete(script: bytes, elem: bytes) -> bytes:
             pc += size
         out += script[start : min(pc, end)]
     return bytes(out)
+
+
+# ---- opcode names (GetOpName, src/script/script.cpp) ----
+
+OPCODE_NAMES: dict[int, str] = {
+    v: k
+    for k, v in sorted(globals().items())
+    if k.startswith("OP_") and isinstance(v, int)
+}
+# canonical spellings where aliases exist
+OPCODE_NAMES[0x00] = "0"
+OPCODE_NAMES[0x51] = "OP_1"
+OPCODE_NAMES[0x87] = "OP_EQUAL"

@@ -15,8 +15,11 @@ from typing import Iterator, Optional
 
 class KVStore:
     def __init__(self, path: str):
-        # isolation_level=None -> explicit transaction control
-        self._db = sqlite3.connect(path, isolation_level=None)
+        # isolation_level=None -> explicit transaction control.
+        # check_same_thread=False: RPC worker threads reach the store, but
+        # every access serializes under the node's cs_main lock.
+        self._db = sqlite3.connect(path, isolation_level=None,
+                                   check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
         self._db.execute("PRAGMA synchronous=NORMAL")
         self._db.execute(

@@ -27,6 +27,7 @@ from ..consensus.serialize import hash_to_hex
 from ..consensus.tx import COutPoint, CTransaction, money_range
 from ..consensus.tx_check import TxValidationError, check_transaction, is_final_tx
 from ..script.script import script_int
+from ..util.log import log_print
 from .chain import BlockStatus, CBlockIndex, CChain
 from .coins import BlockUndo, CoinsCache, CoinsView, TxUndo, add_coins
 
@@ -59,6 +60,7 @@ class ChainstateManager:
         block_store,
         script_verifier=_DEFAULT,
         get_time: Callable[[], int] = lambda: int(_time.time()),
+        index_db=None,
     ):
         if script_verifier is _DEFAULT:
             from .scriptcheck import BlockScriptVerifier
@@ -69,11 +71,14 @@ class ChainstateManager:
         self.block_index: dict[bytes, CBlockIndex] = {}
         self.coins = CoinsCache(coins_base)
         self.block_store = block_store
+        self.index_db = index_db  # BlockIndexDB or None (ephemeral nodes)
         self.script_verifier = script_verifier
         self.get_time = get_time
         self._candidates: set[CBlockIndex] = set()  # setBlockIndexCandidates
         self._seq = 0
         self._invalid: set[CBlockIndex] = set()
+        # setDirtyBlockIndex analogue: indexes whose on-disk record is stale
+        self._dirty_index: set[CBlockIndex] = set()
         # mapBlocksUnlinked analogue: children with data whose ancestor path
         # is missing data; relinked when the gap block arrives.
         self._unlinked: dict[CBlockIndex, list[CBlockIndex]] = {}
@@ -81,6 +86,13 @@ class ChainstateManager:
         self.on_block_connected: list[Callable] = []
         self.on_block_disconnected: list[Callable] = []
         self.on_tip_changed: list[Callable] = []
+        # cumulative ConnectBlock phase timings (ms) — the reference's
+        # nTimeCheck/nTimeConnect/nTimeVerify/nTimeFlush statics
+        # (src/validation.cpp:~1950-2080), surfaced via -debug=bench
+        self.bench = {
+            "check_ms": 0.0, "connect_ms": 0.0, "verify_ms": 0.0,
+            "flush_ms": 0.0, "index_ms": 0.0, "blocks": 0,
+        }
         self._init_genesis()
 
     # ------------------------------------------------------------------
@@ -97,6 +109,7 @@ class ChainstateManager:
         idx.n_tx = len(genesis.vtx)
         idx.chain_tx = idx.n_tx
         self.block_index[gh] = idx
+        self._dirty_index.add(idx)
         best = self.coins.best_block()
         if best == b"\x00" * 32:
             # fresh chainstate: connect genesis outputs
@@ -104,7 +117,62 @@ class ChainstateManager:
             for tx in genesis.vtx:
                 add_coins(self.coins, tx, 0)
             self.coins.set_best_block(gh)
-        # (restart-resume via LoadBlockIndex lives in store/; node/ calls it)
+        # warm chainstate: the tip is restored by load_block_index(), which
+        # the node runtime calls right after construction (LoadBlockIndexDB)
+
+    def load_block_index(self) -> bool:
+        """LoadBlockIndexDB (src/validation.cpp): rebuild the in-memory block
+        tree, block-file positions, chain tip, and connect candidates from the
+        index DB + the coins DB's best-block marker. Returns False when there
+        is nothing to load (fresh datadir). Call once, right after __init__."""
+        if self.index_db is None:
+            return False
+        entries = sorted(self.index_db.iterate_index(), key=lambda e: e[2])
+        if not entries:
+            return False
+        max_seq = 0
+        for h, header, height, status, n_tx, blkpos, undopos in entries:
+            idx = self.block_index.get(h)
+            if idx is None:
+                prev = self.block_index.get(header.hash_prev_block)
+                if prev is None and height != 0:
+                    # orphaned index row (ancestor never flushed) — skip; the
+                    # block data, if any, is recoverable via -reindex
+                    continue
+                idx = CBlockIndex(header, h, prev)
+                self.block_index[h] = idx
+            idx.status = BlockStatus(status)
+            idx.n_tx = n_tx
+            self._seq = max_seq = max_seq + 1
+            idx.sequence_id = max_seq
+            if idx.status & BlockStatus.HAVE_DATA:
+                base = idx.prev.chain_tx if idx.prev is not None else 0
+                if base > 0 or idx.prev is None:
+                    idx.chain_tx = base + idx.n_tx
+                else:
+                    # repopulate mapBlocksUnlinked: data present but an
+                    # ancestor's data is missing
+                    self._unlinked.setdefault(idx.prev, []).append(idx)
+            if blkpos is not None and hasattr(self.block_store, "positions"):
+                self.block_store.positions[h] = blkpos
+            if undopos is not None and hasattr(self.block_store, "undo_positions"):
+                self.block_store.undo_positions[h] = undopos
+        best = self.coins.best_block()
+        tip = self.block_index.get(best)
+        if tip is None:
+            raise BlockValidationError(
+                "chainstate-corrupt",
+                f"best block {hash_to_hex(best)} not in block index (reindex required)",
+            )
+        self.chain.set_tip(tip)
+        for idx in self.block_index.values():
+            if not (idx.status & BlockStatus.FAILED_MASK):
+                self._try_add_candidate(idx)
+            else:
+                self._invalid.add(idx)
+        log_print("db", "LoadBlockIndexDB: %d entries, tip height %d",
+                  len(entries), tip.height)
+        return True
 
     # ------------------------------------------------------------------
     # context-free checks
@@ -218,6 +286,7 @@ class ChainstateManager:
         idx.sequence_id = self._seq
         idx.raise_validity(BlockStatus.VALID_TREE)
         self.block_index[h] = idx
+        self._dirty_index.add(idx)
         return idx
 
     def accept_block(self, block: CBlock) -> CBlockIndex:
@@ -233,6 +302,7 @@ class ChainstateManager:
         idx.status |= BlockStatus.HAVE_DATA
         self.block_store.put_block(idx.hash, block.serialize())
         self._link_chain_tx(idx)
+        self._dirty_index.add(idx)
         return idx
 
     def _link_chain_tx(self, idx: CBlockIndex):
@@ -343,7 +413,9 @@ class ChainstateManager:
         if check_scripts and self.script_verifier is not None:
             # Deferred batch verification — the CCheckQueue replacement:
             # one call, one TPU dispatch (SURVEY.md §4.2 graft point).
+            tv = _time.perf_counter()
             self.script_verifier(block, idx, spent_per_tx)
+            self.bench["verify_ms"] += (_time.perf_counter() - tv) * 1e3
 
         self.coins.set_best_block(idx.hash)
         return undo
@@ -423,9 +495,26 @@ class ChainstateManager:
                 return False
         return True
 
+    def script_checks_needed(self, idx: CBlockIndex) -> bool:
+        """The fScriptChecks assumevalid gate (src/validation.cpp ConnectBlock):
+        skip script verification for ancestors of the assume_valid block,
+        provided that block is in our index and carries at least the params'
+        minimum chain work — the single biggest reindex accelerator
+        (SURVEY.md §6.4)."""
+        av = self.params.assume_valid
+        if not av:
+            return True
+        av_idx = self.block_index.get(av)
+        if av_idx is None or not av_idx.is_valid(BlockStatus.VALID_TREE):
+            return True
+        if av_idx.chain_work < self.params.minimum_chain_work:
+            return True
+        return av_idx.get_ancestor(idx.height) is not idx
+
     def _connect_tip(self, idx: CBlockIndex) -> bool:
         """ConnectTip: load block, connect, update chain; on failure mark
         the subtree invalid and return False."""
+        t0 = _time.perf_counter()
         raw = self.block_store.get_block(idx.hash)
         if raw is None:
             # Should be unreachable (chain_tx gating), but recover rather
@@ -434,19 +523,40 @@ class ChainstateManager:
             self._candidates.discard(idx)
             return False
         block = CBlock.from_bytes(raw)
+        t1 = _time.perf_counter()
+        check_scripts = self.script_checks_needed(idx)
         scratch = CoinsCache(self.coins)
         try:
-            undo = self.connect_block(block, idx, view=scratch)
+            undo = self.connect_block(block, idx, check_scripts=check_scripts,
+                                      view=scratch)
         except BlockValidationError:
             self._mark_invalid(idx)
             return False  # scratch layer dropped; earlier edits untouched
+        t2 = _time.perf_counter()
         scratch.flush()  # merge into the long-lived cache
         self.block_store.put_undo(idx.hash, undo.serialize())
         idx.status |= BlockStatus.HAVE_UNDO
         idx.raise_validity(
-            BlockStatus.VALID_SCRIPTS if self.script_verifier else BlockStatus.VALID_CHAIN
+            BlockStatus.VALID_SCRIPTS
+            if (self.script_verifier and check_scripts)
+            else BlockStatus.VALID_CHAIN
         )
+        self._dirty_index.add(idx)
         self.chain.set_tip(idx)
+        t3 = _time.perf_counter()
+        b = self.bench
+        b["check_ms"] += (t1 - t0) * 1e3
+        b["connect_ms"] += (t2 - t1) * 1e3
+        b["flush_ms"] += (t3 - t2) * 1e3
+        b["blocks"] += 1
+        log_print(
+            "bench",
+            "ConnectBlock %s height=%d txs=%d: read %.2fms connect %.2fms "
+            "post %.2fms [cum: check %.2fms connect %.2fms flush %.2fms]",
+            hash_to_hex(idx.hash)[:16], idx.height, len(block.vtx),
+            (t1 - t0) * 1e3, (t2 - t1) * 1e3, (t3 - t2) * 1e3,
+            b["check_ms"], b["connect_ms"], b["flush_ms"],
+        )
         for cb in self.on_block_connected:
             cb(block, idx)
         return True
@@ -460,6 +570,7 @@ class ChainstateManager:
         scratch = CoinsCache(self.coins)
         self.disconnect_block(block, tip, BlockUndo.from_bytes(undo_raw), view=scratch)
         scratch.flush()
+        self._dirty_index.add(tip)
         self.chain.set_tip(tip.prev)
         self._try_add_candidate(tip)  # it may become best again later
         for cb in self.on_block_disconnected:
@@ -467,18 +578,20 @@ class ChainstateManager:
         return True
 
     def _mark_invalid(self, idx: CBlockIndex):
-        """InvalidBlockFound: FAILED_VALID on idx, FAILED_CHILD on descendants."""
+        """InvalidBlockFound: FAILED_VALID on idx, FAILED_CHILD on descendants.
+        Uses the O(log n) get_ancestor skip-list per index rather than a
+        linear prev-walk (round-1/2 weak-item fix)."""
         idx.status |= BlockStatus.FAILED_VALID
         self._invalid.add(idx)
         self._candidates.discard(idx)
+        self._dirty_index.add(idx)
         for other in self.block_index.values():
-            walk = other
-            while walk is not None and walk.height >= idx.height:
-                if walk is idx and other is not idx:
-                    other.status |= BlockStatus.FAILED_CHILD
-                    self._candidates.discard(other)
-                    break
-                walk = walk.prev
+            if other is idx or other.height <= idx.height:
+                continue
+            if other.get_ancestor(idx.height) is idx:
+                other.status |= BlockStatus.FAILED_CHILD
+                self._candidates.discard(other)
+                self._dirty_index.add(other)
 
     def _prune_candidates(self):
         tip = self.chain.tip()
@@ -516,22 +629,45 @@ class ChainstateManager:
         self.activate_best_chain()
 
     def reconsider_block(self, idx: CBlockIndex) -> None:
-        """ResetBlockFailureFlags analogue."""
+        """ResetBlockFailureFlags analogue (skip-list descendant test)."""
         for other in list(self.block_index.values()):
-            walk = other
-            while walk is not None:
-                if walk is idx:
-                    other.status &= ~BlockStatus.FAILED_MASK
-                    self._invalid.discard(other)
-                    self._try_add_candidate(other)
-                    break
-                walk = walk.prev
+            if other is idx or (
+                other.height >= idx.height and other.get_ancestor(idx.height) is idx
+            ):
+                other.status &= ~BlockStatus.FAILED_MASK
+                self._invalid.discard(other)
+                self._try_add_candidate(other)
+                self._dirty_index.add(other)
         self.activate_best_chain()
 
     def flush(self) -> None:
-        """FlushStateToDisk: batch-write the coins cache + best-block marker."""
-        self.coins.flush()
+        """FlushStateToDisk (src/validation.cpp:~1900). Write ordering is the
+        crash-safety contract (SURVEY.md §6.3): (1) fsync block/undo files,
+        (2) batch-write dirty block-index entries, (3) batch-write the coins
+        cache + best-block marker in one transaction. A crash between (2) and
+        (3) leaves index entries ahead of the chainstate; on restart those
+        blocks are re-activated from their on-disk data."""
+        t0 = _time.perf_counter()
         self.block_store.flush()
+        if self.index_db is not None and self._dirty_index:
+            positions = getattr(self.block_store, "positions", {})
+            undo_positions = getattr(self.block_store, "undo_positions", {})
+            entries = [
+                (
+                    idx.hash,
+                    idx.header.serialize(),
+                    idx.height,
+                    int(idx.status),
+                    idx.n_tx,
+                    positions.get(idx.hash),
+                    undo_positions.get(idx.hash),
+                )
+                for idx in self._dirty_index
+            ]
+            self.index_db.put_index_batch(entries)
+            self._dirty_index.clear()
+        self.coins.flush()
+        self.bench["index_ms"] += (_time.perf_counter() - t0) * 1e3
 
     # -- queries used by RPC / mining --
 
