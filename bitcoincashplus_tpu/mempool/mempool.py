@@ -25,6 +25,7 @@ class MempoolError(Exception):
 
     def __init__(self, reason: str, detail: str = ""):
         self.reason = reason
+        self.detail = detail
         super().__init__(f"{reason}{': ' + detail if detail else ''}")
 
 

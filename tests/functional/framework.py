@@ -1,0 +1,169 @@
+"""Functional-test framework: spawn REAL bcpd processes on regtest and
+drive them over RPC — process-level multi-node on localhost.
+
+Reference: qa/rpc-tests/test_framework/test_framework.py
+(BitcoinTestFramework: start_nodes, stop_nodes), util.py (connect_nodes,
+sync_blocks, sync_mempools, assert_equal). SURVEY.md §5.2: "This is how
+multi-node is tested without a cluster."
+
+Nodes run with JAX_PLATFORMS=cpu (process spawn cost; kernel-vs-device
+behavior is covered by the unit suite and the driver's bench run).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestNode:
+    """One bcpd process + its RPC client."""
+
+    def __init__(self, index: int, base_dir: str, extra_args: list[str] = ()):
+        self.index = index
+        self.datadir_base = os.path.join(base_dir, f"node{index}")
+        os.makedirs(self.datadir_base, exist_ok=True)
+        self.datadir = os.path.join(self.datadir_base, "regtest")
+        self.rpc_port = _free_port()
+        self.p2p_port = _free_port()
+        self.extra_args = list(extra_args)
+        self.process: subprocess.Popen | None = None
+        self.rpc = None
+
+    def args(self, extra: list[str] = ()) -> list[str]:
+        return [
+            sys.executable, "-m", "bitcoincashplus_tpu.cli.bcpd",
+            "-regtest", f"-datadir={self.datadir_base}",
+            f"-rpcport={self.rpc_port}", f"-port={self.p2p_port}",
+            "-flushinterval=8",
+            *self.extra_args, *extra,
+        ]
+
+    def start(self, extra: list[str] = (), timeout: float = 120.0) -> None:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        self.process = subprocess.Popen(
+            self.args(extra), env=env, cwd=REPO_ROOT,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        self.wait_for_rpc(timeout)
+
+    def wait_for_rpc(self, timeout: float = 120.0) -> None:
+        from bitcoincashplus_tpu.rpc.client import RPCClient
+
+        deadline = time.time() + timeout
+        last_err = None
+        while time.time() < deadline:
+            if self.process.poll() is not None:
+                out, err = self.process.communicate()
+                raise RuntimeError(
+                    f"node{self.index} died at startup:\n{err.decode()[-2000:]}"
+                )
+            try:
+                self.rpc = RPCClient(port=self.rpc_port, datadir=self.datadir,
+                                     timeout=60.0)
+                self.rpc.getblockcount()
+                return
+            except Exception as e:  # cookie not written / socket refused yet
+                last_err = e
+                time.sleep(0.25)
+        raise TimeoutError(f"node{self.index} RPC not ready: {last_err!r}")
+
+    def stop(self, timeout: float = 60.0) -> None:
+        if self.process is None:
+            return
+        try:
+            self.rpc.stop()
+        except Exception:
+            self.process.terminate()
+        try:
+            self.process.wait(timeout)
+        except subprocess.TimeoutExpired:
+            self.process.kill()
+            self.process.wait(10)
+        self.process = None
+
+    def kill9(self) -> None:
+        """Simulate a crash — no flush, no orderly shutdown."""
+        self.process.kill()
+        self.process.wait(10)
+        self.process = None
+
+
+class FunctionalFramework:
+    """Context manager owning N nodes and a scratch directory."""
+
+    def __init__(self, num_nodes: int = 1, extra_args=None):
+        self.num_nodes = num_nodes
+        self.extra_args = extra_args or [[] for _ in range(num_nodes)]
+        self.base_dir = tempfile.mkdtemp(prefix="bcp_func_")
+        self.nodes = [
+            TestNode(i, self.base_dir, self.extra_args[i])
+            for i in range(num_nodes)
+        ]
+
+    def __enter__(self):
+        for node in self.nodes:
+            node.start()
+        return self
+
+    def __exit__(self, *exc):
+        for node in self.nodes:
+            try:
+                node.stop()
+            except Exception:
+                pass
+        shutil.rmtree(self.base_dir, ignore_errors=True)
+
+
+# -- sync barriers (test_framework/util.py) ----------------------------
+
+
+def connect_nodes(a: TestNode, b: TestNode) -> None:
+    a.rpc.addnode(f"127.0.0.1:{b.p2p_port}", "onetry")
+    wait_until(lambda: a.rpc.getconnectioncount() >= 1
+               and b.rpc.getconnectioncount() >= 1, timeout=30)
+
+
+def sync_blocks(nodes, timeout: float = 60.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        tips = {n.rpc.getbestblockhash() for n in nodes}
+        if len(tips) == 1:
+            return
+        time.sleep(0.25)
+    raise TimeoutError(f"sync_blocks: tips diverged: "
+                       f"{[n.rpc.getbestblockhash() for n in nodes]}")
+
+
+def sync_mempools(nodes, timeout: float = 60.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        pools = [set(n.rpc.getrawmempool()) for n in nodes]
+        if all(p == pools[0] for p in pools):
+            return
+        time.sleep(0.25)
+    raise TimeoutError("sync_mempools timed out")
+
+
+def wait_until(predicate, timeout: float = 30.0, sleep: float = 0.25) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(sleep)
+    raise TimeoutError("wait_until timed out")

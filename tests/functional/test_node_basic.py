@@ -1,0 +1,193 @@
+"""Single-node functional tests over a REAL bcpd process.
+
+Covers the VERDICT round-2 'done =' bar for the node runtime: a node
+process starts on regtest, mines via RPC, serves a template, accepts a
+submitted block, answers a second client, accepts a raw transaction into
+its mempool and mines it, and resumes cleanly across clean restart,
+kill -9, and -reindex.
+
+Reference behaviors: qa/rpc-tests (mining_*.py, rawtransactions.py,
+reindex.py, abandonconflict-style mempool checks).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from bitcoincashplus_tpu.consensus.serialize import hex_to_hash
+from bitcoincashplus_tpu.consensus.tx import (
+    COutPoint,
+    CTransaction,
+    CTxIn,
+    CTxOut,
+)
+from bitcoincashplus_tpu.rpc.client import JSONRPCException, RPCClient
+from bitcoincashplus_tpu.script.sighash import SIGHASH_ALL
+from bitcoincashplus_tpu.wallet.keys import CKey
+from bitcoincashplus_tpu.wallet.signing import sign_transaction
+
+from .framework import FunctionalFramework
+
+pytestmark = pytest.mark.functional
+
+KEY = CKey(0x1EAF)
+
+
+def _regtest_address(key: CKey) -> str:
+    from bitcoincashplus_tpu.consensus.params import regtest_params
+
+    return key.p2pkh_address(regtest_params())
+
+
+def _mine_template(tmpl: dict, payout_address: str):
+    """Assemble + CPU-mine a block from a getblocktemplate result — an
+    external miner exercising the BIP22 contract."""
+    from bitcoincashplus_tpu.consensus.block import CBlock, CBlockHeader
+    from bitcoincashplus_tpu.consensus.merkle import compute_merkle_root
+    from bitcoincashplus_tpu.consensus.params import regtest_params
+    from bitcoincashplus_tpu.consensus.pow import check_proof_of_work
+    from bitcoincashplus_tpu.mining.assembler import bip34_coinbase_script_sig
+    from bitcoincashplus_tpu.wallet.keys import address_to_script
+
+    params = regtest_params()
+    coinbase = CTransaction(
+        vin=(CTxIn(COutPoint(), bip34_coinbase_script_sig(tmpl["height"]),
+                   0xFFFFFFFF),),
+        vout=(CTxOut(tmpl["coinbasevalue"],
+                     address_to_script(payout_address, params)),),
+    )
+    vtx = (coinbase,
+           *(CTransaction.from_bytes(bytes.fromhex(t["data"]))
+             for t in tmpl["transactions"]))
+    root, _ = compute_merkle_root([tx.txid for tx in vtx])
+    header = CBlockHeader(
+        version=tmpl["version"],
+        hash_prev_block=hex_to_hash(tmpl["previousblockhash"]),
+        hash_merkle_root=root,
+        time=tmpl["curtime"],
+        bits=int(tmpl["bits"], 16),
+        nonce=0,
+    )
+    for nonce in range(1 << 20):  # regtest difficulty: a few tries suffice
+        h = header.with_nonce(nonce)
+        if check_proof_of_work(h.get_hash(), h.bits, params.consensus):
+            return CBlock(h, vtx)
+    raise AssertionError("failed to mine template")
+
+
+def _spend_coinbase(node, coinbase_txid_hex: str, to_key: CKey, amount: int,
+                    fee: int = 2000) -> str:
+    """Build + sign a P2PKH spend of a (mature) coinbase output."""
+    cb = node.rpc.getrawtransaction(coinbase_txid_hex, True)
+    value = int(round(cb["vout"][0]["value"] * 1e8))
+    spk = bytes.fromhex(cb["vout"][0]["scriptPubKey"]["hex"])
+    tx = CTransaction(
+        vin=(CTxIn(COutPoint(hex_to_hash(coinbase_txid_hex), 0)),),
+        vout=(CTxOut(amount, to_key.p2pkh_script()),
+              CTxOut(value - amount - fee, KEY.p2pkh_script())),
+    )
+    signed = sign_transaction(
+        tx, [(spk, value)],
+        lambda ident: KEY if ident == KEY.pubkey_hash else None,
+        SIGHASH_ALL,
+        enable_forkid=True,  # regtest uahf_height=0: FORKID is standard
+    )
+    return signed.serialize().hex()
+
+
+def test_single_node_end_to_end():
+    with FunctionalFramework(num_nodes=1,
+                             extra_args=[["-txindex", "-listen=0"]]) as f:
+        node = f.nodes[0]
+        params_addr = _regtest_address(KEY)
+
+        # -- mine via RPC ------------------------------------------------
+        assert node.rpc.getblockcount() == 0
+        hashes = node.rpc.generatetoaddress(101, params_addr)
+        assert len(hashes) == 101
+        assert node.rpc.getblockcount() == 101
+        info = node.rpc.getblockchaininfo()
+        assert info["blocks"] == 101 and info["chain"] == "regtest"
+
+        # -- second concurrent client ------------------------------------
+        second = RPCClient(port=node.rpc_port, datadir=node.datadir)
+        assert second.getbestblockhash() == node.rpc.getbestblockhash()
+
+        # -- raw tx into the mempool -------------------------------------
+        block1 = node.rpc.getblock(hashes[0], 2)
+        coinbase_txid = block1["tx"][0]["txid"]
+        raw = _spend_coinbase(node, coinbase_txid, CKey(0xBEEF), 10_0000_0000)
+        txid = node.rpc.sendrawtransaction(raw)
+        assert txid in node.rpc.getrawmempool()
+        entry = node.rpc.getmempoolentry(txid)
+        assert entry["ancestorcount"] == 1
+
+        # double-spend conflict is rejected
+        raw2 = _spend_coinbase(node, coinbase_txid, CKey(0xD00D), 9_0000_0000)
+        with pytest.raises(JSONRPCException) as e:
+            node.rpc.sendrawtransaction(raw2)
+        assert e.value.code == -26  # RPC_VERIFY_REJECTED
+
+        # -- template contains the tx, fee-ordered -----------------------
+        tmpl = node.rpc.getblocktemplate()
+        assert tmpl["height"] == 102
+        assert any(t["txid"] == txid for t in tmpl["transactions"])
+
+        # -- mine it; mempool drains; txindex answers --------------------
+        node.rpc.generatetoaddress(1, params_addr)
+        assert node.rpc.getrawmempool() == []
+        got = node.rpc.getrawtransaction(txid, True)
+        assert got["confirmations"] == 1
+        assert got["blockhash"] == node.rpc.getbestblockhash()
+
+        # -- getblocktemplate -> external miner -> submitblock ------------
+        tmpl = node.rpc.getblocktemplate()
+        block = _mine_template(tmpl, params_addr)
+        assert node.rpc.submitblock(block.serialize().hex()) is None
+        assert node.rpc.getbestblockhash() == block.hash_hex
+        # resubmission reports duplicate, like the reference
+        assert node.rpc.submitblock(block.serialize().hex()) == "duplicate"
+
+        # -- gettpuinfo observability ------------------------------------
+        tpu = node.rpc.gettpuinfo()
+        assert "batch" in tpu and "connectblock" in tpu
+        assert tpu["connectblock"]["blocks"] >= 102
+
+        # -- clean restart resumes ---------------------------------------
+        tip = node.rpc.getbestblockhash()
+        height = node.rpc.getblockcount()
+        node.stop()
+        node.start(extra=["-txindex", "-listen=0"])
+        assert node.rpc.getblockcount() == height
+        assert node.rpc.getbestblockhash() == tip
+        # chain still extends after restart
+        node.rpc.generatetoaddress(1, params_addr)
+        assert node.rpc.getblockcount() == height + 1
+
+        # -- -reindex reproduces the same chainstate ----------------------
+        # (run before the kill-9 section so the blk files exactly match the
+        # active chain — a crash leaves orphaned blocks in the files, which
+        # -reindex correctly resurrects if they carry more work)
+        best_before = node.rpc.getbestblockhash()
+        height_before = node.rpc.getblockcount()
+        utxo_before = node.rpc.gettxoutsetinfo()
+        node.stop()
+        node.start(extra=["-txindex", "-listen=0", "-reindex"])
+        assert node.rpc.getblockcount() == height_before
+        assert node.rpc.getbestblockhash() == best_before
+        utxo_after = node.rpc.gettxoutsetinfo()
+        assert utxo_after["txouts"] == utxo_before["txouts"]
+        assert utxo_after["total_amount"] == utxo_before["total_amount"]
+
+        # -- kill -9 resumes (crash safety, SURVEY §6.3) ------------------
+        node.rpc.generatetoaddress(5, params_addr)
+        height_before_kill = node.rpc.getblockcount()
+        node.kill9()
+        node.start(extra=["-txindex", "-listen=0"])
+        # never behind the last flush point (flushinterval=8) and never
+        # corrupted; re-mining works
+        resumed = node.rpc.getblockcount()
+        assert resumed >= height_before_kill - 8
+        assert node.rpc.verifychain(3, 6)
+        node.rpc.generatetoaddress(1, params_addr)
+        assert node.rpc.getblockcount() == resumed + 1
