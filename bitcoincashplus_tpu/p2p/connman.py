@@ -1,0 +1,397 @@
+"""Connection manager + message processing.
+
+Reference: src/net.cpp (CConnman: accept loop, peer lifecycle — the
+reference's ThreadSocketHandler/ThreadMessageHandler pair is one asyncio
+event loop on a dedicated thread here), src/net_processing.cpp
+(ProcessMessage: the per-command logic below follows its shape, minimal
+subset; headers-first sync as in the reference's getheaders/headers/
+getdata flow). Chainstate/mempool access happens under node.cs_main.
+
+Fault handling: any NetMessageError (bad magic/checksum/payload) =
+Misbehaving → disconnect, like the reference's ban-score discharge.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import secrets
+import threading
+import time
+from typing import Optional
+
+from ..consensus.block import CBlock
+from ..consensus.serialize import hash_to_hex
+from ..consensus.tx import CTransaction
+from ..mempool.mempool import MempoolError
+from ..util.log import log_print, log_printf
+from ..validation.chain import BlockStatus
+from ..validation.chainstate import BlockValidationError
+from .protocol import (
+    HEADER_SIZE,
+    MAX_HEADERS_RESULTS,
+    MSG_BLOCK,
+    MSG_TX,
+    MessageHeader,
+    NetMessageError,
+    VersionPayload,
+    check_payload,
+    deser_getheaders,
+    deser_headers,
+    deser_inv,
+    deser_ping,
+    pack_message,
+    ser_getheaders,
+    ser_headers,
+    ser_inv,
+    ser_ping,
+)
+
+
+class Peer:
+    """CNode — one connected peer."""
+
+    _next_id = 0
+
+    def __init__(self, connman: "CConnman", reader, writer, outbound: bool):
+        Peer._next_id += 1
+        self.id = Peer._next_id
+        self.connman = connman
+        self.reader = reader
+        self.writer = writer
+        self.outbound = outbound
+        peername = writer.get_extra_info("peername") or ("?", 0)
+        self.addr = f"{peername[0]}:{peername[1]}"
+        self.version: Optional[VersionPayload] = None
+        self.got_verack = False
+        self.known_invs: set[bytes] = set()
+        self.connected_at = time.time()
+        self.last_recv = 0.0
+        self.last_send = 0.0
+        self.bytes_recv = 0
+        self.bytes_sent = 0
+
+    @property
+    def handshaked(self) -> bool:
+        return self.version is not None and self.got_verack
+
+    def send(self, command: str, payload: bytes = b"") -> None:
+        raw = pack_message(self.connman.magic, command, payload)
+        self.writer.write(raw)
+        self.bytes_sent += len(raw)
+        self.connman.bytes_sent += len(raw)
+        self.last_send = time.time()
+
+    def info(self) -> dict:
+        """getpeerinfo row (src/rpc/net.cpp)."""
+        return {
+            "id": self.id,
+            "addr": self.addr,
+            "inbound": not self.outbound,
+            "version": self.version.version if self.version else 0,
+            "subver": self.version.user_agent if self.version else "",
+            "startingheight": self.version.start_height if self.version else -1,
+            "conntime": int(self.connected_at),
+            "bytessent": self.bytes_sent,
+            "bytesrecv": self.bytes_recv,
+        }
+
+
+class CConnman:
+    def __init__(self, node, bind_host: str = "127.0.0.1", listen_port: int = 0):
+        self.node = node
+        self.magic = node.params.netmagic
+        self.bind_host = bind_host
+        self.listen_port = listen_port  # 0 = don't listen
+        self.port = 0
+        self.peers: dict[int, Peer] = {}
+        self.bytes_recv = 0
+        self.bytes_sent = 0
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self._server = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._requested_blocks: set[bytes] = set()
+        self._nonce = secrets.randbits(64)  # self-connect detection
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="p2p", daemon=True)
+        self._thread.start()
+        if not self._started.wait(30):
+            raise RuntimeError("P2P event loop failed to start")
+        self.node.chainstate.on_tip_changed.append(self._on_tip_changed)
+
+    def _run(self) -> None:
+        self.loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self.loop)
+        if self.listen_port:  # 0 = -listen=0 (outbound only)
+            self.loop.run_until_complete(self._start_server())
+        self._started.set()
+        self.loop.run_forever()
+        # drain: close transports
+        for task in asyncio.all_tasks(self.loop):
+            task.cancel()
+        self.loop.run_until_complete(asyncio.sleep(0))
+        self.loop.close()
+
+    async def _start_server(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_inbound, self.bind_host, self.listen_port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        log_print("net", "P2P listening on %s:%d", self.bind_host, self.port)
+
+    def close(self) -> None:
+        if self.loop is None:
+            return
+
+        def _shutdown():
+            for peer in list(self.peers.values()):
+                peer.writer.close()
+            if self._server is not None:
+                self._server.close()
+            self.loop.stop()
+
+        self.loop.call_soon_threadsafe(_shutdown)
+        self._thread.join(10)
+
+    # -- dialing --------------------------------------------------------
+
+    def connect_to(self, host: str, port: int) -> None:
+        asyncio.run_coroutine_threadsafe(self._dial(host, port), self.loop)
+
+    async def _dial(self, host: str, port: int) -> None:
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+        except OSError as e:
+            log_print("net", "connect to %s:%d failed: %s", host, port, e)
+            return
+        peer = Peer(self, reader, writer, outbound=True)
+        self.peers[peer.id] = peer
+        peer.send("version", self._version_payload().serialize())
+        asyncio.ensure_future(self._peer_loop(peer))
+
+    async def _on_inbound(self, reader, writer) -> None:
+        peer = Peer(self, reader, writer, outbound=False)
+        self.peers[peer.id] = peer
+        await self._peer_loop(peer)
+
+    def disconnect(self, addr: str) -> None:
+        def _do():
+            for peer in list(self.peers.values()):
+                if peer.addr == addr:
+                    peer.writer.close()
+        self.loop.call_soon_threadsafe(_do)
+
+    def _version_payload(self) -> VersionPayload:
+        with self.node.cs_main:
+            height = self.node.chainstate.tip().height
+        return VersionPayload(nonce=self._nonce, start_height=height)
+
+    # -- per-peer receive loop -----------------------------------------
+
+    async def _peer_loop(self, peer: Peer) -> None:
+        try:
+            while True:
+                raw_header = await peer.reader.readexactly(HEADER_SIZE)
+                header = MessageHeader.parse(raw_header, self.magic)
+                payload = await peer.reader.readexactly(header.length)
+                check_payload(header, payload)
+                peer.bytes_recv += HEADER_SIZE + header.length
+                self.bytes_recv += HEADER_SIZE + header.length
+                peer.last_recv = time.time()
+                self._process_message(peer, header.command, payload)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass  # peer hung up
+        except NetMessageError as e:
+            # Misbehaving (src/net_processing.cpp): malformed traffic =>
+            # immediate discharge/disconnect
+            log_print("net", "peer=%d misbehaving: %s — disconnecting", peer.id, e)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log_printf("P2P internal error peer=%d: %r", peer.id, e)
+        finally:
+            self.peers.pop(peer.id, None)
+            try:
+                peer.writer.close()
+            except Exception:
+                pass
+
+    # -- message processing (ProcessMessage) ---------------------------
+
+    def _process_message(self, peer: Peer, command: str, payload: bytes) -> None:
+        log_print("net", "received: %s (%d bytes) peer=%d",
+                  command, len(payload), peer.id)
+        handler = getattr(self, f"_msg_{command}", None)
+        if handler is None:
+            return  # unknown messages are ignored, like the reference
+        handler(peer, payload)
+
+    def _msg_version(self, peer: Peer, payload: bytes) -> None:
+        if peer.version is not None:
+            raise NetMessageError("duplicate version")
+        version = VersionPayload.parse(payload)
+        if version.nonce == self._nonce:
+            raise NetMessageError("connected to self")
+        peer.version = version
+        if not peer.outbound:
+            peer.send("version", self._version_payload().serialize())
+        peer.send("verack")
+
+    def _msg_verack(self, peer: Peer, payload: bytes) -> None:
+        peer.got_verack = True
+        # start headers sync (the reference sends getheaders on verack)
+        with self.node.cs_main:
+            locator = self.node.chainstate.chain.get_locator()
+        peer.send("getheaders", ser_getheaders(locator))
+
+    def _msg_ping(self, peer: Peer, payload: bytes) -> None:
+        peer.send("pong", ser_ping(deser_ping(payload)))
+
+    def _msg_pong(self, peer: Peer, payload: bytes) -> None:
+        pass
+
+    def _msg_getheaders(self, peer: Peer, payload: bytes) -> None:
+        locator, hash_stop = deser_getheaders(payload)
+        with self.node.cs_main:
+            cs = self.node.chainstate
+            start = None
+            for h in locator:
+                idx = cs.block_index.get(h)
+                if idx is not None and idx in cs.chain:
+                    start = idx
+                    break
+            height = (start.height + 1) if start is not None else 0
+            headers = []
+            while len(headers) < MAX_HEADERS_RESULTS:
+                idx = cs.chain[height]
+                if idx is None:
+                    break
+                headers.append(idx.header)
+                if idx.hash == hash_stop:
+                    break
+                height += 1
+        peer.send("headers", ser_headers(headers))
+
+    def _msg_headers(self, peer: Peer, payload: bytes) -> None:
+        headers = deser_headers(payload)
+        if not headers:
+            return
+        want = []
+        with self.node.cs_main:
+            cs = self.node.chainstate
+            for header in headers:
+                try:
+                    idx = cs.accept_block_header(header)
+                except BlockValidationError as e:
+                    if e.reason == "prev-blk-not-found":
+                        # out of order — restart sync from our locator
+                        locator = cs.chain.get_locator()
+                        peer.send("getheaders", ser_getheaders(locator))
+                        return
+                    raise NetMessageError(f"invalid header: {e.reason}") from None
+                if not (idx.status & BlockStatus.HAVE_DATA) and \
+                        idx.hash not in self._requested_blocks:
+                    want.append(idx.hash)
+                    self._requested_blocks.add(idx.hash)
+        if want:
+            peer.send("getdata", ser_inv([(MSG_BLOCK, h) for h in want]))
+        if len(headers) == MAX_HEADERS_RESULTS:  # there may be more
+            with self.node.cs_main:
+                locator = self.node.chainstate.chain.get_locator(
+                    self.node.chainstate.block_index[headers[-1].get_hash()]
+                )
+            peer.send("getheaders", ser_getheaders(locator))
+
+    def _msg_inv(self, peer: Peer, payload: bytes) -> None:
+        items = deser_inv(payload)
+        want_tx = []
+        ask_headers = False
+        with self.node.cs_main:
+            cs = self.node.chainstate
+            for inv_type, h in items:
+                peer.known_invs.add(h)
+                if inv_type == MSG_BLOCK:
+                    idx = cs.block_index.get(h)
+                    if idx is None or not (idx.status & BlockStatus.HAVE_DATA):
+                        ask_headers = True  # headers-first sync
+                elif inv_type == MSG_TX:
+                    if h not in self.node.mempool:
+                        want_tx.append(h)
+            locator = cs.chain.get_locator() if ask_headers else None
+        if ask_headers:
+            peer.send("getheaders", ser_getheaders(locator))
+        if want_tx:
+            peer.send("getdata", ser_inv([(MSG_TX, h) for h in want_tx]))
+
+    def _msg_getdata(self, peer: Peer, payload: bytes) -> None:
+        items = deser_inv(payload)
+        for inv_type, h in items:
+            if inv_type == MSG_BLOCK:
+                with self.node.cs_main:
+                    raw = self.node.block_store.get_block(h)
+                if raw is not None:
+                    peer.send("block", raw)
+            elif inv_type == MSG_TX:
+                with self.node.cs_main:
+                    tx = self.node.mempool.get_tx(h)
+                if tx is not None:
+                    peer.send("tx", tx.serialize())
+
+    def _msg_block(self, peer: Peer, payload: bytes) -> None:
+        try:
+            block = CBlock.from_bytes(payload)
+        except Exception:
+            raise NetMessageError("undecodable block") from None
+        h = block.get_hash()
+        self._requested_blocks.discard(h)
+        peer.known_invs.add(h)
+        with self.node.cs_main:
+            try:
+                self.node.chainstate.process_new_block(block)
+            except BlockValidationError as e:
+                if e.reason not in ("duplicate", "prev-blk-not-found"):
+                    log_print("net", "peer=%d sent invalid block %s: %s",
+                              peer.id, hash_to_hex(h)[:16], e.reason)
+
+    def _msg_tx(self, peer: Peer, payload: bytes) -> None:
+        try:
+            tx = CTransaction.from_bytes(payload)
+        except Exception:
+            raise NetMessageError("undecodable tx") from None
+        peer.known_invs.add(tx.txid)
+        with self.node.cs_main:
+            try:
+                self.node.accept_to_mempool(tx)
+            except MempoolError as e:
+                log_print("net", "tx %s rejected: %s", tx.txid_hex[:16], e.reason)
+                return
+        self.relay_tx(tx.txid, skip_peer=peer.id)
+
+    # -- relay ----------------------------------------------------------
+
+    def _on_tip_changed(self, tip) -> None:
+        if tip is not None:
+            self.relay_block(tip.hash)
+
+    def _broadcast_inv(self, inv_type: int, h: bytes, skip_peer: int = 0) -> None:
+        def _do():
+            for peer in self.peers.values():
+                if peer.id == skip_peer or not peer.handshaked:
+                    continue
+                if h in peer.known_invs:
+                    continue
+                peer.known_invs.add(h)
+                try:
+                    peer.send("inv", ser_inv([(inv_type, h)]))
+                except Exception:
+                    pass
+        self.loop.call_soon_threadsafe(_do)
+
+    def relay_block(self, h: bytes, skip_peer: int = 0) -> None:
+        self._broadcast_inv(MSG_BLOCK, h, skip_peer)
+
+    def relay_tx(self, h: bytes, skip_peer: int = 0) -> None:
+        self._broadcast_inv(MSG_TX, h, skip_peer)

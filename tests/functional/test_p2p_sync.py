@@ -1,0 +1,168 @@
+"""Two-node P2P functional tests: block sync, tx relay, reorg, and a
+fake peer feeding malformed traffic.
+
+Reference behaviors: qa/rpc-tests/p2p-fullblocktest.py (block propagation),
+mininode.py (the fake peer), plus the reference's headers-first sync flow
+(src/net_processing.cpp).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import time
+
+import pytest
+
+from bitcoincashplus_tpu.consensus.params import regtest_params
+from bitcoincashplus_tpu.p2p.protocol import (
+    HEADER_SIZE,
+    VersionPayload,
+    pack_message,
+)
+from bitcoincashplus_tpu.wallet.keys import CKey
+
+from .framework import (
+    FunctionalFramework,
+    connect_nodes,
+    sync_blocks,
+    sync_mempools,
+    wait_until,
+)
+
+pytestmark = pytest.mark.functional
+
+KEY = CKey(0xFADE)
+ADDR = KEY.p2pkh_address(regtest_params())
+
+
+def test_two_node_sync_relay_reorg():
+    with FunctionalFramework(num_nodes=2) as f:
+        a, b = f.nodes
+        connect_nodes(b, a)
+
+        # -- initial block download: A mines, B follows ------------------
+        a.rpc.generatetoaddress(101, ADDR)
+        sync_blocks(f.nodes)
+        assert b.rpc.getblockcount() == 101
+
+        # -- tx relay ----------------------------------------------------
+        block1 = a.rpc.getblock(a.rpc.getblockhash(1), 2)
+        raw = _spend_tx(block1["tx"][0], 25_0000_0000)  # block 1 paid ADDR/KEY
+        txid = a.rpc.sendrawtransaction(raw)
+        sync_mempools(f.nodes)
+        assert txid in b.rpc.getrawmempool()
+
+        # -- B mines the tx; block propagates back to A ------------------
+        b.rpc.generatetoaddress(1, ADDR)
+        sync_blocks(f.nodes)
+        assert a.rpc.getrawmempool() == []
+        assert a.rpc.getblockcount() == 102
+
+        # -- reorg: B builds a longer chain while disconnected -----------
+        b.stop()
+        a.rpc.generatetoaddress(2, ADDR)  # A at 104
+        b.start()
+        b.rpc.generatetoaddress(4, ADDR)  # B at 106 on its own branch
+        assert b.rpc.getblockcount() == 106
+        connect_nodes(b, a)
+        sync_blocks(f.nodes, timeout=90)
+        assert a.rpc.getblockcount() == 106
+        assert a.rpc.getbestblockhash() == b.rpc.getbestblockhash()
+        # the abandoned branch shows up as a valid-fork chain tip
+        tips = a.rpc.getchaintips()
+        assert any(t["status"] != "active" for t in tips)
+
+
+def _spend_tx(cb: dict, amount: int) -> str:
+    """Spend a coinbase (decoded tx json) paid to ADDR/KEY."""
+    from bitcoincashplus_tpu.consensus.serialize import hex_to_hash
+    from bitcoincashplus_tpu.consensus.tx import (
+        COutPoint,
+        CTransaction,
+        CTxIn,
+        CTxOut,
+    )
+    from bitcoincashplus_tpu.script.sighash import SIGHASH_ALL
+    from bitcoincashplus_tpu.wallet.signing import sign_transaction
+
+    value = int(round(cb["vout"][0]["value"] * 1e8))
+    spk = bytes.fromhex(cb["vout"][0]["scriptPubKey"]["hex"])
+    tx = CTransaction(
+        vin=(CTxIn(COutPoint(hex_to_hash(cb["txid"]), 0)),),
+        vout=(CTxOut(amount, CKey(0xF00D).p2pkh_script()),
+              CTxOut(value - amount - 2000, KEY.p2pkh_script())),
+    )
+    signed = sign_transaction(
+        tx, [(spk, value)],
+        lambda ident: KEY if ident == KEY.pubkey_hash else None,
+        SIGHASH_ALL, enable_forkid=True,
+    )
+    return signed.serialize().hex()
+
+
+def test_fake_peer_malformed_messages():
+    """A mininode-style raw-socket peer sends garbage; the node must
+    disconnect it and keep serving (SURVEY §6.3 fault handling)."""
+    with FunctionalFramework(num_nodes=1) as f:
+        node = f.nodes[0]
+        node.rpc.generatetoaddress(3, ADDR)
+        magic = regtest_params().netmagic
+
+        # handshake then bad checksum
+        s = socket.create_connection(("127.0.0.1", node.p2p_port), timeout=10)
+        s.sendall(pack_message(magic, "version", VersionPayload().serialize()))
+        _read_msg(s)  # their version
+        _read_msg(s)  # their verack
+        bad = bytearray(pack_message(magic, "ping", b"\x00" * 8))
+        bad[20] ^= 0xFF  # corrupt checksum
+        s.sendall(bytes(bad))
+        assert _expect_disconnect(s)
+
+        # wrong netmagic disconnects immediately
+        s2 = socket.create_connection(("127.0.0.1", node.p2p_port), timeout=10)
+        s2.sendall(b"\xde\xad\xbe\xef" + b"ping".ljust(12, b"\x00")
+                   + struct.pack("<I", 8) + b"\x00" * 4 + b"\x00" * 8)
+        assert _expect_disconnect(s2)
+
+        # oversized payload length disconnects
+        s3 = socket.create_connection(("127.0.0.1", node.p2p_port), timeout=10)
+        s3.sendall(magic + b"tx".ljust(12, b"\x00")
+                   + struct.pack("<I", 1 << 30) + b"\x00" * 4)
+        assert _expect_disconnect(s3)
+
+        # node is still alive and mining
+        node.rpc.generatetoaddress(1, ADDR)
+        assert node.rpc.getblockcount() == 4
+        assert node.rpc.getconnectioncount() == 0
+
+
+def _read_msg(s: socket.socket) -> tuple[bytes, bytes]:
+    header = _recv_exact(s, HEADER_SIZE)
+    (length,) = struct.unpack_from("<I", header, 16)
+    return header, _recv_exact(s, length)
+
+
+def _recv_exact(s: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = s.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("closed")
+        buf += chunk
+    return buf
+
+
+def _expect_disconnect(s: socket.socket, timeout: float = 15.0) -> bool:
+    s.settimeout(timeout)
+    try:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            data = s.recv(4096)
+            if not data:
+                return True
+    except (ConnectionError, socket.timeout, OSError):
+        return True
+    finally:
+        s.close()
+    return False
