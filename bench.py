@@ -1,19 +1,28 @@
-"""Headline benchmark — prints ONE JSON line.
+"""Headline benchmarks — one JSON object per line, headline metric LAST
+(the driver parses the final line; the tail carries all five BASELINE.json
+configs, VERDICT r2 item 5).
 
-Metric: SHA-256d proof-of-work throughput of the single-chip nonce-sweep
-kernel (the graft's headline number, BASELINE.json: target >=500 GH/s/chip
-on TPU v5e). vs_baseline is value/500.
+Configs (BASELINE.json):
+  1. batched 80-byte header double-SHA (device), correctness-anchored against
+     the known mainnet genesis hash + hashlib vectors
+  2. getblocktemplate nonce-sweep miner, single chip  <- HEADLINE (last line)
+  3. Merkle-root construction over a 4096-tx snapshot
+  4. secp256k1 ECDSA batch-verify, 10k-sig ConnectBlock-scale batch
+  5. 8-chip nonce shard — reported from the 8-device VIRTUAL CPU mesh
+     (no multi-chip hardware on this host; the metric is scaling speedup,
+     clearly labeled, not GH/s)
 
-Method: sweep a fixed header template against an impossible target (no
-early exit) for a fixed tile count entirely on-device (one dispatch,
-lax.while_loop over tiles), timed after a warmup dispatch that absorbs
-compile time. Each nonce costs two SHA-256 compressions (midstate path);
-a "hash" below = one full SHA-256d of an 80-byte header.
+Timing honesty: the axon serving layer memoizes identical (program, args)
+dispatches, so every timed run randomizes an argument; medians over repeats;
+a warmup dispatch absorbs compile. The sweep timings force a scalar host
+fetch (int(tiles)) because block_until_ready alone does not synchronize
+through the serving tunnel.
 """
 
 import json
 import os
 import random
+import subprocess
 import sys
 import time
 
@@ -23,50 +32,205 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bitcoincashplus_tpu.crypto.hashes import header_midstate
-from bitcoincashplus_tpu.ops.miner import sweep_jit
-from bitcoincashplus_tpu.ops.sha256 import bytes_to_words_np, target_to_limbs_np
+BASELINE_GHS = 500.0  # BASELINE.json north star, per chip (see ROOFLINE.md)
 
-BASELINE_GHS = 500.0  # BASELINE.json north star, per chip
+
+def emit(metric, value, unit, vs_baseline, **extra):
+    line = {"metric": metric, "value": value, "unit": unit,
+            "vs_baseline": vs_baseline}
+    line.update(extra)
+    print(json.dumps(line), flush=True)
+
+
+def bench_header_hash():
+    """Config 1: device batch header double-SHA, anchored to known vectors."""
+    import hashlib
+
+    from bitcoincashplus_tpu.consensus.params import main_params
+    from bitcoincashplus_tpu.ops.sha256 import sha256d_headers
+
+    # correctness anchor: mainnet genesis header hashes to the known hash
+    genesis = main_params().genesis
+    hdr = genesis.header.serialize()
+    digest = sha256d_headers(np.frombuffer(hdr, np.uint8).reshape(1, 80))[0]
+    assert bytes(digest) == genesis.get_hash(), "genesis vector mismatch"
+
+    B = 1 << 16
+    rng = np.random.default_rng(1)
+    warm = rng.integers(0, 256, (B, 80), dtype=np.uint8)
+    out = sha256d_headers(warm)
+    # spot-check a lane against hashlib
+    h0 = hashlib.sha256(hashlib.sha256(warm[0].tobytes()).digest()).digest()
+    assert bytes(out[0]) == h0
+    ts = []
+    for _ in range(3):
+        batch = rng.integers(0, 256, (B, 80), dtype=np.uint8)
+        t0 = time.perf_counter()
+        out = sha256d_headers(batch)
+        ts.append(time.perf_counter() - t0)
+    dt = sorted(ts)[1]
+    mhs = B / dt / 1e6
+    emit("header_hash_batch_throughput", round(mhs, 2), "MH/s",
+         round(mhs * 1e6 / (BASELINE_GHS * 1e9), 6),
+         note="64Ki-header batch incl host pack/unpack; genesis+hashlib anchored")
+
+
+def bench_merkle():
+    """Config 3: 4096-tx Merkle root on device vs the scalar host oracle."""
+    from bitcoincashplus_tpu.consensus.merkle import compute_merkle_root
+    from bitcoincashplus_tpu.ops.merkle import compute_merkle_root_tpu
+
+    rng = np.random.default_rng(2)
+    txids = [rng.bytes(32) for _ in range(4096)]
+    root_ref, _ = compute_merkle_root(txids)
+    root_dev, _ = compute_merkle_root_tpu(txids)  # warm + correctness
+    assert root_dev == root_ref
+    ts = []
+    for _ in range(3):
+        txids = [rng.bytes(32) for _ in range(4096)]
+        t0 = time.perf_counter()
+        compute_merkle_root_tpu(txids)
+        ts.append(time.perf_counter() - t0)
+    dt = sorted(ts)[1]
+    emit("merkle_root_4096tx", round(dt * 1e3, 2), "ms",
+         0.0, note="device tree reduction, 12 levels, host odd-pairing")
+
+
+def bench_ecdsa_batch():
+    """Config 4: the 10k-sig ConnectBlock batch through the real dispatch
+    path (pack -> bucket-pad -> device kernel -> unpack)."""
+    from bitcoincashplus_tpu.crypto import secp256k1 as oracle
+    from bitcoincashplus_tpu.ops import ecdsa_batch
+    from bitcoincashplus_tpu.script.interpreter import SigCheckRecord
+
+    rng = np.random.default_rng(5)
+    base = []
+    for _ in range(64):  # 64 distinct real (key, sig, msg) triples
+        secret = int.from_bytes(rng.bytes(32), "big") % (oracle.N - 1) + 1
+        pub = oracle.point_mul(secret, oracle.G)
+        e = int.from_bytes(rng.bytes(32), "big") % oracle.N
+        r, s = oracle.ecdsa_sign(secret, e)
+        base.append((pub, r, s, e))
+    records = [  # tiled to 10k lanes (device work identical per lane)
+        SigCheckRecord(*base[i % 64], b"\x00" * 32, 0) for i in range(10_000)
+    ]
+    ok = ecdsa_batch.verify_batch(records, backend="device")  # warm/compile
+    assert bool(ok.all())
+    t0 = time.perf_counter()
+    ok = ecdsa_batch.verify_batch(records, backend="device")
+    dt = time.perf_counter() - t0
+    assert bool(ok.all())
+    sps = len(records) / dt
+    emit("ecdsa_batch_verify_10k", round(sps), "sigs/s", 0.0,
+         note=f"B=10000 padded to the 16384-lane bucket, one dispatch, "
+              f"{dt:.2f}s; 64 distinct sigs tiled (per-lane work identical)")
+
+
+def bench_virtual_shard():
+    """Config 5: 8-chip nonce shard on the VIRTUAL CPU mesh — scaling
+    speedup only (one real chip on this host; the same shard_map program is
+    what rides ICI on real hardware). Subprocess keeps JAX_PLATFORMS clean."""
+    code = r"""
+import os, time, json
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+import sys; sys.path.insert(0, %r)
+import jax
+jax.config.update("jax_default_device", jax.devices("cpu")[0])
+from bitcoincashplus_tpu.parallel.nonce_shard import sweep_header_sharded
+header = bytes(range(80))
+def timed(n_chips, tiles):
+    t0 = time.perf_counter()
+    nonce, hashes = sweep_header_sharded(header, 0, max_nonces=tiles * 4096,
+                                         tile=4096, n_chips=n_chips)
+    return time.perf_counter() - t0, hashes
+timed(8, 8)   # warm 8-way
+timed(1, 1)   # warm 1-way
+t8, h8 = timed(8, 64)
+t1, h1 = timed(1, 8)
+r8, r1 = h8 / t8, h1 / t1
+print(json.dumps({"speedup": r8 / r1, "r1_mhs": r1 / 1e6, "r8_mhs": r8 / 1e6}))
+""" % os.path.dirname(os.path.abspath(__file__))
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    try:
+        out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                             text=True, env=env, timeout=900)
+        line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else "{}"
+        r = json.loads(line)
+        emit("nonce_shard_virtual8_speedup", round(r["speedup"], 2), "x", 0.0,
+             note="8-device VIRTUAL CPU mesh (no multi-chip hardware here); "
+                  "shard_map program identical to the ICI path")
+    except Exception as e:  # pragma: no cover - diagnostics only
+        emit("nonce_shard_virtual8_speedup", -1, "x", 0.0,
+             note=f"subprocess failed: {e}")
+
+
+def bench_sweep_headline():
+    """Config 2 (HEADLINE, printed last): single-chip nonce-sweep GH/s on
+    the tuned Pallas kernel, XLA while-loop fallback if Pallas fails."""
+    from bitcoincashplus_tpu.crypto.hashes import header_midstate
+    from bitcoincashplus_tpu.ops.sha256 import bytes_to_words_np, target_to_limbs_np
+
+    header = bytes(range(80))
+    mid = jnp.asarray(np.array(header_midstate(header), dtype=np.uint32))
+    tail = jnp.asarray(bytes_to_words_np(np.frombuffer(header[64:76], np.uint8)))
+
+    on_cpu = jax.default_backend() == "cpu"
+    kernel = "pallas"
+    try:
+        if on_cpu:
+            raise RuntimeError("pallas TPU kernel needs the chip")
+        from bitcoincashplus_tpu.ops.pallas_sweep import pallas_sweep_jit
+
+        sublanes, max_tiles = 64, 262144  # tuned: tools/roofline.py sweep
+        tile = sublanes * 128
+
+        def run(start, n):
+            _f, _n, t = pallas_sweep_jit(mid, tail, jnp.uint32(0), start, n,
+                                         sublanes=sublanes, max_tiles=max_tiles)
+            return int(t)
+
+        n_units = max_tiles
+        run(jnp.uint32(0), jnp.uint32(1))  # warm/compile INSIDE the try:
+        # jax.jit compiles lazily, so a Mosaic lowering failure on another
+        # TPU generation surfaces here, not at import
+    except Exception:
+        kernel = "xla-while"
+        from bitcoincashplus_tpu.ops.miner import sweep_jit
+
+        tgt = jnp.asarray(target_to_limbs_np(0))
+        tile = 1 << 14 if on_cpu else 1 << 20
+        n_units = 4 if on_cpu else 128
+
+        def run(start, n):
+            _f, _n, t = sweep_jit(mid, tail, tgt, start, n, tile=tile)
+            return int(t)
+
+        run(jnp.uint32(0), jnp.uint32(1))  # warm/compile the fallback
+    rates = []
+    for _ in range(4):
+        start = jnp.uint32(random.getrandbits(32))
+        t0 = time.perf_counter()
+        tiles = run(start, jnp.uint32(n_units))
+        dt = time.perf_counter() - t0
+        rates.append(tiles * tile / dt)
+    rates = sorted(rates[1:])
+    ghs = rates[len(rates) // 2] / 1e9
+    emit("sha256d_sweep_throughput_per_chip", round(ghs, 4), "GH/s",
+         round(ghs / BASELINE_GHS, 6),
+         kernel=kernel,
+         note="truncated-h7 specialized double-SHA at ~90% of the chip's "
+              "6.17T u32-op/s VPU integer ceiling — see ROOFLINE.md")
 
 
 def main():
-    on_cpu = jax.default_backend() == "cpu" and "axon" not in str(jax.devices())
-    header = bytes(range(80))
-    midstate = jnp.asarray(np.array(header_midstate(header), dtype=np.uint32))
-    tail = jnp.asarray(bytes_to_words_np(np.frombuffer(header[64:76], np.uint8)))
-    target = jnp.asarray(target_to_limbs_np(0))  # impossible: full sweep
-
-    tile = 1 << 14 if on_cpu else 1 << 20
-    n_tiles = 4 if on_cpu else 128
-
-    # warmup / compile
-    jax.block_until_ready(
-        sweep_jit(midstate, tail, target, jnp.uint32(0), jnp.uint32(1), tile=tile)
-    )
-
-    rates = []
-    for _ in range(4):
-        # random start nonce: the serving layer memoizes identical
-        # (program, args) dispatches, which would fake the timing
-        start = jnp.uint32(random.getrandbits(32))
-        t0 = time.perf_counter()
-        found, nonce, tiles = jax.block_until_ready(
-            sweep_jit(midstate, tail, target, start, jnp.uint32(n_tiles), tile=tile)
-        )
-        dt = time.perf_counter() - t0
-        rates.append(int(tiles) * tile / dt)
-
-    # the first post-warmup dispatch returns anomalously fast through the
-    # serving tunnel; median of the remaining runs is the honest figure
-    rates = sorted(rates[1:])
-    ghs = rates[len(rates) // 2] / 1e9
-    print(json.dumps({
-        "metric": "sha256d_sweep_throughput_per_chip",
-        "value": round(ghs, 4),
-        "unit": "GH/s",
-        "vs_baseline": round(ghs / BASELINE_GHS, 6),
-    }))
+    on_cpu = jax.default_backend() == "cpu"
+    bench_header_hash()
+    bench_merkle()
+    if not on_cpu:
+        bench_ecdsa_batch()  # device kernel; CPU fallback would not be news
+    bench_virtual_shard()
+    bench_sweep_headline()  # headline LAST: the driver parses the final line
 
 
 if __name__ == "__main__":

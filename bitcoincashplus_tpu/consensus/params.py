@@ -20,6 +20,7 @@ from .block import CBlock, CBlockHeader
 from .merkle import compute_merkle_root
 from .serialize import hex_to_hash
 from .tx import COIN, COutPoint, CTransaction, CTxIn, CTxOut
+from .versionbits import NO_TIMEOUT, VBDeployment
 
 
 @dataclass(frozen=True)
@@ -41,6 +42,11 @@ class Consensus:
     # BCH-family deltas [fork-delta, hedged — SURVEY.md §0]:
     uahf_height: int = -1  # SIGHASH_FORKID activation (-1 = never)
     use_cash_daa: bool = False
+    # BIP9 versionbits (src/consensus/params.h nRuleChangeActivationThreshold
+    # / nMinerConfirmationWindow / vDeployments) — see consensus/versionbits.py
+    rule_change_activation_threshold: int = 1916  # 95% of 2016
+    miner_confirmation_window: int = 2016
+    deployments: tuple = ()
 
     @property
     def difficulty_adjustment_interval(self) -> int:
@@ -125,6 +131,12 @@ def main_params() -> ChainParams:
         csv_height=419_328,  # CSV softfork activation
         uahf_height=478_559,  # [fork-delta, hedged] BCH-family split height
         use_cash_daa=False,  # enabled per-run via -cashdaa once height rules land
+        deployments=(
+            # vDeployments[DEPLOYMENT_TESTDUMMY] (chainparams.cpp)
+            VBDeployment("testdummy", 28, 1199145601, 1230767999),
+            # DEPLOYMENT_CSV: the BIP9 run that activated at csv_height
+            VBDeployment("csv", 0, 1462060800, 1493596800),
+        ),
     )
     genesis = create_genesis_block(1231006505, 2083236893, 0x1D00FFFF, 1, 50 * COIN)
     return ChainParams(
@@ -184,6 +196,11 @@ def regtest_params() -> ChainParams:
         bip66_height=0,
         csv_height=0,
         uahf_height=0,
+        rule_change_activation_threshold=108,  # 75% of 144 (regtest)
+        miner_confirmation_window=144,
+        deployments=(
+            VBDeployment("testdummy", 28, 0, NO_TIMEOUT),
+        ),
     )
     genesis = create_genesis_block(1296688602, 2, 0x207FFFFF, 1, 50 * COIN)
     return ChainParams(

@@ -17,6 +17,7 @@ from ..consensus.merkle import block_merkle_root
 from ..consensus.params import ChainParams, get_block_subsidy
 from ..consensus.pow import get_next_work_required
 from ..consensus.tx import COutPoint, CTransaction, CTxIn, CTxOut
+from ..consensus.versionbits import compute_block_version
 from ..validation.chain import CBlockIndex
 from ..validation.chainstate import ChainstateManager, _script_int
 
@@ -44,10 +45,14 @@ class BlockTemplate:
 class BlockAssembler:
     """BlockAssembler (src/miner.cpp:~110)."""
 
-    def __init__(self, chainstate: ChainstateManager, mempool=None):
+    def __init__(self, chainstate: ChainstateManager, mempool=None,
+                 versionbits_cache=None):
         self.chainstate = chainstate
         self.mempool = mempool
         self.params: ChainParams = chainstate.params
+        # VersionBitsCache: without it every template re-walks all period
+        # boundaries from genesis (O(height) per getblocktemplate)
+        self.versionbits_cache = versionbits_cache
 
     def create_new_block(self, script_pubkey: bytes,
                          time_override: Optional[int] = None) -> BlockTemplate:
@@ -87,8 +92,16 @@ class BlockAssembler:
         )
         vtx = (coinbase, *txs)
         root, _ = block_merkle_root(_BlockView(vtx))
+        # ComputeBlockVersion (miner.cpp:~60): signal every versionbits
+        # deployment currently STARTED/LOCKED_IN on top of TOP_BITS
+        version = compute_block_version(
+            tip, consensus.deployments,
+            consensus.miner_confirmation_window,
+            consensus.rule_change_activation_threshold,
+            self.versionbits_cache,
+        )
         header = CBlockHeader(
-            version=0x20000000,
+            version=version,
             hash_prev_block=tip.hash,
             hash_merkle_root=root,
             time=block_time,

@@ -18,6 +18,7 @@ import time
 from typing import Optional
 
 from ..consensus.block import CBlock
+from ..consensus.versionbits import VersionBitsCache
 from ..consensus.serialize import hash_to_hex
 from ..mempool.accept import accept_to_memory_pool
 from ..mempool.mempool import CTxMemPool, MempoolError
@@ -90,6 +91,7 @@ class Node:
         self.coins_db = CoinsDB(self._coins_kv)
 
         self.sigcache = SignatureCache()
+        self.versionbits_cache = VersionBitsCache()
         backend = config.tpu_backend
         self.backend = backend
         verifier = BlockScriptVerifier(self.params, backend=backend,
@@ -172,7 +174,8 @@ class Node:
     # -- mining ---------------------------------------------------------
 
     def assembler(self) -> BlockAssembler:
-        return BlockAssembler(self.chainstate, self.mempool)
+        return BlockAssembler(self.chainstate, self.mempool,
+                              versionbits_cache=self.versionbits_cache)
 
     def generate_to_script(self, script_pubkey: bytes, n_blocks: int,
                            max_tries: int = MAX_TRIES_DEFAULT) -> list[bytes]:

@@ -33,7 +33,7 @@ from ..crypto import secp256k1 as oracle
 
 # Pad-to-bucket sizes (SURVEY.md §8.4 dispatch layer). One compiled
 # executable per bucket; persistent across blocks via jit cache.
-BUCKETS = (32, 128, 512, 2048, 8192, 32768)
+BUCKETS = (32, 128, 512, 2048, 8192, 16384, 32768)
 # Below this lane count a device round-trip costs more than host verify.
 CPU_FLOOR = 8
 
@@ -46,6 +46,11 @@ class BatchStats:
     sigs_verified: int = 0
     sigs_padded: int = 0
     cpu_fallback_sigs: int = 0
+    # sigchecks that never reach the batch at all (gettpuinfo honesty:
+    # what fraction of a block's sigops actually ran on the chip):
+    eager_multisig_sigs: int = 0   # CHECKMULTISIG trials, verified inline
+    inline_legacy_sigs: int = 0    # pre-NULLFAIL blocks, deferral unsound
+    sigcache_hits: int = 0         # records dropped by the sigcache probe
     device_seconds: float = 0.0
     last_batch: int = 0
     buckets_used: dict = field(default_factory=dict)

@@ -1,0 +1,220 @@
+"""Specialized SHA-256d nonce-sweep kernel (op-count-minimal h7 path).
+
+The generic sweep (ops/miner.py + ops/sha256.py) computes the full 8-word
+double-SHA digest per nonce and an 8-limb target compare. This module is the
+miner-grade specialization of the same search — the moral equivalent of the
+hand-scheduled Transform specializations the reference keeps per-ISA
+(src/crypto/sha256_sse4.cpp, sha256_avx2.cpp: same math, fewer ops per hash):
+
+  1. **Shared prefix** — header bytes 0..63 are midstate (already exploited);
+     on top of that, rounds 0..2 of the second compression consume only
+     header words w0..w2 (merkle tail / nTime / nBits), which are constant
+     across the sweep, so those rounds and every schedule term not touching
+     the nonce fold to constants (the AsicBoost-style schedule sharing of
+     PAPERS.md item 2, applied to the nonce axis).
+  2. **Zero/constant padding algebra** — block 2 of the first hash is
+     [w0,w1,w2,nonce,PAD,0*10,len]; most σ0/σ1 schedule terms vanish or fold.
+  3. **Truncated tail + h7-first early exit** — PoW compares the hash as a
+     little-endian uint256, whose topmost 32 bits are digest word h[7]
+     byte-swapped (src/pow.cpp:~74 CheckProofOfWork / arith_uint256). h[7] =
+     IV7 + e_61, and e_61 = a_57 + t1_60, so rounds 61..63 of the second
+     compression are never computed and rounds 57..60 need only their
+     e-chain (t1); the other seven digest words are never produced. The
+     device returns *candidate* nonces (limb7 <= target limb7); the host
+     re-verifies the full 256-bit compare with the scalar oracle and resumes
+     the sweep past false positives (~2^-32 per hash when limb7 ties).
+
+All round/schedule code below is polymorphic over numpy uint32 scalars and
+traced jax arrays: anything not data-dependent on the nonce lane vector stays
+a numpy scalar at trace time (folded into the program as a literal), or a
+traced scalar (hoisted by XLA out of the vector fusion) when the midstate is
+passed as a device array. Only nonce-dependent values become (tile,)-shaped
+vector ops — the count that sets throughput on the VPU (see ROOFLINE.md).
+
+Differential-tested against hashlib in tests/unit/test_sha256_sweep.py.
+"""
+
+from __future__ import annotations
+
+import warnings
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..crypto.hashes import SHA256_INIT, SHA256_K, header_midstate, sha256d
+from .sha256 import bswap32, bytes_to_words_np, target_to_limbs_np
+
+_K = [np.uint32(k) for k in SHA256_K]
+_IV = [np.uint32(v) for v in SHA256_INIT]
+_PAD = np.uint32(0x80000000)
+_Z = np.uint32(0)
+_LEN80 = np.uint32(640)
+_LEN32 = np.uint32(256)
+
+
+def _rotr(x, n: int):
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _S0(x):
+    return _rotr(x, 2) ^ _rotr(x, 13) ^ _rotr(x, 22)
+
+
+def _S1(x):
+    return _rotr(x, 6) ^ _rotr(x, 11) ^ _rotr(x, 25)
+
+
+def _s0(x):
+    return _rotr(x, 7) ^ _rotr(x, 18) ^ (x >> np.uint32(3))
+
+
+def _s1(x):
+    return _rotr(x, 17) ^ _rotr(x, 19) ^ (x >> np.uint32(10))
+
+
+def _ch(e, f, g):
+    # g ^ (e & (f ^ g)) == (e & f) | (~e & g): one op fewer than the
+    # textbook form (no ~), and f^g is shared when f,g are still scalar.
+    return g ^ (e & (f ^ g))
+
+
+def _maj(a, b, c):
+    # (a & (b ^ c)) ^ (b & c): 4 ops vs 5 for the three-AND form.
+    return ((b ^ c) & a) ^ (b & c)
+
+
+def _round(state, k, w):
+    a, b, c, d, e, f, g, h = state
+    t1 = h + _S1(e) + _ch(e, f, g) + k + w
+    t2 = _S0(a) + _maj(a, b, c)
+    return (t1 + t2, a, b, c, d + t1, e, f, g)
+
+
+def _expand(w, upto: int):
+    """Extend a 16-entry message schedule list in place to `upto` words.
+    Entries that are numpy scalars stay numpy (folded at trace time)."""
+    for i in range(16, upto):
+        w.append(w[i - 16] + _s0(w[i - 15]) + w[i - 7] + _s1(w[i - 2]))
+    return w
+
+
+def sweep_h7(midstate8, tail3, nonces):
+    """Digest word h[7] of sha256d(header) for each nonce in `nonces`.
+
+    midstate8: 8 uint32 scalars (numpy or traced) — SHA-256 state after
+    header bytes 0..63. tail3: 3 uint32 scalars — BE words of bytes 64..75.
+    nonces: (tile,) uint32 device array. Returns (tile,) uint32 h[7] values;
+    the PoW limb is bswap32(h7) (top 32 bits of the LE uint256 hash).
+    """
+    with warnings.catch_warnings():
+        # numpy scalar uint32 arithmetic wraps mod 2^32 (what SHA needs) but
+        # warns; the traced side never warns.
+        warnings.simplefilter("ignore", RuntimeWarning)
+
+        # ---- compression 2: midstate + [w0,w1,w2,nonce,PAD,0*10,len] ----
+        w = list(tail3) + [bswap32(nonces), _PAD] + [_Z] * 10 + [_LEN80]
+        _expand(w, 64)
+        st = tuple(midstate8)
+        for i in range(64):
+            st = _round(st, _K[i], w[i])
+        d8 = [m + s for m, s in zip(midstate8, st)]  # feedback -> digest words
+
+        # ---- compression 3 (second hash), truncated to the h7 chain ----
+        w = list(d8) + [_PAD] + [_Z] * 6 + [_LEN32]
+        _expand(w, 61)  # w61..w63 are never consumed
+        st = tuple(_IV)
+        for i in range(57):
+            st = _round(st, _K[i], w[i])
+        a57, b57, c57, d57, e, f, g, h = st
+        # rounds 57..59: e-chain only (t1); a/b/c/d successors are known
+        # shifts of a57..c57, so no Σ0/maj work is ever done here.
+        d_chain = (d57, c57, b57)
+        for r, dprev in zip((57, 58, 59), d_chain):
+            t1 = h + _S1(e) + _ch(e, f, g) + _K[r] + w[r]
+            e, f, g, h = dprev + t1, e, f, g
+        # round 60: only t1 is needed; e_61 = d_60 + t1_60 with d_60 = a_57.
+        t1_60 = h + _S1(e) + _ch(e, f, g) + _K[60] + w[60]
+        return _IV[7] + a57 + t1_60
+
+
+@partial(jax.jit, static_argnames=("tile",))
+def sweep_fast_jit(midstate, tail, t7, start_nonce, n_tiles, tile: int):
+    """Candidate sweep of [start, start + n_tiles*tile): first nonce whose
+    hash's top LE limb (bswap32(h7)) is <= t7.
+
+    midstate: (8,) uint32; tail: (3,) uint32; t7: uint32 scalar (top limb of
+    the target; 0 for any real-difficulty target). Returns (found, nonce,
+    tiles_done). Candidates must be host-verified against the full 256-bit
+    target (sweep_header_fast does); at limb equality the compare is
+    undecided at this truncation.
+    """
+    mid8 = [midstate[i] for i in range(8)]
+    tail3 = [tail[i] for i in range(3)]
+
+    def tile_fn(base):
+        lanes = jax.lax.broadcasted_iota(jnp.uint32, (tile, 1), 0).squeeze(-1)
+        nonces = base + lanes
+        h7 = sweep_h7(mid8, tail3, nonces)
+        ok = bswap32(h7) <= t7
+        return jnp.any(ok), nonces[jnp.argmax(ok)]
+
+    def cond(carry):
+        i, found, _ = carry
+        return jnp.logical_and(i < n_tiles, jnp.logical_not(found))
+
+    def body(carry):
+        i, _, _ = carry
+        hit, nonce = tile_fn(start_nonce + i.astype(jnp.uint32) * np.uint32(tile))
+        return i + np.uint32(1), hit, nonce
+
+    tiles, found, nonce = jax.lax.while_loop(
+        cond, body, (jnp.uint32(0), jnp.array(False), jnp.uint32(0))
+    )
+    return found, nonce, tiles
+
+
+DEFAULT_TILE = 1 << 20
+
+
+def sweep_header_fast(header80: bytes, target: int, start_nonce: int = 0,
+                      max_nonces: int = 1 << 32, tile: int = DEFAULT_TILE):
+    """Host API: find a nonce with sha256d(header) <= target, or None.
+
+    Same contract as ops.miner.sweep_header (first hit in nonce order wins,
+    returns (nonce_or_None, hashes_attempted)) but on the truncated-h7
+    kernel: device candidates are exact-verified on the host and the sweep
+    resumes past false positives, so the result is bit-identical to the
+    generic path while doing ~12% fewer vector ops per nonce.
+    """
+    assert len(header80) == 80
+    midstate = jnp.asarray(np.array(header_midstate(header80), dtype=np.uint32))
+    tail_np = bytes_to_words_np(np.frombuffer(header80[64:76], dtype=np.uint8))
+    tail = jnp.asarray(tail_np)
+    t7 = jnp.uint32(target_to_limbs_np(target)[7])
+
+    hashes = 0
+    nonce = start_nonce & 0xFFFFFFFF
+    remaining = max_nonces
+    while remaining > 0:
+        n_tiles = min((remaining + tile - 1) // tile, (1 << 32) // tile)
+        found, cand, tiles = sweep_fast_jit(
+            midstate, tail, t7, jnp.uint32(nonce), jnp.uint32(n_tiles), tile=tile
+        )
+        done = int(tiles) * tile
+        hashes += done
+        if not bool(found):
+            return None, hashes
+        cand = int(cand)
+        # exact host check of the candidate (scalar oracle)
+        hdr = header80[:76] + int(cand).to_bytes(4, "little")
+        if int.from_bytes(sha256d(hdr), "little") <= target:
+            return cand, hashes
+        # false positive (limb7 tie): resume just past it. The tiles the
+        # device already swept before the candidate stay counted; the
+        # candidate's own tile is partially re-swept, which is harmless.
+        consumed = (cand - nonce) & 0xFFFFFFFF
+        remaining -= consumed + 1
+        nonce = (cand + 1) & 0xFFFFFFFF
+    return None, hashes

@@ -110,7 +110,11 @@ class CConnman:
         self._server = None
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
-        self._requested_blocks: set[bytes] = set()
+        # in-flight block downloads: hash -> requesting peer id. Entries are
+        # dropped on block arrival AND on that peer's disconnect — otherwise
+        # an unclean hangup would leave the hash "requested" forever and no
+        # other peer could ever be asked for it (sync deadlock).
+        self._requested_blocks: dict[bytes, int] = {}
         self._nonce = secrets.randbits(64)  # self-connect detection
 
     # -- lifecycle ------------------------------------------------------
@@ -201,7 +205,7 @@ class CConnman:
                 peer.bytes_recv += HEADER_SIZE + header.length
                 self.bytes_recv += HEADER_SIZE + header.length
                 peer.last_recv = time.time()
-                self._process_message(peer, header.command, payload)
+                await self._process_message(peer, header.command, payload)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass  # peer hung up
         except NetMessageError as e:
@@ -214,6 +218,11 @@ class CConnman:
             log_printf("P2P internal error peer=%d: %r", peer.id, e)
         finally:
             self.peers.pop(peer.id, None)
+            # free this peer's in-flight block requests for other peers
+            self._requested_blocks = {
+                h: pid for h, pid in self._requested_blocks.items()
+                if pid != peer.id
+            }
             try:
                 peer.writer.close()
             except Exception:
@@ -221,13 +230,16 @@ class CConnman:
 
     # -- message processing (ProcessMessage) ---------------------------
 
-    def _process_message(self, peer: Peer, command: str, payload: bytes) -> None:
+    async def _process_message(self, peer: Peer, command: str,
+                               payload: bytes) -> None:
         log_print("net", "received: %s (%d bytes) peer=%d",
                   command, len(payload), peer.id)
         handler = getattr(self, f"_msg_{command}", None)
         if handler is None:
             return  # unknown messages are ignored, like the reference
-        handler(peer, payload)
+        result = handler(peer, payload)
+        if asyncio.iscoroutine(result):  # bulk-serving handlers drain
+            await result
 
     def _msg_version(self, peer: Peer, payload: bytes) -> None:
         if peer.version is not None:
@@ -287,7 +299,11 @@ class CConnman:
                     idx = cs.accept_block_header(header)
                 except BlockValidationError as e:
                     if e.reason == "prev-blk-not-found":
-                        # out of order — restart sync from our locator
+                        # out of order — un-reserve anything we queued for
+                        # this batch (its getdata is never sent) and restart
+                        # sync from our locator
+                        for h in want:
+                            self._requested_blocks.pop(h, None)
                         locator = cs.chain.get_locator()
                         peer.send("getheaders", ser_getheaders(locator))
                         return
@@ -295,7 +311,7 @@ class CConnman:
                 if not (idx.status & BlockStatus.HAVE_DATA) and \
                         idx.hash not in self._requested_blocks:
                     want.append(idx.hash)
-                    self._requested_blocks.add(idx.hash)
+                    self._requested_blocks[idx.hash] = peer.id
         if want:
             peer.send("getdata", ser_inv([(MSG_BLOCK, h) for h in want]))
         if len(headers) == MAX_HEADERS_RESULTS:  # there may be more
@@ -326,7 +342,11 @@ class CConnman:
         if want_tx:
             peer.send("getdata", ser_inv([(MSG_TX, h) for h in want_tx]))
 
-    def _msg_getdata(self, peer: Peer, payload: bytes) -> None:
+    async def _msg_getdata(self, peer: Peer, payload: bytes) -> None:
+        # async handler: a 2000-block IBD getdata would otherwise buffer
+        # every serialized block in the transport at once — drain after each
+        # send for backpressure (the reference bounds this with its
+        # per-peer send-buffer limit, net.cpp nSendBufferMaxSize)
         items = deser_inv(payload)
         for inv_type, h in items:
             if inv_type == MSG_BLOCK:
@@ -334,11 +354,13 @@ class CConnman:
                     raw = self.node.block_store.get_block(h)
                 if raw is not None:
                     peer.send("block", raw)
+                    await peer.writer.drain()
             elif inv_type == MSG_TX:
                 with self.node.cs_main:
                     tx = self.node.mempool.get_tx(h)
                 if tx is not None:
                     peer.send("tx", tx.serialize())
+                    await peer.writer.drain()
 
     def _msg_block(self, peer: Peer, payload: bytes) -> None:
         try:
@@ -346,7 +368,7 @@ class CConnman:
         except Exception:
             raise NetMessageError("undecodable block") from None
         h = block.get_hash()
-        self._requested_blocks.discard(h)
+        self._requested_blocks.pop(h, None)
         peer.known_invs.add(h)
         with self.node.cs_main:
             try:

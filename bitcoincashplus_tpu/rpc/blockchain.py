@@ -80,8 +80,42 @@ def getblockchaininfo(node, params):
         "verificationprogress": 1.0,
         "chainwork": f"{tip.chain_work:064x}",
         "pruned": False,
-        "softforks": [],
+        "softforks": _softforks(node, tip),
     }
+
+
+def _softforks(node, tip):
+    """BIP9 deployment status per getblockchaininfo's bip9_softforks
+    (rpc/blockchain.cpp:~1200) + the unknown-version upgrade warning count
+    (validation.cpp:~2200)."""
+    from ..consensus.versionbits import (
+        get_state_for,
+        get_state_since_height,
+        unknown_version_signalling,
+    )
+
+    c = node.params.consensus
+    out = {}
+    for dep in c.deployments:
+        cache = node.versionbits_cache.for_dep(dep)
+        state = get_state_for(
+            dep, tip, c.miner_confirmation_window,
+            c.rule_change_activation_threshold, cache,
+        )
+        out[dep.name] = {
+            "status": state.value,
+            "bit": dep.bit,
+            "startTime": dep.start_time,
+            "timeout": dep.timeout,
+            "since": get_state_since_height(
+                dep, tip, c.miner_confirmation_window,
+                c.rule_change_activation_threshold, cache,
+            ),
+        }
+    out["unknown_versions_last_100"] = unknown_version_signalling(
+        tip, c.deployments, c.miner_confirmation_window
+    )
+    return out
 
 
 @rpc_method("getbestblockhash")

@@ -316,6 +316,9 @@ class DeferringSignatureChecker(TransactionSignatureChecker):
     def check_sig(self, sig: bytes, pubkey: bytes, script_code: bytes,
                   flags: int, defer_ok: bool = True) -> bool:
         if not defer_ok:
+            from ..ops.ecdsa_batch import STATS
+
+            STATS.eager_multisig_sigs += 1
             return super().check_sig(sig, pubkey, script_code, flags, defer_ok)
         parsed = self._sighash_and_parse(sig, pubkey, script_code, flags)
         if parsed is None:

@@ -71,6 +71,15 @@ def block_script_flags(height: int, block_time: int,
     return flags
 
 
+class _InlineCountingChecker(TransactionSignatureChecker):
+    """Host-side inline sigcheck (pre-NULLFAIL eras) with BatchStats
+    metering, so gettpuinfo can report how many sigops bypassed the TPU."""
+
+    def check_sig(self, sig, pubkey, script_code, flags, defer_ok=True):
+        ecdsa_batch.STATS.inline_legacy_sigs += 1
+        return super().check_sig(sig, pubkey, script_code, flags, defer_ok)
+
+
 class BlockScriptVerifier:
     """The ChainstateManager ``script_verifier`` hook (chainstate.py).
 
@@ -109,7 +118,7 @@ class BlockScriptVerifier:
                     )
                 else:
                     # pre-NULLFAIL blocks: deferral unsound, verify inline
-                    checker = TransactionSignatureChecker(
+                    checker = _InlineCountingChecker(
                         tx, i, coin.out.value, cache
                     )
                 try:
@@ -137,6 +146,7 @@ class BlockScriptVerifier:
         fresh = [
             k for k, key in enumerate(keys) if not self.sigcache.contains(key)
         ]
+        ecdsa_batch.STATS.sigcache_hits += len(records) - len(fresh)
         if fresh:
             ok = ecdsa_batch.verify_batch(
                 [records[k] for k in fresh], backend=self.backend

@@ -1,0 +1,142 @@
+"""BIP9 versionbits state machine tests — the scenarios of the reference's
+versionbits_tests.cpp, on a synthetic CBlockIndex chain with a small window."""
+
+from bitcoincashplus_tpu.consensus.block import CBlockHeader
+from bitcoincashplus_tpu.consensus.versionbits import (
+    ALWAYS_ACTIVE,
+    NO_TIMEOUT,
+    VERSIONBITS_TOP_BITS,
+    ThresholdState,
+    VBDeployment,
+    VersionBitsCache,
+    compute_block_version,
+    get_state_for,
+    get_state_since_height,
+    unknown_version_signalling,
+)
+from bitcoincashplus_tpu.validation.chain import CBlockIndex
+
+WINDOW = 4
+THRESHOLD = 3
+BIT = 5
+SIGNAL = VERSIONBITS_TOP_BITS | (1 << BIT)
+NO_SIGNAL = VERSIONBITS_TOP_BITS
+
+DEP = VBDeployment("dep", BIT, 0, NO_TIMEOUT)
+
+
+def build_chain(versions, times=None):
+    """Index chain from a list of block versions (genesis first)."""
+    chain = []
+    prev = None
+    for h, v in enumerate(versions):
+        hdr = CBlockHeader(
+            version=v, hash_prev_block=b"\x00" * 32,
+            hash_merkle_root=h.to_bytes(32, "little"),
+            time=times[h] if times else 1000 + h,
+            bits=0x207FFFFF, nonce=h,
+        )
+        idx = CBlockIndex(hdr, h.to_bytes(32, "big"), prev)
+        chain.append(idx)
+        prev = idx
+    return chain
+
+
+def state_at(chain, height, dep=DEP, cache=None):
+    """State for the block AT `height` (prev = height-1)."""
+    prev = chain[height - 1] if height > 0 else None
+    return get_state_for(dep, prev, WINDOW, THRESHOLD, cache)
+
+
+def test_all_signalling_reaches_active():
+    chain = build_chain([SIGNAL] * 16)
+    assert state_at(chain, 0) == ThresholdState.DEFINED
+    assert state_at(chain, 2) == ThresholdState.DEFINED
+    assert state_at(chain, 4) == ThresholdState.STARTED
+    assert state_at(chain, 7) == ThresholdState.STARTED
+    assert state_at(chain, 8) == ThresholdState.LOCKED_IN
+    assert state_at(chain, 11) == ThresholdState.LOCKED_IN
+    assert state_at(chain, 12) == ThresholdState.ACTIVE
+    assert state_at(chain, 15) == ThresholdState.ACTIVE
+
+
+def test_below_threshold_stays_started_then_locks():
+    # period h4..h7: only 2 of 4 signal -> stays STARTED;
+    # period h8..h11: 3 signal -> LOCKED_IN at h12
+    versions = (
+        [NO_SIGNAL] * 4
+        + [SIGNAL, NO_SIGNAL, SIGNAL, NO_SIGNAL]
+        + [SIGNAL, SIGNAL, NO_SIGNAL, SIGNAL]
+        + [NO_SIGNAL] * 4
+    )
+    chain = build_chain(versions)
+    assert state_at(chain, 8) == ThresholdState.STARTED
+    assert state_at(chain, 12) == ThresholdState.LOCKED_IN
+    # LOCKED_IN -> ACTIVE regardless of further signalling
+    chain2 = build_chain(versions + [NO_SIGNAL] * 4)
+    assert state_at(chain2, 16) == ThresholdState.ACTIVE
+
+
+def test_timeout_fails():
+    dep = VBDeployment("dep", BIT, 0, 1010)  # times are 1000+h
+    chain = build_chain([NO_SIGNAL] * 20)  # never signals -> cannot lock in
+    # MTP crosses 1010 a few blocks after h10; once a boundary's MTP is past
+    # timeout while STARTED, the next period is FAILED — and stays FAILED
+    states = [state_at(chain, h, dep) for h in range(0, 20, WINDOW)]
+    assert ThresholdState.FAILED in states
+    assert states[-1] == ThresholdState.FAILED
+    # terminal: never ACTIVE afterwards
+    assert ThresholdState.ACTIVE not in states
+
+
+def test_never_started_before_start_time():
+    dep = VBDeployment("dep", BIT, 10_000, NO_TIMEOUT)  # start far in future
+    chain = build_chain([SIGNAL] * 16)
+    for h in range(0, 16, WINDOW):
+        assert state_at(chain, h, dep) == ThresholdState.DEFINED
+
+
+def test_always_active_sentinel():
+    dep = VBDeployment("dep", BIT, ALWAYS_ACTIVE, NO_TIMEOUT)
+    chain = build_chain([NO_SIGNAL] * 4)
+    assert state_at(chain, 2, dep) == ThresholdState.ACTIVE
+
+
+def test_state_since_height():
+    chain = build_chain([SIGNAL] * 16)
+    prev = chain[14]
+    assert get_state_for(DEP, prev, WINDOW, THRESHOLD) == ThresholdState.ACTIVE
+    assert get_state_since_height(DEP, prev, WINDOW, THRESHOLD) == 12
+
+
+def test_cache_consistency():
+    chain = build_chain([SIGNAL] * 16)
+    cache = {}
+    uncached = [state_at(chain, h) for h in range(16)]
+    cached = [state_at(chain, h, cache=cache) for h in range(16)]
+    assert uncached == cached
+    assert cache  # boundaries were memoized
+    # cached re-query still right
+    assert state_at(chain, 15, cache=cache) == ThresholdState.ACTIVE
+
+
+def test_compute_block_version_signals_only_while_pending():
+    chain = build_chain([SIGNAL] * 16)
+    # STARTED at h4..h11 boundaries -> signal; ACTIVE at h12 -> stop
+    v_started = compute_block_version(chain[5], (DEP,), WINDOW, THRESHOLD)
+    assert v_started & (1 << BIT)
+    v_active = compute_block_version(chain[14], (DEP,), WINDOW, THRESHOLD)
+    assert not v_active & (1 << BIT)
+    assert v_active == VERSIONBITS_TOP_BITS
+    cache = VersionBitsCache()
+    assert compute_block_version(chain[5], (DEP,), WINDOW, THRESHOLD,
+                                 cache) == v_started
+
+
+def test_unknown_version_warning():
+    # half the recent blocks signal an unknown bit (not DEP's)
+    unknown = VERSIONBITS_TOP_BITS | (1 << 7)
+    chain = build_chain([SIGNAL, unknown] * 8)
+    n = unknown_version_signalling(chain[-1], (DEP,), WINDOW)
+    assert n == 2  # window=4 lookback: 2 of the last 4 blocks
+    assert unknown_version_signalling(chain[-1], (DEP, VBDeployment("x", 7, 0, NO_TIMEOUT)), WINDOW) == 0

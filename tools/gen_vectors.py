@@ -1,0 +1,780 @@
+"""Generate the committed consensus vector corpus (tests/data/*.json).
+
+SURVEY.md §5.4(2)/§8.6(d): the reference pins consensus behavior with
+data-driven vector files (src/test/data/script_tests.json, sighash.json,
+tx_valid.json, tx_invalid.json). This generator re-derives an equivalent
+corpus from THIS framework's trusted signer + interpreter (both themselves
+differential-tested against library oracles), asserting every authored
+expectation against the interpreter as it emits — so a mismatch aborts
+generation rather than committing a wrong vector. The committed JSON then
+locks current consensus behavior against regressions.
+
+Usage:  python tools/gen_vectors.py          # writes tests/data/*.json
+Runner: tests/unit/test_script_vectors.py    # replays in the default suite
+
+Formats (self-describing; first element of each file is a comment string):
+  script_tests.json entries: [scriptSig_hex, scriptPubKey_hex, flags, expect, desc]
+  sighash.json      entries: [tx_hex, scriptCode_hex, in_idx, hashtype, amount,
+                              legacy_digest_hex_or_None, forkid_digest_hex]
+  tx_valid/invalid  entries: {inputs: [[prevtxid_hex, n, spk_hex, amount]...],
+                              tx: hex, flags: str, expect: str, desc: str}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from bitcoincashplus_tpu.consensus.serialize import ByteReader
+from bitcoincashplus_tpu.consensus.tx import (
+    COutPoint,
+    CTransaction,
+    CTxIn,
+    CTxOut,
+)
+from bitcoincashplus_tpu.crypto.hashes import hash160, ripemd160, sha256, sha256d
+from bitcoincashplus_tpu.script import script as S
+from bitcoincashplus_tpu.script.interpreter import (
+    SCRIPT_ENABLE_SIGHASH_FORKID,
+    SCRIPT_VERIFY_CHECKLOCKTIMEVERIFY,
+    SCRIPT_VERIFY_CHECKSEQUENCEVERIFY,
+    SCRIPT_VERIFY_CLEANSTACK,
+    SCRIPT_VERIFY_DERSIG,
+    SCRIPT_VERIFY_DISCOURAGE_UPGRADABLE_NOPS,
+    SCRIPT_VERIFY_LOW_S,
+    SCRIPT_VERIFY_MINIMALDATA,
+    SCRIPT_VERIFY_NONE,
+    SCRIPT_VERIFY_NULLDUMMY,
+    SCRIPT_VERIFY_NULLFAIL,
+    SCRIPT_VERIFY_P2SH,
+    SCRIPT_VERIFY_SIGPUSHONLY,
+    SCRIPT_VERIFY_STRICTENC,
+    ScriptError,
+    TransactionSignatureChecker,
+    VerifyScript,
+)
+from bitcoincashplus_tpu.script.sighash import (
+    SIGHASH_ALL,
+    SIGHASH_ANYONECANPAY,
+    SIGHASH_FORKID,
+    SIGHASH_NONE,
+    SIGHASH_SINGLE,
+    signature_hash_forkid,
+    signature_hash_legacy,
+)
+from bitcoincashplus_tpu.crypto import secp256k1 as secp
+from bitcoincashplus_tpu.wallet.keys import CKey
+from bitcoincashplus_tpu.wallet.signing import make_signature, sign_transaction
+
+DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "tests", "data")
+
+FLAG_BITS = {
+    "P2SH": SCRIPT_VERIFY_P2SH,
+    "STRICTENC": SCRIPT_VERIFY_STRICTENC,
+    "DERSIG": SCRIPT_VERIFY_DERSIG,
+    "LOW_S": SCRIPT_VERIFY_LOW_S,
+    "NULLDUMMY": SCRIPT_VERIFY_NULLDUMMY,
+    "SIGPUSHONLY": SCRIPT_VERIFY_SIGPUSHONLY,
+    "MINIMALDATA": SCRIPT_VERIFY_MINIMALDATA,
+    "DISCOURAGE_UPGRADABLE_NOPS": SCRIPT_VERIFY_DISCOURAGE_UPGRADABLE_NOPS,
+    "CLEANSTACK": SCRIPT_VERIFY_CLEANSTACK,
+    "CHECKLOCKTIMEVERIFY": SCRIPT_VERIFY_CHECKLOCKTIMEVERIFY,
+    "CHECKSEQUENCEVERIFY": SCRIPT_VERIFY_CHECKSEQUENCEVERIFY,
+    "NULLFAIL": SCRIPT_VERIFY_NULLFAIL,
+    "FORKID": SCRIPT_ENABLE_SIGHASH_FORKID,
+}
+
+
+def parse_flags(s: str) -> int:
+    f = SCRIPT_VERIFY_NONE
+    if s:
+        for name in s.split(","):
+            f |= FLAG_BITS[name]
+    return f
+
+
+KEY = CKey(0x1CE1CE1CE1CE1CE1CE1CE1CE1CE1CE1CE1CE1CE1CE1CE1CE1CE1CE1CE1CE1CE1)
+KEY2 = CKey(0x2B2B2B2B2B2B2B2B2B2B2B2B2B2B2B2B2B2B2B2B2B2B2B2B2B2B2B2B2B2B2B2B)
+KEY3 = CKey(0x3C3C3C3C3C3C3C3C3C3C3C3C3C3C3C3C3C3C3C3C3C3C3C3C3C3C3C3C3C3C3C3C)
+AMOUNT = 12_3456_7890  # satoshis credited in every script-test context
+
+
+def build_ctx(script_sig: bytes, script_pubkey: bytes,
+              amount: int = AMOUNT, sequence: int = 0xFFFFFFFF,
+              locktime: int = 0):
+    """Crediting + spending transaction pair — the fixed context every
+    script_tests vector runs in (mirrors the reference's
+    BuildCreditingTransaction/BuildSpendingTransaction convention)."""
+    credit = CTransaction(
+        version=1,
+        vin=(CTxIn(COutPoint(), b"\x00\x00"),),
+        vout=(CTxOut(amount, script_pubkey),),
+        locktime=0,
+    )
+    spend = CTransaction(
+        version=1,
+        vin=(CTxIn(COutPoint(credit.txid, 0), script_sig, sequence),),
+        vout=(CTxOut(amount, b""),),
+        locktime=locktime,
+    )
+    return credit, spend
+
+
+def run_script_vector(sig_hex: str, spk_hex: str, flags_str: str) -> str:
+    sig, spk = bytes.fromhex(sig_hex), bytes.fromhex(spk_hex)
+    _, spend = build_ctx(sig, spk)
+    checker = TransactionSignatureChecker(spend, 0, AMOUNT)
+    try:
+        VerifyScript(sig, spk, parse_flags(flags_str), checker)
+        return "OK"
+    except ScriptError as e:
+        return e.code
+
+
+SCRIPT_VECTORS: list[list[str]] = []
+
+
+def vec(sig: bytes, spk: bytes, flags: str, expect: str, desc: str):
+    got = run_script_vector(sig.hex(), spk.hex(), flags)
+    if got != expect:
+        raise SystemExit(
+            f"VECTOR MISMATCH: {desc!r}\n  sig={sig.hex()} spk={spk.hex()} "
+            f"flags={flags}\n  expected {expect}, interpreter says {got}"
+        )
+    SCRIPT_VECTORS.append([sig.hex(), spk.hex(), flags, expect, desc])
+
+
+def op(*codes) -> bytes:
+    return bytes(codes)
+
+
+def push(data: bytes) -> bytes:
+    return S.push_data_raw(data)
+
+
+def pushnum(n: int) -> bytes:
+    """Minimal push of small number n."""
+    if n == 0:
+        return b"\x00"
+    if 1 <= n <= 16:
+        return bytes([0x50 + n])
+    if n == -1:
+        return bytes([S.OP_1NEGATE])
+    return push(S.script_num_encode(n) if hasattr(S, "script_num_encode")
+                else _num(n))
+
+
+def _num(n: int) -> bytes:
+    """Script-number encode (minimal)."""
+    if n == 0:
+        return b""
+    neg = n < 0
+    n = abs(n)
+    out = bytearray()
+    while n:
+        out.append(n & 0xFF)
+        n >>= 8
+    if out[-1] & 0x80:
+        out.append(0x80 if neg else 0x00)
+    elif neg:
+        out[-1] |= 0x80
+    return bytes(out)
+
+
+def make_ctx_signature(script_code: bytes, hashtype: int, *, key=KEY,
+                       forkid=False, amount=AMOUNT) -> bytes:
+    """Signature over the standard script-test context for `script_code`."""
+    _, spend = build_ctx(b"", script_code, amount)
+    return make_signature(key, script_code, spend, 0, amount, hashtype,
+                          enable_forkid=forkid)
+
+
+def gen_script_vectors():
+    OPC = S  # opcode namespace
+
+    # ---- trivial / truthiness ----
+    vec(b"", op(OPC.OP_1), "", "OK", "empty sig, OP_1")
+    vec(b"", b"", "", "eval-false", "both empty: empty final stack")
+    vec(b"\x00", b"", "", "eval-false", "push empty -> false")
+    vec(pushnum(1), b"", "", "OK", "OP_1 alone is true")
+    vec(b"", op(OPC.OP_0), "", "eval-false", "OP_0 -> false")
+
+    # ---- pushes: OP_1..OP_16 round-trip through EQUAL ----
+    for n in range(1, 17):
+        vec(bytes([0x50 + n]), push(bytes([n])) + op(OPC.OP_EQUAL), "",
+            "OK", f"OP_{n} equals direct push")
+    vec(bytes([OPC.OP_1NEGATE]), push(b"\x81") + op(OPC.OP_EQUAL), "",
+        "OK", "OP_1NEGATE encoding")
+
+    # ---- PUSHDATA forms vs MINIMALDATA ----
+    data = b"\x42"
+    forms = {
+        "direct": push(data),
+        "pushdata1": bytes([OPC.OP_PUSHDATA1, 1]) + data,
+        "pushdata2": bytes([OPC.OP_PUSHDATA2, 1, 0]) + data,
+        "pushdata4": bytes([OPC.OP_PUSHDATA4, 1, 0, 0, 0]) + data,
+    }
+    for name, frm in forms.items():
+        vec(frm, push(data) + op(OPC.OP_EQUAL), "", "OK",
+            f"{name} push accepted without MINIMALDATA")
+        expect = "OK" if name == "direct" else "minimaldata"
+        vec(frm, push(data) + op(OPC.OP_EQUAL), "MINIMALDATA", expect,
+            f"{name} push under MINIMALDATA")
+    # number-encoded-as-data must use OP_n form under MINIMALDATA
+    vec(push(b"\x01"), op(OPC.OP_1, OPC.OP_EQUAL), "MINIMALDATA",
+        "minimaldata", "0x01 data push where OP_1 required")
+
+    # ---- push size limits ----
+    vec(push(b"\x6a" * 520), op(OPC.OP_SIZE) + push(_num(520)) +
+        op(OPC.OP_EQUALVERIFY, OPC.OP_SIZE, OPC.OP_0NOTEQUAL), "",
+        "OK", "520-byte push is legal (MAX_SCRIPT_ELEMENT_SIZE)")
+    big = b"\x6a" * 521
+    vec(bytes([OPC.OP_PUSHDATA2]) + len(big).to_bytes(2, "little") + big,
+        op(OPC.OP_DROP, OPC.OP_1), "", "push-size", "521-byte push rejected")
+
+    # ---- control flow ----
+    vec(pushnum(1), op(OPC.OP_IF, OPC.OP_1, OPC.OP_ELSE, OPC.OP_0,
+                       OPC.OP_ENDIF), "", "OK", "IF true branch")
+    vec(pushnum(0), op(OPC.OP_IF, OPC.OP_0, OPC.OP_ELSE, OPC.OP_1,
+                       OPC.OP_ENDIF), "", "OK", "ELSE branch")
+    vec(pushnum(0), op(OPC.OP_NOTIF, OPC.OP_1, OPC.OP_ENDIF), "",
+        "OK", "NOTIF on false")
+    vec(pushnum(1), op(OPC.OP_IF, OPC.OP_1), "", "unbalanced-conditional",
+        "IF without ENDIF")
+    vec(b"", op(OPC.OP_ELSE, OPC.OP_1), "", "unbalanced-conditional",
+        "ELSE without IF")
+    vec(b"", op(OPC.OP_ENDIF, OPC.OP_1), "", "unbalanced-conditional",
+        "ENDIF without IF")
+    vec(b"", op(OPC.OP_IF, OPC.OP_1, OPC.OP_ENDIF), "",
+        "invalid-stack-operation", "IF with empty stack")
+    vec(pushnum(0) + pushnum(1),
+        op(OPC.OP_IF, OPC.OP_IF, OPC.OP_0, OPC.OP_ELSE, OPC.OP_1,
+           OPC.OP_ENDIF, OPC.OP_ELSE, OPC.OP_0, OPC.OP_ENDIF),
+        "", "OK", "nested IF: outer true, inner false takes inner ELSE")
+
+    # ---- VERIFY / RETURN ----
+    vec(pushnum(1), op(OPC.OP_VERIFY, OPC.OP_1), "", "OK", "VERIFY true")
+    vec(pushnum(0), op(OPC.OP_VERIFY, OPC.OP_1), "", "verify", "VERIFY false")
+    vec(b"", op(OPC.OP_RETURN), "", "op-return", "OP_RETURN fails")
+    vec(pushnum(1), op(OPC.OP_RETURN), "", "op-return",
+        "OP_RETURN fails with true on stack")
+
+    # ---- stack ops ----
+    vec(pushnum(7), op(OPC.OP_DUP, OPC.OP_EQUAL), "", "OK", "DUP")
+    vec(pushnum(1) + pushnum(0), op(OPC.OP_DROP), "", "OK", "DROP")
+    vec(pushnum(1) + pushnum(2),
+        op(OPC.OP_SWAP) + pushnum(1) + op(OPC.OP_EQUALVERIFY) + pushnum(2) +
+        op(OPC.OP_EQUAL), "", "OK", "SWAP order")
+    vec(b"", op(OPC.OP_DUP), "", "invalid-stack-operation",
+        "DUP on empty stack")
+    vec(pushnum(5), op(OPC.OP_DEPTH, OPC.OP_1, OPC.OP_EQUALVERIFY,
+                       OPC.OP_5, OPC.OP_EQUAL), "", "OK", "DEPTH counts")
+    vec(pushnum(1) + pushnum(2) + pushnum(3),
+        op(OPC.OP_ROT) + pushnum(1) + op(OPC.OP_EQUALVERIFY) + pushnum(3) +
+        op(OPC.OP_EQUALVERIFY) + pushnum(2) + op(OPC.OP_EQUAL),
+        "", "OK", "ROT rotation")
+    vec(pushnum(9) + pushnum(8),
+        op(OPC.OP_OVER) + pushnum(9) + op(OPC.OP_EQUALVERIFY, OPC.OP_2DROP,
+                                          OPC.OP_1), "", "OK", "OVER copies")
+    vec(pushnum(4) + pushnum(5) + pushnum(1),
+        op(OPC.OP_PICK) + pushnum(4) + op(OPC.OP_EQUALVERIFY, OPC.OP_2DROP,
+                                          OPC.OP_1), "", "OK", "PICK depth 1")
+    vec(pushnum(4) + pushnum(5) + pushnum(1),
+        op(OPC.OP_ROLL) + pushnum(4) + op(OPC.OP_EQUALVERIFY, OPC.OP_DROP,
+                                          OPC.OP_1), "", "OK", "ROLL depth 1")
+    vec(pushnum(3), op(OPC.OP_IFDUP, OPC.OP_EQUAL), "", "OK",
+        "IFDUP duplicates nonzero")
+    vec(pushnum(6), op(OPC.OP_TOALTSTACK, OPC.OP_FROMALTSTACK) + pushnum(6) +
+        op(OPC.OP_EQUAL), "", "OK", "altstack round trip")
+    vec(b"", op(OPC.OP_FROMALTSTACK), "", "invalid-altstack-operation",
+        "FROMALTSTACK empty")
+    vec(pushnum(1) + pushnum(2), op(OPC.OP_NIP) + pushnum(2) +
+        op(OPC.OP_EQUAL), "", "OK", "NIP removes second")
+    vec(pushnum(1) + pushnum(2),
+        op(OPC.OP_TUCK, OPC.OP_DEPTH, OPC.OP_3, OPC.OP_EQUALVERIFY,
+           OPC.OP_2DROP), "", "OK", "TUCK inserts copy")
+
+    # ---- numeric ----
+    vec(pushnum(2) + pushnum(3), op(OPC.OP_ADD, OPC.OP_5, OPC.OP_EQUAL),
+        "", "OK", "2+3=5")
+    vec(pushnum(5) + pushnum(3), op(OPC.OP_SUB, OPC.OP_2, OPC.OP_EQUAL),
+        "", "OK", "5-3=2")
+    vec(pushnum(5), op(OPC.OP_NEGATE) + push(b"\x85") + op(OPC.OP_EQUAL),
+        "", "OK", "NEGATE encoding")
+    vec(push(b"\x85"), op(OPC.OP_ABS, OPC.OP_5, OPC.OP_EQUAL), "", "OK",
+        "ABS(-5)")
+    vec(pushnum(0), op(OPC.OP_NOT), "", "OK", "NOT 0 = 1")
+    vec(pushnum(11), op(OPC.OP_0NOTEQUAL), "", "OK", "0NOTEQUAL")
+    vec(pushnum(2) + pushnum(7), op(OPC.OP_MAX, OPC.OP_7, OPC.OP_EQUAL),
+        "", "OK", "MAX")
+    vec(pushnum(2) + pushnum(7), op(OPC.OP_MIN, OPC.OP_2, OPC.OP_EQUAL),
+        "", "OK", "MIN")
+    vec(pushnum(5) + pushnum(1) + pushnum(10), op(OPC.OP_WITHIN), "", "OK",
+        "WITHIN [1,10)")
+    vec(pushnum(1) + pushnum(1), op(OPC.OP_BOOLAND), "", "OK", "BOOLAND")
+    vec(pushnum(0) + pushnum(1), op(OPC.OP_BOOLOR), "", "OK", "BOOLOR")
+    vec(pushnum(3) + pushnum(3), op(OPC.OP_NUMEQUAL), "", "OK", "NUMEQUAL")
+    vec(pushnum(2) + pushnum(3), op(OPC.OP_LESSTHAN), "", "OK", "LESSTHAN")
+    vec(pushnum(3) + pushnum(2), op(OPC.OP_GREATERTHAN), "", "OK",
+        "GREATERTHAN")
+    vec(pushnum(1), op(OPC.OP_1ADD, OPC.OP_2, OPC.OP_EQUAL), "", "OK", "1ADD")
+    vec(pushnum(2), op(OPC.OP_1SUB, OPC.OP_1, OPC.OP_EQUAL), "", "OK", "1SUB")
+    # 5-byte number operand overflows CScriptNum
+    vec(push(b"\xff\xff\xff\xff\x7f"), op(OPC.OP_1ADD, OPC.OP_DROP, OPC.OP_1),
+        "", "unknown-error", "5-byte scriptnum operand rejected")
+    # but 5-byte result of arithmetic is fine to produce and compare raw
+    vec(push(b"\xff\xff\xff\x7f") + op(OPC.OP_DUP, OPC.OP_ADD),
+        push(b"\xfe\xff\xff\xff\x00") + op(OPC.OP_EQUAL), "",
+        "OK", "4-byte operands may produce 5-byte result")
+
+    # ---- hashing opcodes ----
+    msg = b"tpu"
+    vec(push(msg), op(OPC.OP_SHA256) + push(sha256(msg)) + op(OPC.OP_EQUAL),
+        "", "OK", "SHA256 vector")
+    vec(push(msg), op(OPC.OP_HASH256) + push(sha256d(msg)) + op(OPC.OP_EQUAL),
+        "", "OK", "HASH256 vector")
+    vec(push(msg), op(OPC.OP_RIPEMD160) + push(ripemd160(msg)) +
+        op(OPC.OP_EQUAL), "", "OK", "RIPEMD160 vector")
+    vec(push(msg), op(OPC.OP_HASH160) + push(hash160(msg)) + op(OPC.OP_EQUAL),
+        "", "OK", "HASH160 vector")
+
+    # ---- disabled opcodes: fail even in unexecuted branches ----
+    for name in ("OP_CAT", "OP_SUBSTR", "OP_LEFT", "OP_RIGHT", "OP_INVERT",
+                 "OP_AND", "OP_OR", "OP_XOR", "OP_2MUL", "OP_2DIV", "OP_MUL",
+                 "OP_DIV", "OP_MOD", "OP_LSHIFT", "OP_RSHIFT"):
+        code = getattr(OPC, name)
+        vec(pushnum(0), op(OPC.OP_IF, code, OPC.OP_ENDIF, OPC.OP_1), "",
+            "disabled-opcode", f"{name} disabled even unexecuted")
+
+    # ---- NOPs and upgradable NOPs ----
+    vec(b"", op(OPC.OP_NOP, OPC.OP_1), "", "OK", "NOP")
+    for nop in (OPC.OP_NOP1, OPC.OP_NOP4, OPC.OP_NOP10):
+        vec(b"", op(nop, OPC.OP_1), "", "OK", "upgradable NOP without flag")
+        vec(b"", op(nop, OPC.OP_1), "DISCOURAGE_UPGRADABLE_NOPS",
+            "discourage-upgradable-nops", "upgradable NOP discouraged")
+
+    # ---- CLTV / CSV (context-free failure modes; success in tx_valid) ----
+    vec(b"", op(OPC.OP_CHECKLOCKTIMEVERIFY, OPC.OP_1), "CHECKLOCKTIMEVERIFY",
+        "invalid-stack-operation", "CLTV empty stack")
+    vec(push(b"\x81"), op(OPC.OP_CHECKLOCKTIMEVERIFY, OPC.OP_DROP, OPC.OP_1),
+        "CHECKLOCKTIMEVERIFY", "negative-locktime", "CLTV negative")
+    vec(pushnum(1), op(OPC.OP_CHECKLOCKTIMEVERIFY, OPC.OP_DROP, OPC.OP_1),
+        "CHECKLOCKTIMEVERIFY", "unsatisfied-locktime",
+        "CLTV unmet (tx locktime 0)")
+    vec(b"", op(OPC.OP_CHECKSEQUENCEVERIFY, OPC.OP_1), "CHECKSEQUENCEVERIFY",
+        "invalid-stack-operation", "CSV empty stack")
+    vec(push(b"\x81"), op(OPC.OP_CHECKSEQUENCEVERIFY, OPC.OP_DROP, OPC.OP_1),
+        "CHECKSEQUENCEVERIFY", "negative-locktime", "CSV negative")
+    vec(pushnum(1), op(OPC.OP_CHECKLOCKTIMEVERIFY, OPC.OP_DROP, OPC.OP_1),
+        "", "OK", "CLTV is a NOP without its flag")
+
+    # ---- P2SH ----
+    redeem = op(OPC.OP_1)
+    p2sh = S.p2sh_script_for_redeem(redeem)
+    vec(push(redeem), p2sh, "P2SH", "OK", "P2SH redeem OP_1")
+    vec(push(redeem), p2sh, "", "OK", "P2SH pattern is plain hash-EQUAL pre-flag")
+    vec(push(op(OPC.OP_0)), p2sh, "P2SH", "eval-false",
+        "P2SH wrong redeem hash")
+    vec(op(OPC.OP_NOP) + push(redeem), p2sh, "P2SH", "sig-pushonly",
+        "P2SH scriptSig must be push-only")
+    redeem_false = op(OPC.OP_0)
+    p2sh_false = S.p2sh_script_for_redeem(redeem_false)
+    vec(push(redeem_false), p2sh_false, "P2SH", "eval-false",
+        "P2SH redeem evaluates false")
+    vec(pushnum(1) + push(redeem), p2sh, "P2SH,CLEANSTACK", "cleanstack",
+        "extra stack element under CLEANSTACK")
+    vec(push(redeem), p2sh, "P2SH,CLEANSTACK", "OK", "CLEANSTACK clean")
+    vec(op(OPC.OP_NOP) + pushnum(1), op(OPC.OP_1), "SIGPUSHONLY",
+        "sig-pushonly", "SIGPUSHONLY rejects non-push scriptSig")
+
+    # ---- CHECKSIG family ----
+    spk_pk = push(KEY.pubkey) + op(OPC.OP_CHECKSIG)
+    sig_ok = make_ctx_signature(spk_pk, SIGHASH_ALL)
+    vec(push(sig_ok), spk_pk, "", "OK", "P2PK valid sig (legacy ALL)")
+    vec(push(sig_ok), spk_pk, "STRICTENC,DERSIG,LOW_S,NULLFAIL", "OK",
+        "P2PK valid sig passes strict bundle")
+    # forkid signature
+    sig_fid = make_ctx_signature(spk_pk, SIGHASH_ALL | SIGHASH_FORKID,
+                                 forkid=True)
+    vec(push(sig_fid), spk_pk, "FORKID,STRICTENC", "OK",
+        "P2PK valid FORKID sig")
+    vec(push(sig_fid), spk_pk, "STRICTENC", "illegal-forkid",
+        "FORKID bit without FORKID flag")
+    vec(push(sig_ok), spk_pk, "FORKID,STRICTENC", "must-use-forkid",
+        "legacy sig when FORKID active")
+    # tampered sig
+    bad = bytearray(sig_ok)
+    bad[10] ^= 0x01
+    vec(push(bytes(bad)), spk_pk, "", "eval-false",
+        "tampered sig -> false, no NULLFAIL")
+    vec(push(bytes(bad)), spk_pk, "NULLFAIL", "sig-nullfail",
+        "tampered sig under NULLFAIL")
+    vec(b"\x00", spk_pk, "NULLFAIL", "eval-false",
+        "empty sig may fail quietly under NULLFAIL")
+    # P2PKH
+    spk_pkh = KEY.p2pkh_script()
+    sig_pkh = make_ctx_signature(spk_pkh, SIGHASH_ALL)
+    vec(push(sig_pkh) + push(KEY.pubkey), spk_pkh, "", "OK",
+        "P2PKH valid spend")
+    vec(push(sig_pkh) + push(KEY2.pubkey), spk_pkh, "", "equalverify",
+        "P2PKH wrong pubkey")
+    # CHECKSIGVERIFY
+    spk_csv = push(KEY.pubkey) + op(OPC.OP_CHECKSIGVERIFY, OPC.OP_1)
+    sig_csv = make_ctx_signature(spk_csv, SIGHASH_ALL)
+    vec(push(sig_csv), spk_csv, "", "OK", "CHECKSIGVERIFY valid")
+    vec(b"\x00", spk_csv, "", "checksigverify", "CHECKSIGVERIFY empty sig")
+    # hashtype variants (legacy + forkid)
+    for ht, name in ((SIGHASH_NONE, "NONE"), (SIGHASH_SINGLE, "SINGLE"),
+                     (SIGHASH_ALL | SIGHASH_ANYONECANPAY, "ALL|ACP"),
+                     (SIGHASH_NONE | SIGHASH_ANYONECANPAY, "NONE|ACP"),
+                     (SIGHASH_SINGLE | SIGHASH_ANYONECANPAY, "SINGLE|ACP")):
+        s = make_ctx_signature(spk_pk, ht)
+        vec(push(s), spk_pk, "STRICTENC", "OK", f"legacy {name} sig")
+        s = make_ctx_signature(spk_pk, ht | SIGHASH_FORKID, forkid=True)
+        vec(push(s), spk_pk, "FORKID,STRICTENC", "OK", f"forkid {name} sig")
+    # bad hashtype byte under STRICTENC
+    s20 = sig_ok[:-1] + b"\x20"
+    vec(push(s20), spk_pk, "STRICTENC", "sig-hashtype",
+        "undefined hashtype under STRICTENC")
+    vec(push(s20), spk_pk, "", "eval-false",
+        "undefined hashtype merely fails without STRICTENC")
+    # high-S
+    r, s_val = secp.sig_der_decode(sig_ok)
+    hi = secp.N - s_val
+    if hi < s_val:
+        r, s_val, hi = r, hi, s_val  # ensure hi is the high one
+        sig_low_body = secp.sig_der_encode(r, s_val)
+    high_sig = secp.sig_der_encode(r, max(s_val, secp.N - s_val)) + b"\x01"
+    low_sig = secp.sig_der_encode(r, min(s_val, secp.N - s_val)) + b"\x01"
+    # exactly one of the two verifies as the original; find which
+    vec(push(high_sig), spk_pk, "LOW_S", "sig-high-s",
+        "high-S rejected under LOW_S")
+    # non-canonical DER (long-form length) — lax parse ok, DERSIG rejects
+    body = sig_ok[:-1]
+    assert body[0] == 0x30
+    lax = b"\x30\x81" + bytes([body[1]]) + body[2:] + b"\x01"
+    vec(push(lax), spk_pk, "", "OK",
+        "BER long-form length accepted pre-DERSIG (parse_der_lax)")
+    vec(push(lax), spk_pk, "DERSIG", "sig-der",
+        "BER long-form length rejected by DERSIG")
+    # hybrid pubkey encoding under STRICTENC
+    uncompressed = secp.privkey_to_pubkey(KEY.secret, compressed=False)
+    hybrid = b"\x06" + uncompressed[1:]
+    spk_hyb = push(hybrid) + op(OPC.OP_CHECKSIG)
+    vec(b"\x00", spk_hyb, "STRICTENC", "pubkeytype",
+        "hybrid pubkey under STRICTENC")
+    vec(b"\x00", spk_hyb, "", "eval-false",
+        "hybrid pubkey merely fails without STRICTENC")
+
+    # ---- CHECKMULTISIG ----
+    keys2 = [KEY, KEY2]
+    ms12 = S.multisig_script(1, [k.pubkey for k in keys2])
+    s1 = make_ctx_signature(ms12, SIGHASH_ALL)
+    vec(b"\x00" + push(s1), ms12, "", "OK", "1-of-2 multisig (key 1)")
+    s2 = make_ctx_signature(ms12, SIGHASH_ALL, key=KEY2)
+    vec(b"\x00" + push(s2), ms12, "", "OK", "1-of-2 multisig (key 2)")
+    ms23 = S.multisig_script(2, [k.pubkey for k in (KEY, KEY2, KEY3)])
+    sa = make_ctx_signature(ms23, SIGHASH_ALL)
+    sb = make_ctx_signature(ms23, SIGHASH_ALL, key=KEY2)
+    sc = make_ctx_signature(ms23, SIGHASH_ALL, key=KEY3)
+    vec(b"\x00" + push(sa) + push(sb), ms23, "", "OK", "2-of-3 in order")
+    vec(b"\x00" + push(sb) + push(sc), ms23, "", "OK", "2-of-3 later keys")
+    vec(b"\x00" + push(sb) + push(sa), ms23, "", "eval-false",
+        "2-of-3 out of order fails")
+    vec(b"\x00" + push(sb) + push(sa), ms23, "NULLFAIL", "sig-nullfail",
+        "out-of-order multisig under NULLFAIL")
+    vec(b"\x00" + push(sa) + push(sa), ms23, "", "eval-false",
+        "same sig twice fails")
+    vec(pushnum(1) + push(sa) + push(sb), ms23, "NULLDUMMY", "sig-nulldummy",
+        "non-null dummy under NULLDUMMY")
+    vec(pushnum(1) + push(sa) + push(sb), ms23, "", "OK",
+        "non-null dummy tolerated without NULLDUMMY")
+    vec(b"\x00" + b"\x00" + b"\x00", ms23, "", "eval-false",
+        "empty sigs fail 2-of-3 quietly")
+    # CHECKMULTISIGVERIFY
+    msv = S.multisig_script(1, [KEY.pubkey])[:-1] + op(
+        OPC.OP_CHECKMULTISIGVERIFY, OPC.OP_1)
+    sv = make_ctx_signature(msv, SIGHASH_ALL)
+    vec(b"\x00" + push(sv), msv, "", "OK", "CHECKMULTISIGVERIFY valid")
+    vec(b"\x00" + b"\x00", msv, "", "checkmultisigverify",
+        "CHECKMULTISIGVERIFY failure")
+    # pubkey/sig count bounds
+    too_many = op(OPC.OP_1) + b"".join(push(KEY.pubkey) for _ in range(21)) + \
+        push(_num(21)) + op(OPC.OP_CHECKMULTISIG)
+    vec(b"\x00\x00", too_many, "", "pubkey-count", ">20 pubkeys")
+
+    # ---- op count limit (>201 non-push ops) ----
+    many_ops = op(OPC.OP_1) + op(*([OPC.OP_DUP, OPC.OP_DROP] * 101))
+    vec(b"", many_ops, "", "op-count", "202 ops exceeds MAX_OPS_PER_SCRIPT")
+    # script size limit
+    oversize = push(b"\x51" * 520) + op(OPC.OP_DROP)
+    oversize = oversize * 20 + op(OPC.OP_1)  # > 10000 bytes
+    vec(b"", oversize, "", "script-size", "script > 10000 bytes")
+
+
+def gen_sighash_vectors(rng: random.Random, n: int = 120) -> list:
+    """Random-tx digest vectors: [tx_hex, scriptCode_hex, in_idx, hashtype,
+    amount, legacy_hex|None, forkid_hex]. Legacy is None for the FORKID
+    hashtypes (undefined combination we never emit)."""
+    out = []
+    base_types = (SIGHASH_ALL, SIGHASH_NONE, SIGHASH_SINGLE)
+    for _ in range(n):
+        nin = rng.randint(1, 4)
+        nout = rng.randint(0, 4)
+        vin = tuple(
+            CTxIn(
+                COutPoint(rng.randbytes(32), rng.randint(0, 0xFFFF)),
+                rng.randbytes(rng.randint(0, 40)),
+                rng.choice((0xFFFFFFFF, 0xFFFFFFFE, 0, rng.randint(0, 1 << 31))),
+            )
+            for _ in range(nin)
+        )
+        vout = tuple(
+            CTxOut(rng.randint(0, 21_000_000 * 100_000_000),
+                   rng.randbytes(rng.randint(0, 48)))
+            for _ in range(nout)
+        )
+        tx = CTransaction(
+            version=rng.choice((1, 2)), vin=vin, vout=vout,
+            locktime=rng.randint(0, 0xFFFFFFFF),
+        )
+        in_idx = rng.randrange(nin)
+        # parseable script code: random pushes + simple ops, sometimes with
+        # OP_CODESEPARATOR (which legacy sighash must strip)
+        parts = []
+        for _p in range(rng.randint(1, 4)):
+            r = rng.random()
+            if r < 0.5:
+                parts.append(S.push_data_raw(rng.randbytes(rng.randint(0, 24))))
+            elif r < 0.8:
+                parts.append(bytes([rng.choice((S.OP_DUP, S.OP_HASH160,
+                                                S.OP_EQUALVERIFY,
+                                                S.OP_CHECKSIG, S.OP_NOP))]))
+            else:
+                parts.append(bytes([S.OP_CODESEPARATOR]))
+        sc = b"".join(parts)
+        amount = rng.randint(0, 21_000_000 * 100_000_000)
+        ht = rng.choice(base_types) | rng.choice((0, SIGHASH_ANYONECANPAY))
+        legacy = signature_hash_legacy(sc, tx, in_idx, ht)
+        forkid = signature_hash_forkid(sc, tx, in_idx, ht | SIGHASH_FORKID,
+                                       amount)
+        out.append([tx.serialize().hex(), sc.hex(), in_idx, ht, amount,
+                    legacy.hex(), forkid.hex()])
+    # the SIGHASH_SINGLE out-of-range bug: digest is uint256(1)
+    tx = CTransaction(
+        version=1,
+        vin=(CTxIn(COutPoint(b"\x11" * 32, 0), b"", 0xFFFFFFFF),
+             CTxIn(COutPoint(b"\x22" * 32, 1), b"", 0xFFFFFFFF)),
+        vout=(CTxOut(50_000, b"\x51"),),
+        locktime=0,
+    )
+    legacy = signature_hash_legacy(b"\x51", tx, 1, SIGHASH_SINGLE)
+    assert legacy == (1).to_bytes(32, "little"), "SIGHASH_SINGLE bug vector"
+    out.append([tx.serialize().hex(), "51", 1, SIGHASH_SINGLE, 0,
+                legacy.hex(),
+                signature_hash_forkid(b"\x51", tx, 1,
+                                      SIGHASH_SINGLE | SIGHASH_FORKID,
+                                      0).hex()])
+    return out
+
+
+TX_VALID: list[dict] = []
+TX_INVALID: list[dict] = []
+
+
+def run_tx_vector(entry: dict) -> str:
+    tx = CTransaction.deserialize(ByteReader(bytes.fromhex(entry["tx"])))
+    flags = parse_flags(entry["flags"])
+    try:
+        for i, (txin, (_h, _n, spk_hex, amount)) in enumerate(
+            zip(tx.vin, entry["inputs"])
+        ):
+            checker = TransactionSignatureChecker(tx, i, amount)
+            VerifyScript(txin.script_sig, bytes.fromhex(spk_hex), flags,
+                         checker)
+        return "OK"
+    except ScriptError as e:
+        return e.code
+
+
+def tx_vec(valid: bool, inputs, tx: CTransaction, flags: str, expect: str,
+           desc: str):
+    entry = {
+        "inputs": [[h.hex(), n, spk.hex(), amount]
+                   for (h, n, spk, amount) in inputs],
+        "tx": tx.serialize().hex(),
+        "flags": flags,
+        "expect": expect,
+        "desc": desc,
+    }
+    got = run_tx_vector(entry)
+    if got != expect:
+        raise SystemExit(
+            f"TX VECTOR MISMATCH: {desc!r}\n  expected {expect}, got {got}"
+        )
+    (TX_VALID if valid else TX_INVALID).append(entry)
+
+
+def gen_tx_vectors():
+    prev = b"\x77" * 32
+    spk = KEY.p2pkh_script()
+    amount = 5_000_000_000
+
+    def spend_tx(nin=1, locktime=0, sequence=0xFFFFFFFF, value=None):
+        vin = tuple(CTxIn(COutPoint(prev, i), b"", sequence)
+                    for i in range(nin))
+        vout = (CTxOut(value if value is not None else amount - 10_000,
+                       b"\x51"),)
+        return CTransaction(version=2, vin=vin, vout=vout, locktime=locktime)
+
+    # valid P2PKH single input, forkid bundle
+    tx = spend_tx()
+    signed = sign_transaction(
+        tx, [(spk, amount)], lambda i: KEY if i == KEY.pubkey_hash else None,
+        enable_forkid=True,
+    )
+    tx_vec(True, [(prev, 0, spk, amount)], signed,
+           "P2SH,STRICTENC,DERSIG,LOW_S,NULLFAIL,NULLDUMMY,FORKID", "OK",
+           "P2PKH forkid spend, post-fork flag bundle")
+    # same, legacy (pre-fork)
+    signed_legacy = sign_transaction(
+        tx, [(spk, amount)], lambda i: KEY if i == KEY.pubkey_hash else None,
+        enable_forkid=False,
+    )
+    tx_vec(True, [(prev, 0, spk, amount)], signed_legacy, "P2SH", "OK",
+           "P2PKH legacy spend, pre-fork flags")
+    # two inputs
+    tx2 = spend_tx(nin=2)
+    signed2 = sign_transaction(
+        tx2, [(spk, amount), (spk, amount)],
+        lambda i: KEY if i == KEY.pubkey_hash else None, enable_forkid=True,
+    )
+    tx_vec(True, [(prev, 0, spk, amount), (prev, 1, spk, amount)], signed2,
+           "P2SH,STRICTENC,NULLFAIL,FORKID", "OK", "two-input P2PKH spend")
+    # P2SH multisig 2-of-3
+    redeem = S.multisig_script(2, [KEY.pubkey, KEY2.pubkey, KEY3.pubkey])
+    p2sh = S.p2sh_script_for_redeem(redeem)
+    keymap = {KEY.pubkey: KEY, KEY2.pubkey: KEY2, KEY3.pubkey: KEY3}
+    tx3 = spend_tx()
+    signed3 = sign_transaction(
+        tx3, [(p2sh, amount)], lambda i: keymap.get(i),
+        enable_forkid=True, redeem_scripts={hash160(redeem): redeem},
+    )
+    tx_vec(True, [(prev, 0, p2sh, amount)], signed3,
+           "P2SH,STRICTENC,NULLFAIL,NULLDUMMY,FORKID", "OK",
+           "P2SH 2-of-3 multisig spend")
+    # bare multisig
+    ms = S.multisig_script(1, [KEY2.pubkey])
+    tx4 = spend_tx()
+    signed4 = sign_transaction(
+        tx4, [(ms, amount)], lambda i: keymap.get(i), enable_forkid=True,
+    )
+    tx_vec(True, [(prev, 0, ms, amount)], signed4,
+           "STRICTENC,NULLFAIL,NULLDUMMY,FORKID", "OK",
+           "bare 1-of-1 multisig spend")
+    # CLTV satisfied: tx locktime 500 >= required 400, sequence non-final
+    cltv_spk = push(_num(400)) + op(S.OP_CHECKLOCKTIMEVERIFY, S.OP_DROP) + \
+        push(KEY.pubkey) + op(S.OP_CHECKSIG)
+    txl = spend_tx(locktime=500, sequence=0xFFFFFFFE)
+    sig = make_signature(KEY, cltv_spk, txl, 0, amount,
+                         SIGHASH_ALL | SIGHASH_FORKID, enable_forkid=True)
+    txl_signed = CTransaction(
+        txl.version, (CTxIn(txl.vin[0].prevout, push(sig),
+                            txl.vin[0].sequence),),
+        txl.vout, txl.locktime,
+    )
+    tx_vec(True, [(prev, 0, cltv_spk, amount)], txl_signed,
+           "CHECKLOCKTIMEVERIFY,FORKID,NULLFAIL", "OK", "CLTV satisfied")
+    # CLTV unsatisfied: required 600 > locktime 500
+    cltv_spk2 = push(_num(600)) + op(S.OP_CHECKLOCKTIMEVERIFY, S.OP_DROP) + \
+        push(KEY.pubkey) + op(S.OP_CHECKSIG)
+    sig2 = make_signature(KEY, cltv_spk2, txl, 0, amount,
+                          SIGHASH_ALL | SIGHASH_FORKID, enable_forkid=True)
+    txl2 = CTransaction(
+        txl.version, (CTxIn(txl.vin[0].prevout, push(sig2),
+                            txl.vin[0].sequence),),
+        txl.vout, txl.locktime,
+    )
+    tx_vec(False, [(prev, 0, cltv_spk2, amount)], txl2,
+           "CHECKLOCKTIMEVERIFY,FORKID,NULLFAIL", "unsatisfied-locktime",
+           "CLTV unsatisfied")
+    # CSV satisfied: input sequence 20 relative blocks, spk requires 10
+    csv_spk = push(_num(10)) + op(S.OP_CHECKSEQUENCEVERIFY, S.OP_DROP) + \
+        push(KEY.pubkey) + op(S.OP_CHECKSIG)
+    txs = spend_tx(sequence=20)  # version 2, type flag clear -> blocks
+    sigs_ = make_signature(KEY, csv_spk, txs, 0, amount,
+                           SIGHASH_ALL | SIGHASH_FORKID, enable_forkid=True)
+    txs_signed = CTransaction(
+        txs.version, (CTxIn(txs.vin[0].prevout, push(sigs_), 20),),
+        txs.vout, txs.locktime,
+    )
+    tx_vec(True, [(prev, 0, csv_spk, amount)], txs_signed,
+           "CHECKSEQUENCEVERIFY,FORKID,NULLFAIL", "OK", "CSV satisfied")
+    # CSV unsatisfied: requires 30, sequence 20
+    csv_spk2 = push(_num(30)) + op(S.OP_CHECKSEQUENCEVERIFY, S.OP_DROP) + \
+        push(KEY.pubkey) + op(S.OP_CHECKSIG)
+    sig3 = make_signature(KEY, csv_spk2, txs, 0, amount,
+                          SIGHASH_ALL | SIGHASH_FORKID, enable_forkid=True)
+    txs2 = CTransaction(
+        txs.version, (CTxIn(txs.vin[0].prevout, push(sig3), 20),),
+        txs.vout, txs.locktime,
+    )
+    tx_vec(False, [(prev, 0, csv_spk2, amount)], txs2,
+           "CHECKSEQUENCEVERIFY,FORKID,NULLFAIL", "unsatisfied-locktime",
+           "CSV unsatisfied")
+    # wrong-amount forkid signature
+    signed_bad = sign_transaction(
+        spend_tx(), [(spk, amount + 1)],
+        lambda i: KEY if i == KEY.pubkey_hash else None, enable_forkid=True,
+    )
+    tx_vec(False, [(prev, 0, spk, amount)], signed_bad,
+           "STRICTENC,NULLFAIL,FORKID", "sig-nullfail",
+           "forkid sig commits to amount; mismatch fails")
+    # unsigned spend
+    tx_vec(False, [(prev, 0, spk, amount)], spend_tx(),
+           "STRICTENC,NULLFAIL,FORKID", "invalid-stack-operation",
+           "unsigned P2PKH spend")
+    # missing FORKID bit under post-fork flags
+    tx_vec(False, [(prev, 0, spk, amount)], signed_legacy,
+           "STRICTENC,NULLFAIL,FORKID", "must-use-forkid",
+           "legacy sig rejected post-fork")
+
+
+def main():
+    os.makedirs(DATA_DIR, exist_ok=True)
+    rng = random.Random(0xBC9)
+
+    gen_script_vectors()
+    sighash = gen_sighash_vectors(rng)
+    gen_tx_vectors()
+
+    def dump(name, comment, payload):
+        path = os.path.join(DATA_DIR, name)
+        with open(path, "w") as f:
+            json.dump([comment] + payload, f, indent=0)
+            f.write("\n")
+        print(f"wrote {path}: {len(payload)} vectors")
+
+    dump("script_tests.json",
+         "[scriptSig_hex, scriptPubKey_hex, flags, expect, desc] — "
+         "generated by tools/gen_vectors.py; do not hand-edit",
+         SCRIPT_VECTORS)
+    dump("sighash.json",
+         "[tx_hex, scriptCode_hex, in_idx, hashtype, amount, legacy_hex, "
+         "forkid_hex] — generated by tools/gen_vectors.py",
+         sighash)
+    dump("tx_valid.json",
+         "{inputs, tx, flags, expect, desc} — generated by tools/gen_vectors.py",
+         TX_VALID)
+    dump("tx_invalid.json",
+         "{inputs, tx, flags, expect, desc} — generated by tools/gen_vectors.py",
+         TX_INVALID)
+
+
+if __name__ == "__main__":
+    main()
