@@ -148,6 +148,19 @@ class Node:
         if self._blocks_since_flush >= self.flush_interval:
             self.chainstate.flush()
             self._blocks_since_flush = 0
+        # -blocknotify=<cmd>: run the shell hook with %s = new block hash
+        # (init.cpp BlockNotifyCallback); fire-and-forget, never blocks
+        # validation, only on the active tip like the reference
+        cmd = self.config.get("blocknotify")
+        if cmd and self.chainstate.tip() is idx:
+            import subprocess
+
+            from ..consensus.serialize import hash_to_hex as _h2h
+
+            try:
+                subprocess.Popen(cmd.replace("%s", _h2h(idx.hash)), shell=True)
+            except OSError as e:
+                log_printf("blocknotify failed: %r", e)
 
     def _on_block_disconnected(self, block: CBlock, idx) -> None:
         # BlockDisconnected: return the block's transactions to the mempool
@@ -177,13 +190,34 @@ class Node:
         return BlockAssembler(self.chainstate, self.mempool,
                               versionbits_cache=self.versionbits_cache)
 
+    def _select_sweep(self):
+        """Pick the PoW sweep for this backend: the specialized truncated-h7
+        kernel (ops/sha256_sweep) on a real accelerator — bit-identical
+        results via host re-verify, ~2x the generic sweep (ROOFLINE.md) —
+        and the generic looped sweep on CPU, where the unrolled kernel's
+        XLA compile is pathologically slow (ops/sha256._use_unrolled)."""
+        try:
+            from ..ops.sha256 import backend_is_cpu
+
+            if not backend_is_cpu():
+                from ..ops.sha256_sweep import sweep_header_fast
+
+                return sweep_header_fast
+        except Exception:
+            pass
+        from ..ops.miner import sweep_header
+
+        return sweep_header
+
     def generate_to_script(self, script_pubkey: bytes, n_blocks: int,
                            max_tries: int = MAX_TRIES_DEFAULT) -> list[bytes]:
         """generatetoaddress backend (src/rpc/mining.cpp generateBlocks)."""
         hashes: list[bytes] = []
         asm = self.assembler()
+        sweep = self._select_sweep()
         for _ in range(n_blocks):
-            block = mine_block(asm, script_pubkey, max_tries=max_tries)
+            block = mine_block(asm, script_pubkey, max_tries=max_tries,
+                               sweep=sweep)
             if block is None:
                 break
             self.chainstate.process_new_block(block)
@@ -366,10 +400,24 @@ class Node:
         from ..wallet.wallet import Wallet
 
         if self.wallet is None:
-            self.wallet = Wallet(params=self.params)
+            path = os.path.join(self.datadir, "wallet.json")
+            self.wallet = Wallet(params=self.params, path=path)
+            self.wallet.load()
+            if self.wallet._pkh_index or self.wallet.keys_by_pubkey:
+                self._rescan_wallet()  # ScanForWalletTransactions
             self.chainstate.on_block_connected.append(self.wallet.block_connected)
             self.chainstate.on_block_disconnected.append(self.wallet.block_disconnected)
         return self.wallet
+
+    def _rescan_wallet(self) -> None:
+        """CWallet::ScanForWalletTransactions over the active chain — a
+        reloaded wallet file has keys but no coin state."""
+        cs = self.chainstate
+        for height in range(cs.tip().height + 1):
+            idx = cs.chain[height]
+            block = cs.get_block(idx.hash)
+            if block is not None:
+                self.wallet.block_connected(block, idx)
 
     # -- lifecycle ------------------------------------------------------
 

@@ -53,6 +53,9 @@ class BatchStats:
     sigcache_hits: int = 0         # records dropped by the sigcache probe
     device_seconds: float = 0.0
     last_batch: int = 0
+    # P3 pipeline overlap: dispatches currently in flight / high-water mark
+    in_flight: int = 0
+    max_in_flight: int = 0
     buckets_used: dict = field(default_factory=dict)
 
     def snapshot(self) -> dict:
@@ -142,13 +145,46 @@ def _device_available() -> bool:
         return False
 
 
-def verify_batch(records: Sequence, backend: str = "auto") -> np.ndarray:
-    """Verify all records; returns (len(records),) bool.
+class BatchHandle:
+    """An in-flight verify dispatch (P3 pipeline overlap, SURVEY.md §3.2).
+
+    JAX dispatch is asynchronous: `dispatch_batch` returns immediately with
+    the device computation enqueued, and the host keeps interpreting the
+    next transactions' scripts while the chip verifies — the CCheckQueue
+    master/worker overlap, with XLA's async runtime as the worker pool.
+    `.result()` materializes (blocks) and finalizes stats."""
+
+    __slots__ = ("_n", "_bucket", "_device_ok", "_cpu_ok")
+
+    def __init__(self, n, bucket=0, device_ok=None, cpu_ok=None):
+        self._n = n
+        self._bucket = bucket
+        self._device_ok = device_ok
+        self._cpu_ok = cpu_ok
+
+    def result(self) -> np.ndarray:
+        if self._device_ok is None:
+            return self._cpu_ok
+        t0 = time.monotonic()
+        ok = np.asarray(self._device_ok)  # blocks until the chip finishes
+        # device_seconds counts only the blocking wait — when the P3
+        # overlap is doing its job the host hid the latency and this is
+        # near zero; summing dispatch->settle spans would double-count
+        # concurrent chunks and absorb host interpreter time.
+        STATS.device_seconds += time.monotonic() - t0
+        STATS.in_flight = max(0, STATS.in_flight - 1)
+        self._device_ok = None
+        self._cpu_ok = ok[: self._n]
+        return self._cpu_ok
+
+
+def dispatch_batch(records: Sequence, backend: str = "auto") -> BatchHandle:
+    """Enqueue a verify batch without waiting; returns a BatchHandle.
 
     backend: "auto" (device if available and batch >= CPU_FLOOR),
-    "device" (force), "cpu" (force oracle)."""
+    "device" (force), "cpu" (force oracle — synchronous)."""
     if not records:
-        return np.zeros(0, bool)
+        return BatchHandle(0, cpu_ok=np.zeros(0, bool))
     use_device = backend == "device" or (
         backend == "auto"
         and len(records) >= CPU_FLOOR
@@ -156,25 +192,23 @@ def verify_batch(records: Sequence, backend: str = "auto") -> np.ndarray:
     )
     if not use_device:
         STATS.cpu_fallback_sigs += len(records)
-        return _verify_cpu(records)
-
-    import jax
+        return BatchHandle(len(records), cpu_ok=_verify_cpu(records))
 
     from . import secp256k1 as dev
 
     bucket = _bucket_for(len(records))
     arrays = pack_records(records, bucket)
-    t0 = time.monotonic()
-    ok = np.asarray(
-        jax.block_until_ready(
-            dev.ecdsa_verify_batch_jit(*map(np.asarray, arrays))
-        )
-    )
-    dt = time.monotonic() - t0
+    device_ok = dev.ecdsa_verify_batch_jit(*map(np.asarray, arrays))
     STATS.dispatches += 1
     STATS.sigs_verified += len(records)
     STATS.sigs_padded += bucket - len(records)
-    STATS.device_seconds += dt
     STATS.last_batch = len(records)
     STATS.buckets_used[bucket] = STATS.buckets_used.get(bucket, 0) + 1
-    return ok[: len(records)]
+    STATS.in_flight += 1
+    STATS.max_in_flight = max(STATS.max_in_flight, STATS.in_flight)
+    return BatchHandle(len(records), bucket, device_ok)
+
+
+def verify_batch(records: Sequence, backend: str = "auto") -> np.ndarray:
+    """Verify all records synchronously; returns (len(records),) bool."""
+    return dispatch_batch(records, backend).result()

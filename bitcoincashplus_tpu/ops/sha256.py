@@ -59,6 +59,24 @@ def bswap32(x):
     )
 
 
+def backend_is_cpu() -> bool:
+    """True when computation effectively runs on the XLA CPU backend.
+
+    JAX_PLATFORMS=cpu (driver dryrun / CI) beats backend autodetection —
+    the axon TPU plugin wins default-backend selection even then, but
+    meshes built by parallel/mesh.local_devices honor the env var, so the
+    computation really runs on CPU. Shared by every caller that picks a
+    compile-friendly form per backend (here, node._select_sweep) so the
+    detection logic has exactly one home.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() == "cpu":
+        return True
+    dd = jax.config.jax_default_device
+    if dd is not None:
+        return dd.platform == "cpu"
+    return jax.default_backend() == "cpu"
+
+
 def _use_unrolled() -> bool:
     """Unrolled rounds on TPU (best VPU schedule), lax.fori_loop on CPU.
 
@@ -71,16 +89,7 @@ def _use_unrolled() -> bool:
     override = os.environ.get("BCP_SHA_UNROLL")
     if override is not None:
         return override not in ("0", "false", "")
-    # JAX_PLATFORMS=cpu (driver dryrun / CI) beats backend autodetection —
-    # the axon TPU plugin wins default-backend selection even then, but
-    # meshes built by parallel/mesh.local_devices honor the env var, so the
-    # computation really runs on CPU.
-    if os.environ.get("JAX_PLATFORMS", "").split(",")[0].strip() == "cpu":
-        return False
-    dd = jax.config.jax_default_device
-    if dd is not None:
-        return dd.platform != "cpu"
-    return jax.default_backend() != "cpu"
+    return not backend_is_cpu()
 
 
 def _compress_unrolled(state8: list, w16: list) -> list:

@@ -9,4 +9,4 @@ transport is Python's stdlib http.server instead of libevent.
 from .registry import RPCError, rpc_method, RPC_METHODS  # noqa: F401
 
 # import for registration side effects
-from . import blockchain, control, mining, net, rawtransaction  # noqa: F401,E402
+from . import blockchain, control, mining, net, rawtransaction, wallet  # noqa: F401,E402

@@ -109,6 +109,33 @@ def _make_handler(server: RPCServer):
             self.end_headers()
             self.wfile.write(payload)
 
+        def do_GET(self):
+            """-rest interface (src/rest.cpp): unauthenticated GET routes,
+            enabled by the `rest` config flag; 403 otherwise."""
+            from .rest import RestError, handle_rest
+
+            if not server.node.config.get_bool("rest"):
+                payload = b"REST interface disabled (enable with -rest)\n"
+                self.send_response(403)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+                return
+            try:
+                status, ctype, body = handle_rest(server.node, self.path)
+            except RestError as e:
+                status, ctype = e.status, "text/plain"
+                body = (e.message + "\r\n").encode()
+            except Exception as e:  # parity with the POST-side wrapping
+                log_printf("REST internal error %s: %r", self.path, e)
+                status, ctype, body = 500, "text/plain", b"internal error\r\n"
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_POST(self):
             auth = self.headers.get("Authorization", "")
             if auth != f"Basic {server._auth}":

@@ -1,0 +1,203 @@
+"""Wallet RPCs — src/wallet/rpcwallet.cpp / rpcdump.cpp.
+
+The wallet is loaded lazily on first wallet-RPC use (the reference loads at
+init; lazy keeps non-wallet nodes wallet-free). All handlers already hold
+cs_main via the server dispatch; wallet state is only touched under it.
+"""
+
+from __future__ import annotations
+
+from ..consensus.serialize import hash_to_hex
+from ..consensus.tx import COIN
+from ..mempool.mempool import MempoolError
+from ..wallet.keys import CKey
+from ..wallet.wallet import WalletError
+from .registry import (
+    RPC_INVALID_ADDRESS_OR_KEY,
+    RPC_INVALID_PARAMETER,
+    RPC_MISC_ERROR,
+    RPCError,
+    require_params,
+    rpc_method,
+)
+
+RPC_WALLET_ERROR = -4
+RPC_WALLET_PASSPHRASE_INCORRECT = -14
+RPC_WALLET_WRONG_ENC_STATE = -15
+RPC_WALLET_UNLOCK_NEEDED = -13
+
+
+def _wallet(node):
+    w = node.load_wallet()
+    w.maybe_relock()
+    return w
+
+
+@rpc_method("getnewaddress")
+def getnewaddress(node, params):
+    require_params(params, 0, 1, "getnewaddress ( \"account\" )")
+    try:
+        return _wallet(node).get_new_address()
+    except WalletError as e:
+        raise RPCError(RPC_WALLET_UNLOCK_NEEDED, str(e)) from None
+
+
+@rpc_method("getbalance")
+def getbalance(node, params):
+    w = _wallet(node)
+    return w.balance(node.chainstate.tip().height) / COIN
+
+
+@rpc_method("listunspent")
+def listunspent(node, params):
+    w = _wallet(node)
+    tip = node.chainstate.tip().height
+    out = []
+    for coin in w.available_coins(tip):
+        out.append({
+            "txid": hash_to_hex(coin.outpoint.hash),
+            "vout": coin.outpoint.n,
+            "amount": coin.txout.value / COIN,
+            "confirmations": tip - coin.height + 1,
+            "scriptPubKey": coin.txout.script_pubkey.hex(),
+            "spendable": not w.is_locked,
+        })
+    return out
+
+
+@rpc_method("sendtoaddress")
+def sendtoaddress(node, params):
+    require_params(params, 2, 2, "sendtoaddress \"address\" amount")
+    address = params[0]
+    amount = int(round(float(params[1]) * COIN))
+    if amount <= 0:
+        raise RPCError(RPC_INVALID_PARAMETER, "Invalid amount for send")
+    w = _wallet(node)
+    try:
+        tx = w.create_transaction(
+            address, amount, node.chainstate.tip().height, enable_forkid=True
+        )
+    except WalletError as e:
+        raise RPCError(RPC_WALLET_UNLOCK_NEEDED, str(e)) from None
+    except ValueError as e:
+        raise RPCError(RPC_WALLET_ERROR, str(e)) from None
+    try:
+        node.accept_to_mempool(tx)
+    except MempoolError as e:
+        raise RPCError(RPC_WALLET_ERROR, f"transaction rejected: {e}") from None
+    if node.connman is not None:
+        node.connman.relay_tx(tx.txid)
+    return tx.txid_hex
+
+
+@rpc_method("getwalletinfo")
+def getwalletinfo(node, params):
+    w = _wallet(node)
+    tip = node.chainstate.tip().height
+    info = {
+        "walletname": "wallet.json",
+        "balance": w.balance(tip) / COIN,
+        "txcount": len(w.coins),
+        "keypoolsize": len(w.keys_by_pubkey) or len(w.encrypted_keys),
+    }
+    if w.is_crypted:
+        info["unlocked_until"] = (
+            0 if w.is_locked else int(w.unlocked_until)
+        )
+    return info
+
+
+@rpc_method("encryptwallet")
+def encryptwallet(node, params):
+    require_params(params, 1, 1, "encryptwallet \"passphrase\"")
+    w = _wallet(node)
+    if w.is_crypted:
+        raise RPCError(RPC_WALLET_WRONG_ENC_STATE,
+                       "Wallet is already encrypted")
+    try:
+        w.encrypt(str(params[0]))
+    except WalletError as e:
+        raise RPCError(RPC_MISC_ERROR, str(e)) from None
+    # the reference shuts down after encryptwallet; we just lock
+    return ("wallet encrypted; the wallet is now locked — use "
+            "walletpassphrase to unlock")
+
+
+@rpc_method("walletpassphrase")
+def walletpassphrase(node, params):
+    require_params(params, 2, 2, "walletpassphrase \"passphrase\" timeout")
+    w = _wallet(node)
+    if not w.is_crypted:
+        raise RPCError(RPC_WALLET_WRONG_ENC_STATE,
+                       "running with an unencrypted wallet, but "
+                       "walletpassphrase was called")
+    timeout = float(params[1])
+    if timeout <= 0:
+        raise RPCError(RPC_INVALID_PARAMETER, "timeout must be positive")
+    if not w.unlock(str(params[0]), timeout):
+        raise RPCError(RPC_WALLET_PASSPHRASE_INCORRECT,
+                       "Error: The wallet passphrase entered was incorrect.")
+    return None
+
+
+@rpc_method("walletlock")
+def walletlock(node, params):
+    w = _wallet(node)
+    if not w.is_crypted:
+        raise RPCError(RPC_WALLET_WRONG_ENC_STATE,
+                       "running with an unencrypted wallet, but "
+                       "walletlock was called")
+    w.lock()
+    return None
+
+
+@rpc_method("walletpassphrasechange")
+def walletpassphrasechange(node, params):
+    require_params(params, 2, 2,
+                   "walletpassphrasechange \"oldpassphrase\" \"newpassphrase\"")
+    w = _wallet(node)
+    if not w.is_crypted:
+        raise RPCError(RPC_WALLET_WRONG_ENC_STATE,
+                       "running with an unencrypted wallet")
+    if not w.change_passphrase(str(params[0]), str(params[1])):
+        raise RPCError(RPC_WALLET_PASSPHRASE_INCORRECT,
+                       "Error: The wallet passphrase entered was incorrect.")
+    return None
+
+
+@rpc_method("dumpprivkey")
+def dumpprivkey(node, params):
+    require_params(params, 1, 1, "dumpprivkey \"address\"")
+    from ..wallet.keys import address_to_script
+    from ..script.script import get_script_ops
+
+    w = _wallet(node)
+    if w.is_locked:
+        raise RPCError(RPC_WALLET_UNLOCK_NEEDED,
+                       "Error: Please enter the wallet passphrase with "
+                       "walletpassphrase first.")
+    spk = address_to_script(params[0], node.params)
+    if spk is None:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Invalid address")
+    pkh = list(get_script_ops(spk))[2][1]
+    key = w.keys_by_pkh.get(pkh)
+    if key is None:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                       "Private key for address is not known")
+    return key.to_wif(node.params)
+
+
+@rpc_method("importprivkey")
+def importprivkey(node, params):
+    require_params(params, 1, 2, "importprivkey \"privkey\" ( \"label\" )")
+    w = _wallet(node)
+    key = CKey.from_wif(params[0], node.params)
+    if key is None:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                       "Invalid private key encoding")
+    try:
+        w.add_key(key)
+    except WalletError as e:
+        raise RPCError(RPC_WALLET_UNLOCK_NEEDED, str(e)) from None
+    node._rescan_wallet()
+    return None

@@ -89,10 +89,18 @@ class BlockScriptVerifier:
     """
 
     def __init__(self, params: ChainParams, backend: str = "auto",
-                 sigcache: Optional[SignatureCache] = None):
+                 sigcache: Optional[SignatureCache] = None,
+                 chunk: int = 4096):
         self.params = params
         self.backend = backend
         self.sigcache = sigcache if sigcache is not None else SignatureCache()
+        # P3 pipeline overlap (SURVEY.md §3.2): once this many deferred
+        # records accumulate, dispatch them to the chip WITHOUT waiting and
+        # keep interpreting the remaining transactions — host script work
+        # and device ECDSA verify run concurrently (JAX async dispatch as
+        # the CCheckQueue worker pool). Settlement at the end preserves the
+        # all-or-nothing block verdict and failure attribution.
+        self.chunk = chunk
 
     def __call__(self, block, idx, spent_per_tx) -> None:
         from .chainstate import BlockValidationError
@@ -104,61 +112,92 @@ class BlockScriptVerifier:
 
         records: list[SigCheckRecord] = []
         rec_attr: list[tuple[int, int]] = []  # (tx_index, input_index)
+        # in-flight chunks: (record_indices, keys, BatchHandle)
+        pending: list[tuple[list[int], list, object]] = []
+        dispatched = 0
+
+        def dispatch_from(start: int) -> int:
+            """Sigcache-probe records[start:] and enqueue the fresh ones."""
+            keys = [
+                SignatureCache.entry_key(r.msg_hash, r.r, r.s, r.pubkey)
+                for r in records[start:]
+            ]
+            fresh = [
+                start + j for j, key in enumerate(keys)
+                if not self.sigcache.contains(key)
+            ]
+            ecdsa_batch.STATS.sigcache_hits += (
+                len(records) - start - len(fresh)
+            )
+            if fresh:
+                handle = ecdsa_batch.dispatch_batch(
+                    [records[k] for k in fresh], backend=self.backend
+                )
+                pending.append(
+                    (fresh, [keys[k - start] for k in fresh], handle)
+                )
+            return len(records)
 
         assert len(spent_per_tx) == len(block.vtx) - 1, "spent coins mismatch"
-        for t, (tx, spent) in enumerate(
-            zip(block.vtx[1:], spent_per_tx), start=1
-        ):
-            cache = SighashCache(tx)
-            for i, (txin, coin) in enumerate(zip(tx.vin, spent)):
-                if defer:
-                    n_before = len(records)
-                    checker = DeferringSignatureChecker(
-                        tx, i, coin.out.value, records, cache
-                    )
-                else:
-                    # pre-NULLFAIL blocks: deferral unsound, verify inline
-                    checker = _InlineCountingChecker(
-                        tx, i, coin.out.value, cache
-                    )
+        try:
+            for t, (tx, spent) in enumerate(
+                zip(block.vtx[1:], spent_per_tx), start=1
+            ):
+                cache = SighashCache(tx)
+                for i, (txin, coin) in enumerate(zip(tx.vin, spent)):
+                    if defer:
+                        n_before = len(records)
+                        checker = DeferringSignatureChecker(
+                            tx, i, coin.out.value, records, cache
+                        )
+                    else:
+                        # pre-NULLFAIL blocks: deferral unsound, verify inline
+                        checker = _InlineCountingChecker(
+                            tx, i, coin.out.value, cache
+                        )
+                    try:
+                        VerifyScript(
+                            txin.script_sig, coin.out.script_pubkey, flags,
+                            checker
+                        )
+                    except ScriptError as e:
+                        raise BlockValidationError(
+                            "blk-bad-inputs",
+                            f"script failure ({e.code}) "
+                            f"tx {tx.txid_hex} input {i}",
+                        ) from e
+                    if defer:
+                        rec_attr.extend(
+                            (t, i) for _ in range(len(records) - n_before)
+                        )
+                # overlap point: enough records banked -> ship a chunk now
+                if len(records) - dispatched >= self.chunk:
+                    dispatched = dispatch_from(dispatched)
+
+            if dispatched < len(records):
+                dispatched = dispatch_from(dispatched)
+
+            # settle every in-flight chunk (in dispatch order)
+            while pending:
+                fresh, keys, handle = pending.pop(0)
+                ok = handle.result()
+                for lane, k in enumerate(fresh):
+                    if not ok[lane]:
+                        t, i = rec_attr[k]
+                        tx = block.vtx[t]
+                        raise BlockValidationError(
+                            "blk-bad-inputs",
+                            "signature verification failed "
+                            f"tx {tx.txid_hex} input {i}",
+                        )
+                for key in keys:
+                    self.sigcache.add(key)
+        finally:
+            # a script failure or bad chunk aborts the block mid-flight:
+            # drain the remaining handles so STATS.in_flight doesn't leak
+            # phantom dispatches into gettpuinfo
+            for _fresh, _keys, handle in pending:
                 try:
-                    VerifyScript(
-                        txin.script_sig, coin.out.script_pubkey, flags, checker
-                    )
-                except ScriptError as e:
-                    raise BlockValidationError(
-                        "blk-bad-inputs",
-                        f"script failure ({e.code}) tx {tx.txid_hex} input {i}",
-                    ) from e
-                if defer:
-                    rec_attr.extend(
-                        (t, i) for _ in range(len(records) - n_before)
-                    )
-
-        if not records:
-            return
-
-        # sigcache probe: drop already-known-valid records from the batch
-        keys = [
-            SignatureCache.entry_key(r.msg_hash, r.r, r.s, r.pubkey)
-            for r in records
-        ]
-        fresh = [
-            k for k, key in enumerate(keys) if not self.sigcache.contains(key)
-        ]
-        ecdsa_batch.STATS.sigcache_hits += len(records) - len(fresh)
-        if fresh:
-            ok = ecdsa_batch.verify_batch(
-                [records[k] for k in fresh], backend=self.backend
-            )
-            for lane, k in enumerate(fresh):
-                if not ok[lane]:
-                    t, i = rec_attr[k]
-                    tx = block.vtx[t]
-                    raise BlockValidationError(
-                        "blk-bad-inputs",
-                        "signature verification failed "
-                        f"tx {tx.txid_hex} input {i}",
-                    )
-            for k in fresh:
-                self.sigcache.add(keys[k])
+                    handle.result()
+                except Exception:
+                    pass

@@ -1,22 +1,38 @@
-"""Wallet — key store + owned-coin tracking + spend builder.
+"""Wallet — key store + owned-coin tracking + spend builder + encryption.
 
 Reference: src/wallet/wallet.cpp (CWallet::AddToWallet via the
 BlockConnected signal, CWallet::CreateTransaction, AvailableCoins,
-coin selection). Simplified: keypool is generate-on-demand, coin
+coin selection), src/wallet/crypter.cpp (CCryptoKeyStore: master-key
+encryption, Lock/Unlock). Simplified: keypool is generate-on-demand, coin
 selection is largest-first (the reference's knapsack is a policy
-optimization, not consensus), storage is the node's kvstore.
+optimization, not consensus), storage is a JSON wallet file in the datadir
+(wallet.dat's role without BDB).
 """
 
 from __future__ import annotations
 
+import json
+import os
+import time
 from typing import Optional
 
 from ..consensus.params import ChainParams
 from ..consensus.tx import COutPoint, CTransaction, CTxIn, CTxOut
 from ..script.script import classify_script, get_script_ops
 from ..script.sighash import SIGHASH_ALL
+from .crypter import (
+    MasterKey,
+    decrypt_secret,
+    encrypt_secret,
+    new_master_key,
+    unseal_master_key,
+)
 from .keys import CKey, address_to_script
 from .signing import sign_transaction
+
+
+class WalletError(Exception):
+    pass
 
 
 class WalletCoin:
@@ -33,23 +49,178 @@ class WalletCoin:
 class Wallet:
     """In-memory wallet; persistence via export_keys/import_keys (WIF)."""
 
-    def __init__(self, params: ChainParams):
+    def __init__(self, params: ChainParams, path: Optional[str] = None):
         self.params = params
+        self.path = path
         self.keys_by_pkh: dict[bytes, CKey] = {}
         self.keys_by_pubkey: dict[bytes, CKey] = {}
         self.coins: dict[COutPoint, WalletCoin] = {}
         self.spent: set[COutPoint] = set()
+        # CCryptoKeyStore state: pubkey -> (ciphertext, compressed). The
+        # pkh index survives Lock so IsMine keeps answering while locked.
+        self.master_key_record: Optional[MasterKey] = None
+        self.encrypted_keys: dict[bytes, tuple[bytes, bool]] = {}
+        self._master: Optional[bytes] = None
+        self._pkh_index: dict[bytes, bytes] = {}  # pkh -> pubkey
+        self.unlocked_until: float = 0.0
+
+    # -- encryption (CCryptoKeyStore) --
+
+    @property
+    def is_crypted(self) -> bool:
+        return self.master_key_record is not None
+
+    @property
+    def is_locked(self) -> bool:
+        return self.is_crypted and self._master is None
+
+    def encrypt(self, passphrase: str) -> None:
+        """EncryptWallet: seal every key under a fresh master key, then
+        Lock (the reference requires walletpassphrase afterwards)."""
+        if self.is_crypted:
+            raise WalletError("wallet already encrypted")
+        if not passphrase:
+            raise WalletError("passphrase must not be empty")
+        record, master = new_master_key(passphrase)
+        for pubkey, key in self.keys_by_pubkey.items():
+            ct = encrypt_secret(master, key.secret.to_bytes(32, "big"), pubkey)
+            self.encrypted_keys[pubkey] = (ct, key.compressed)
+        self.master_key_record = record
+        self.lock()
+        self.save()
+
+    def lock(self) -> None:
+        if not self.is_crypted:
+            raise WalletError("wallet is not encrypted")
+        self._master = None
+        self.unlocked_until = 0.0
+        self.keys_by_pkh.clear()
+        self.keys_by_pubkey.clear()
+
+    def unlock(self, passphrase: str, timeout: float = 0) -> bool:
+        """Unlock: False on wrong passphrase. timeout 0 = until lock()."""
+        if not self.is_crypted:
+            raise WalletError("wallet is not encrypted")
+        master = unseal_master_key(self.master_key_record, passphrase)
+        if master is None:
+            return False
+        restored = []
+        for pubkey, (ct, compressed) in self.encrypted_keys.items():
+            sec = decrypt_secret(master, ct, pubkey)
+            if sec is None:
+                return False
+            key = CKey(int.from_bytes(sec, "big"), compressed)
+            if key.pubkey != pubkey:  # integrity check (crypter.cpp Unlock)
+                return False
+            restored.append(key)
+        for key in restored:
+            self.keys_by_pkh[key.pubkey_hash] = key
+            self.keys_by_pubkey[key.pubkey] = key
+        self._master = master
+        self.unlocked_until = time.time() + timeout if timeout else 0.0
+        return True
+
+    def maybe_relock(self) -> None:
+        """nWalletUnlockTime expiry (rpcwallet.cpp LockWallet timer)."""
+        if (self.is_crypted and self._master is not None
+                and self.unlocked_until and time.time() > self.unlocked_until):
+            self.lock()
+
+    def change_passphrase(self, old: str, new: str) -> bool:
+        if not self.is_crypted:
+            raise WalletError("wallet is not encrypted")
+        master = unseal_master_key(self.master_key_record, old)
+        if master is None:
+            return False
+        record, fresh = new_master_key(new)
+        # re-seal every secret under the new master key
+        new_encrypted = {}
+        for pubkey, (ct, compressed) in self.encrypted_keys.items():
+            sec = decrypt_secret(master, ct, pubkey)
+            if sec is None:
+                return False
+            new_encrypted[pubkey] = (
+                encrypt_secret(fresh, sec, pubkey), compressed
+            )
+        self.encrypted_keys = new_encrypted
+        self.master_key_record = record
+        if self._master is not None:
+            self._master = fresh
+        self.save()
+        return True
 
     # -- keys --
 
-    def add_key(self, key: CKey) -> None:
+    def add_key(self, key: CKey, persist: bool = True) -> None:
+        if self.is_locked:
+            raise WalletError("cannot add keys to a locked wallet")
         self.keys_by_pkh[key.pubkey_hash] = key
         self.keys_by_pubkey[key.pubkey] = key
+        self._pkh_index[key.pubkey_hash] = key.pubkey
+        if self.is_crypted:
+            self.encrypted_keys[key.pubkey] = (
+                encrypt_secret(self._master, key.secret.to_bytes(32, "big"),
+                               key.pubkey),
+                key.compressed,
+            )
+        if persist:
+            self.save()
 
     def get_new_address(self) -> str:
         key = CKey.generate()
         self.add_key(key)
         return key.p2pkh_address(self.params)
+
+    # -- persistence (wallet.dat role) --
+
+    def save(self) -> None:
+        if not self.path:
+            return
+        if self.is_crypted:
+            payload = {
+                "version": 1,
+                "master_key": self.master_key_record.to_dict(),
+                "encrypted_keys": [
+                    {"pubkey": pk.hex(), "ct": ct.hex(), "compressed": comp}
+                    for pk, (ct, comp) in self.encrypted_keys.items()
+                ],
+            }
+        else:
+            payload = {
+                "version": 1,
+                "keys": [
+                    {"wif": k.to_wif(self.params)}
+                    for k in self.keys_by_pubkey.values()
+                ],
+            }
+        tmp = self.path + ".tmp"
+        # 0600: the plaintext form carries WIF keys (same treatment as the
+        # RPC .cookie); encrypted form too — no reason to leak either
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, self.path)  # atomic, crash-safe
+
+    def load(self) -> None:
+        if not (self.path and os.path.exists(self.path)):
+            return
+        with open(self.path) as f:
+            payload = json.load(f)
+        if "master_key" in payload:
+            self.master_key_record = MasterKey.from_dict(payload["master_key"])
+            for rec in payload["encrypted_keys"]:
+                pubkey = bytes.fromhex(rec["pubkey"])
+                self.encrypted_keys[pubkey] = (
+                    bytes.fromhex(rec["ct"]), rec["compressed"]
+                )
+                from ..crypto.hashes import hash160
+
+                self._pkh_index[hash160(pubkey)] = pubkey
+        else:
+            for rec in payload.get("keys", []):
+                key = CKey.from_wif(rec["wif"], self.params)
+                if key is not None:
+                    self.add_key(key, persist=False)
 
     def key_for_id(self, ident: bytes) -> Optional[CKey]:
         """Solver callback: 20-byte pubkey hash or raw pubkey."""
@@ -58,15 +229,19 @@ class Wallet:
         return self.keys_by_pubkey.get(ident)
 
     def _is_mine(self, script_pubkey: bytes) -> bool:
-        """IsMine (src/script/ismine.cpp) for the templates we hold keys to."""
+        """IsMine (src/script/ismine.cpp) for the templates we hold keys to.
+        Answers from the lock-surviving indexes so a locked wallet still
+        tracks its coins (CCryptoKeyStore::HaveKey semantics)."""
         kind = classify_script(script_pubkey)
         try:
             if kind == "pubkeyhash":
                 ops = list(get_script_ops(script_pubkey))
-                return ops[2][1] in self.keys_by_pkh
+                return (ops[2][1] in self.keys_by_pkh
+                        or ops[2][1] in self._pkh_index)
             if kind == "pubkey":
                 ops = list(get_script_ops(script_pubkey))
-                return ops[0][1] in self.keys_by_pubkey
+                return (ops[0][1] in self.keys_by_pubkey
+                        or ops[0][1] in self.encrypted_keys)
         except Exception:
             return False
         return False
@@ -123,6 +298,10 @@ class Wallet:
     ) -> CTransaction:
         """CWallet::CreateTransaction: select coins (largest-first), build,
         sign, with change back to a fresh key."""
+        if self.is_locked:
+            raise WalletError(
+                "wallet is locked; unlock with walletpassphrase first"
+            )
         script_pubkey = address_to_script(address, self.params)
         if script_pubkey is None:
             raise ValueError(f"bad address {address}")

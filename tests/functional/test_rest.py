@@ -1,0 +1,95 @@
+"""REST interface (-rest) + -blocknotify functional test (src/rest.cpp,
+init.cpp BlockNotifyCallback) against a real bcpd process."""
+
+import glob
+import os
+import time
+import urllib.error
+import urllib.request
+
+from .framework import FunctionalFramework, wait_until
+from .test_node_basic import KEY, _regtest_address
+
+
+def _get(node, path):
+    url = f"http://127.0.0.1:{node.rpc_port}{path}"
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, r.read()
+
+
+def _get_status(node, path):
+    try:
+        return _get(node, path)[0]
+    except urllib.error.HTTPError as e:
+        return e.code
+
+
+def test_rest_and_blocknotify(tmp_path):
+    notify_dir = str(tmp_path)
+    notify_cmd = f"-blocknotify=touch {notify_dir}/notified_%s"
+    with FunctionalFramework(
+        num_nodes=1,
+        extra_args=[["-rest", "-txindex", "-listen=0", notify_cmd]],
+    ) as f:
+        node = f.nodes[0]
+        addr = _regtest_address(KEY)
+        hashes = node.rpc.generatetoaddress(5, addr)
+        tip = hashes[-1]
+
+        # chaininfo
+        status, body = _get(node, "/rest/chaininfo.json")
+        assert status == 200
+        import json
+
+        info = json.loads(body)
+        assert info["blocks"] == 5 and info["bestblockhash"] == tip
+
+        # block by hash, both formats
+        status, body = _get(node, f"/rest/block/{tip}.json")
+        assert status == 200
+        blk = json.loads(body)
+        assert blk["height"] == 5 and len(blk["tx"]) == 1
+        status, body = _get(node, f"/rest/block/{tip}.hex")
+        assert status == 200
+        raw = bytes.fromhex(body.decode().strip())
+        assert len(raw) == blk["size"]
+
+        # headers ascending from genesis-side hash
+        first = hashes[0]
+        status, body = _get(node, f"/rest/headers/5/{first}.hex")
+        assert status == 200
+        assert len(bytes.fromhex(body.decode().strip())) == 5 * 80
+
+        # blockhashbyheight
+        status, body = _get(node, "/rest/blockhashbyheight/3.json")
+        assert status == 200
+        assert json.loads(body)["blockhash"] == hashes[2]
+
+        # tx via txindex
+        coinbase_txid = blk["tx"][0]["txid"]
+        status, body = _get(node, f"/rest/tx/{coinbase_txid}.hex")
+        assert status == 200
+        assert len(body.decode().strip()) > 100
+
+        # mempool endpoints
+        assert _get(node, "/rest/mempool/info.json")[0] == 200
+        assert _get(node, "/rest/mempool/contents.json")[0] == 200
+
+        # error paths: unknown hash -> 404, bad format -> 400
+        assert _get_status(node, "/rest/block/" + "00" * 32 + ".json") == 404
+        assert _get_status(node, f"/rest/block/{tip}.xml") == 400
+        assert _get_status(node, "/rest/nonsense") == 404
+
+        # -blocknotify fired for the tip (fire-and-forget: allow a moment)
+        wait_until(
+            lambda: os.path.exists(os.path.join(notify_dir, f"notified_{tip}")),
+            timeout=15,
+        )
+        assert len(glob.glob(os.path.join(notify_dir, "notified_*"))) == 5
+
+
+def test_rest_disabled_is_403():
+    with FunctionalFramework(num_nodes=1,
+                             extra_args=[["-listen=0"]]) as f:
+        node = f.nodes[0]
+        assert _get_status(node, "/rest/chaininfo.json") == 403

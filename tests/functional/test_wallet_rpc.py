@@ -1,0 +1,71 @@
+"""Wallet RPC surface functional test — rpcwallet.cpp flows against a real
+bcpd process: mine to a wallet address, spend, encrypt, restart (wallet file
+reload + rescan), unlock, spend again."""
+
+import pytest
+
+from .framework import FunctionalFramework, wait_until
+from .test_node_basic import KEY, _regtest_address
+
+
+def _rpc_error_code(exc_info):
+    return getattr(exc_info.value, "code", None)
+
+
+def test_wallet_rpc_lifecycle():
+    with FunctionalFramework(num_nodes=1,
+                             extra_args=[["-listen=0"]]) as f:
+        node = f.nodes[0]
+        addr = node.rpc.getnewaddress()
+        assert addr.startswith(("m", "n"))  # regtest P2PKH prefixes
+
+        node.rpc.generatetoaddress(101, addr)
+        bal = node.rpc.getbalance()
+        assert bal == 100.0  # two mature 50-coin coinbases
+
+        # plain spend to a foreign address
+        dest = _regtest_address(KEY)
+        txid = node.rpc.sendtoaddress(dest, 1.5)
+        assert txid in node.rpc.getrawmempool()
+        unspent = node.rpc.listunspent()
+        assert all(u["spendable"] for u in unspent)
+
+        # encrypt: wallet locks; spending fails with unlock-needed
+        node.rpc.encryptwallet("secret phrase")
+        info = node.rpc.getwalletinfo()
+        assert info["unlocked_until"] == 0
+        from bitcoincashplus_tpu.rpc.client import JSONRPCException as RPCClientError
+
+        with pytest.raises(RPCClientError):
+            node.rpc.sendtoaddress(dest, 1.0)
+        with pytest.raises(RPCClientError):
+            node.rpc.getnewaddress()
+
+        # wrong passphrase rejected
+        with pytest.raises(RPCClientError):
+            node.rpc.walletpassphrase("wrong", 60)
+
+        node.rpc.walletpassphrase("secret phrase", 600)
+        assert node.rpc.getwalletinfo()["unlocked_until"] > 0
+        txid2 = node.rpc.sendtoaddress(dest, 1.0)
+        assert txid2 in node.rpc.getrawmempool()
+        node.rpc.walletlock()
+        with pytest.raises(RPCClientError):
+            node.rpc.sendtoaddress(dest, 1.0)
+
+        # restart: encrypted wallet file reloads, rescan restores coins
+        node.stop()
+        node.start()
+        info = node.rpc.getwalletinfo()
+        assert info["unlocked_until"] == 0  # still encrypted+locked
+        assert node.rpc.getbalance() > 0  # rescan found the coins
+        node.rpc.walletpassphrase("secret phrase", 60)
+        txid3 = node.rpc.sendtoaddress(dest, 0.5)
+        assert txid3 in node.rpc.getrawmempool()
+
+        # passphrase change
+        node.rpc.walletpassphrasechange("secret phrase", "new phrase")
+        node.rpc.walletlock()
+        with pytest.raises(RPCClientError):
+            node.rpc.walletpassphrase("secret phrase", 60)
+        node.rpc.walletpassphrase("new phrase", 60)

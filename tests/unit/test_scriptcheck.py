@@ -194,6 +194,52 @@ class TestSignedBlockConnect:
         assert ecdsa_batch.STATS.cpu_fallback_sigs == before + 3
         assert len(chainstate.test_verifier.sigcache) == 3
 
+    def test_chunked_pipeline_dispatch(self, chainstate):
+        """P3 pipeline overlap: with chunk=1 every tx's records ship as an
+        independent in-flight dispatch; verdict and sigcache behavior are
+        identical to the single-batch path."""
+        outs = _matured_chain(chainstate, n_spendable=3)
+        spends = tuple(_signed_spend(op, v) for op, v in outs)
+        tip = chainstate.tip()
+        blk = _hand_mine(
+            tip.hash, tip.height + 1, chainstate.get_time() + 10,
+            tip.bits, spends,
+        )
+        chainstate.test_verifier.chunk = 1  # force per-tx chunks
+        before = ecdsa_batch.STATS.cpu_fallback_sigs
+        try:
+            chainstate.process_new_block(blk)
+        finally:
+            chainstate.test_verifier.chunk = 4096
+        assert chainstate.tip().hash == blk.get_hash()
+        assert ecdsa_batch.STATS.cpu_fallback_sigs == before + 3
+        assert len(chainstate.test_verifier.sigcache) == 3
+
+    def test_chunked_pipeline_attribution(self, chainstate):
+        """A bad sig in a later chunk still attributes to (tx, input)."""
+        outs = _matured_chain(chainstate, n_spendable=2)
+        good = _signed_spend(*outs[0])
+        bad_src = _signed_spend(*outs[1])
+        ss = bytearray(bad_src.vin[0].script_sig)
+        ss[40] ^= 0x01
+        bad = CTransaction(
+            bad_src.version, (CTxIn(outs[1][0], bytes(ss)),),
+            bad_src.vout, bad_src.locktime,
+        )
+        tip = chainstate.tip()
+        blk = _hand_mine(
+            tip.hash, tip.height + 1, chainstate.get_time() + 10,
+            tip.bits, (good, bad),
+        )
+        chainstate.test_verifier.chunk = 1
+        idx = chainstate.accept_block(blk)
+        try:
+            with pytest.raises(BlockValidationError) as ei:
+                chainstate.connect_block(blk, idx)
+        finally:
+            chainstate.test_verifier.chunk = 4096
+        assert bad.txid_hex in str(ei.value)
+
     def test_multisig_spend_metered_as_eager(self, chainstate):
         """CHECKMULTISIG trials bypass the batch by design (outcome-dependent
         sig->pubkey assignment); VERDICT r2 weak #8: they must be METERED.

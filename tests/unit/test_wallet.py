@@ -110,3 +110,86 @@ class TestWalletTracking:
         tip = cs.tip()
         cs.invalidate_block(tip)
         assert len(wallet.coins) == 2
+
+
+class TestWalletEncryption:
+    """CCryptoKeyStore lifecycle (src/wallet/crypter.cpp) + wallet-file
+    persistence round trips."""
+
+    def test_encrypt_lock_unlock_spend(self, rig, tmp_path):
+        cs, wallet = rig
+        wallet.path = str(tmp_path / "wallet.json")
+        _mine_to_wallet(cs, wallet, 101)
+        assert wallet.balance(cs.tip().height) == 100 * COIN
+
+        wallet.encrypt("correct horse")
+        assert wallet.is_crypted and wallet.is_locked
+        # locked: still tracks coins, refuses to sign or mint keys
+        assert wallet.balance(cs.tip().height) == 100 * COIN
+        from bitcoincashplus_tpu.wallet.wallet import WalletError
+
+        with pytest.raises(WalletError):
+            wallet.get_new_address()
+        with pytest.raises(WalletError):
+            wallet.create_transaction(
+                CKey(0xBEEF).p2pkh_address(wallet.params), COIN,
+                cs.tip().height, enable_forkid=True,
+            )
+
+        assert not wallet.unlock("wrong passphrase")
+        assert wallet.is_locked
+        assert wallet.unlock("correct horse")
+        assert not wallet.is_locked
+        tx = wallet.create_transaction(
+            CKey(0xBEEF).p2pkh_address(wallet.params), COIN,
+            cs.tip().height, enable_forkid=True,
+        )
+        assert tx.txid  # signed successfully
+
+    def test_change_passphrase(self, rig, tmp_path):
+        cs, wallet = rig
+        wallet.path = str(tmp_path / "wallet.json")
+        wallet.get_new_address()
+        wallet.encrypt("old pass")
+        assert not wallet.change_passphrase("bad", "new pass")
+        assert wallet.change_passphrase("old pass", "new pass")
+        assert not wallet.unlock("old pass")
+        assert wallet.unlock("new pass")
+
+    def test_encrypted_wallet_persists(self, rig, tmp_path):
+        cs, wallet = rig
+        path = str(tmp_path / "wallet.json")
+        wallet.path = path
+        addr = wallet.get_new_address()
+        pkh_index = dict(wallet._pkh_index)
+        wallet.encrypt("pass")
+
+        reloaded = Wallet(wallet.params, path=path)
+        reloaded.load()
+        assert reloaded.is_crypted and reloaded.is_locked
+        assert reloaded._pkh_index == pkh_index
+        assert reloaded.unlock("pass")
+        # the reloaded key signs for the same address
+        key = next(iter(reloaded.keys_by_pkh.values()))
+        assert key.p2pkh_address(wallet.params) == addr
+
+    def test_plaintext_wallet_persists(self, rig, tmp_path):
+        cs, wallet = rig
+        path = str(tmp_path / "wallet.json")
+        wallet.path = path
+        addr = wallet.get_new_address()
+        reloaded = Wallet(wallet.params, path=path)
+        reloaded.load()
+        key = next(iter(reloaded.keys_by_pkh.values()))
+        assert key.p2pkh_address(wallet.params) == addr
+
+    def test_unlock_timeout_relocks(self, rig):
+        cs, wallet = rig
+        wallet.get_new_address()
+        wallet.encrypt("p")
+        assert wallet.unlock("p", timeout=0.05)
+        import time as _time
+
+        _time.sleep(0.1)
+        wallet.maybe_relock()
+        assert wallet.is_locked
