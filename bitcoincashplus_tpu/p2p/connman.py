@@ -116,6 +116,10 @@ class CConnman:
         # other peer could ever be asked for it (sync deadlock).
         self._requested_blocks: dict[bytes, int] = {}
         self._nonce = secrets.randbits(64)  # self-connect detection
+        # CConnman/BanMan (src/banman.cpp): ip -> ban-expiry unix time.
+        # Host granularity (no CIDR) matching how we track peers.
+        self._banned: dict[str, float] = {}
+        self.bantime = 86400  # -bantime default
 
     # -- lifecycle ------------------------------------------------------
 
@@ -177,9 +181,56 @@ class CConnman:
         asyncio.ensure_future(self._peer_loop(peer))
 
     async def _on_inbound(self, reader, writer) -> None:
+        peername = writer.get_extra_info("peername") or ("?", 0)
+        if self.is_banned(peername[0]):
+            writer.close()
+            return
         peer = Peer(self, reader, writer, outbound=False)
         self.peers[peer.id] = peer
         await self._peer_loop(peer)
+
+    # -- ban list (src/banman.cpp) --------------------------------------
+
+    def is_banned(self, ip: str) -> bool:
+        until = self._banned.get(ip)
+        if until is None:
+            return False
+        if time.time() > until:
+            self._banned.pop(ip, None)
+            return False
+        return True
+
+    def ban(self, ip: str, bantime: int = 0) -> None:
+        self._banned[ip] = time.time() + (bantime or self.bantime)
+        # drop any live connections from that host
+        def _do():
+            for peer in list(self.peers.values()):
+                if peer.addr.rsplit(":", 1)[0] == ip:
+                    peer.writer.close()
+        if self.loop is not None:
+            self.loop.call_soon_threadsafe(_do)
+
+    def unban(self, ip: str) -> bool:
+        return self._banned.pop(ip, None) is not None
+
+    def banned(self) -> dict[str, float]:
+        now = time.time()
+        self._banned = {ip: t for ip, t in self._banned.items() if t > now}
+        return dict(self._banned)
+
+    def clear_banned(self) -> None:
+        self._banned.clear()
+
+    def ping_all(self) -> None:
+        def _do():
+            for peer in self.peers.values():
+                if peer.handshaked:
+                    try:
+                        peer.send("ping", ser_ping(secrets.randbits(64)))
+                    except Exception:
+                        pass
+        if self.loop is not None:
+            self.loop.call_soon_threadsafe(_do)
 
     def disconnect(self, addr: str) -> None:
         def _do():
@@ -210,7 +261,9 @@ class CConnman:
             pass  # peer hung up
         except NetMessageError as e:
             # Misbehaving (src/net_processing.cpp): malformed traffic =>
-            # immediate discharge/disconnect
+            # immediate discharge/disconnect. Banning stays operator-driven
+            # (setban) — everything dials loopback here, and auto-banning
+            # 127.0.0.1 would take out every future peer on the host.
             log_print("net", "peer=%d misbehaving: %s — disconnecting", peer.id, e)
         except asyncio.CancelledError:
             raise

@@ -71,3 +71,51 @@ def disconnectnode(node, params):
     if node.connman is not None:
         node.connman.disconnect(params[0])
     return None
+
+
+@rpc_method("setban")
+def setban(node, params):
+    """setban "ip" "add|remove" (bantime) — src/rpc/net.cpp:~560, backed by
+    the connman ban list (banman.cpp). Host granularity, like peer tracking."""
+    require_params(params, 2, 3, "setban \"ip\" \"add|remove\" ( bantime )")
+    if node.connman is None:
+        raise RPCError(RPC_INVALID_PARAMETER, "P2P networking is disabled")
+    ip, cmd = str(params[0]), str(params[1])
+    if cmd == "add":
+        bantime = int(params[2]) if len(params) > 2 and params[2] else 0
+        node.connman.ban(ip, bantime)
+    elif cmd == "remove":
+        if not node.connman.unban(ip):
+            raise RPCError(
+                RPC_INVALID_PARAMETER,
+                "Error: Unban failed. Requested address/subnet "
+                "was not previously banned.",
+            )
+    else:
+        raise RPCError(RPC_INVALID_PARAMETER, f"unknown command {cmd!r}")
+    return None
+
+
+@rpc_method("listbanned")
+def listbanned(node, params):
+    if node.connman is None:
+        return []
+    return [
+        {"address": ip, "banned_until": int(until)}
+        for ip, until in sorted(node.connman.banned().items())
+    ]
+
+
+@rpc_method("clearbanned")
+def clearbanned(node, params):
+    if node.connman is not None:
+        node.connman.clear_banned()
+    return None
+
+
+@rpc_method("ping")
+def ping(node, params):
+    """Queue a ping to every connected peer (src/rpc/net.cpp ping)."""
+    if node.connman is not None:
+        node.connman.ping_all()
+    return None

@@ -198,3 +198,89 @@ def decodescript(node, params):
 
     out["p2sh"] = script_to_address(p2sh_script(hash160(script)), node.params)
     return out
+
+
+@rpc_method("signrawtransaction")
+def signrawtransaction(node, params):
+    """signrawtransaction (src/rpc/rawtransaction.cpp:~700): sign inputs
+    using wallet keys or caller-provided WIF keys; prevout scripts come from
+    the UTXO set, the mempool, or the caller's prevtxs array. Partial
+    signing returns complete=false with per-input errors."""
+    require_params(params, 1, 3,
+                   "signrawtransaction \"hexstring\" ( [{prevtxs},...] "
+                   "[\"privatekey\",...] )")
+    from ..consensus.tx import COIN
+    from ..script.sighash import SIGHASH_ALL, SIGHASH_FORKID, SighashCache
+    from ..wallet.keys import CKey
+    from ..wallet.signing import SignError, solve_script_sig
+
+    tx = _parse_tx_hex(params[0])
+    prevtxs = params[1] if len(params) > 1 and params[1] else []
+    privkeys = params[2] if len(params) > 2 and params[2] else None
+
+    spents = {}
+    for p in prevtxs:
+        spk = bytes.fromhex(p["scriptPubKey"])
+        amount = int(round(float(p.get("amount", 0)) * COIN))
+        spents[(hex_to_hash(p["txid"]), int(p["vout"]))] = (spk, amount)
+    for txin in tx.vin:
+        key = (txin.prevout.hash, txin.prevout.n)
+        if key in spents:
+            continue
+        coin = node.chainstate.coins.get_coin(txin.prevout)
+        if coin is not None:
+            spents[key] = (coin.out.script_pubkey, coin.out.value)
+            continue
+        parent = node.mempool.get_tx(txin.prevout.hash)
+        if parent is not None and txin.prevout.n < len(parent.vout):
+            out = parent.vout[txin.prevout.n]
+            spents[key] = (out.script_pubkey, out.value)
+
+    if privkeys is not None:
+        keymap = {}
+        for wif in privkeys:
+            k = CKey.from_wif(wif, node.params)
+            if k is None:
+                raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                               "Invalid private key")
+            keymap[k.pubkey_hash] = k
+            keymap[k.pubkey] = k
+        key_for_id = keymap.get
+    else:
+        wallet = node.load_wallet()
+        wallet.maybe_relock()
+        key_for_id = wallet.key_for_id
+
+    hashtype = SIGHASH_ALL | SIGHASH_FORKID
+    cache = SighashCache(tx)
+    new_vin = []
+    errors = []
+    for i, txin in enumerate(tx.vin):
+        ent = spents.get((txin.prevout.hash, txin.prevout.n))
+        if ent is None:
+            errors.append({
+                "txid": hash_to_hex(txin.prevout.hash),
+                "vout": txin.prevout.n,
+                "error": "Input not found or already spent",
+            })
+            new_vin.append(txin)
+            continue
+        spk, amount = ent
+        try:
+            script_sig = solve_script_sig(
+                spk, tx, i, amount, key_for_id, hashtype,
+                enable_forkid=True, cache=cache,
+            )
+            new_vin.append(CTxIn(txin.prevout, script_sig, txin.sequence))
+        except SignError as e:
+            errors.append({
+                "txid": hash_to_hex(txin.prevout.hash),
+                "vout": txin.prevout.n,
+                "error": str(e),
+            })
+            new_vin.append(txin)
+    signed = CTransaction(tx.version, tuple(new_vin), tx.vout, tx.locktime)
+    out = {"hex": signed.serialize().hex(), "complete": not errors}
+    if errors:
+        out["errors"] = errors
+    return out

@@ -201,3 +201,56 @@ def importprivkey(node, params):
         raise RPCError(RPC_WALLET_UNLOCK_NEEDED, str(e)) from None
     node._rescan_wallet()
     return None
+
+def _tx_log_json(node, w, txid: bytes, entry: dict) -> dict:
+    """One listtransactions/gettransaction row (rpcwallet.cpp WalletTxToJSON)."""
+    tip = node.chainstate.tip().height
+    height = entry["height"]
+    confirmations = 0 if height < 0 else tip - height + 1
+    net = entry["received"] - entry["sent"]
+    if entry["is_coinbase"]:
+        maturity = node.params.consensus.coinbase_maturity
+        category = "generate" if confirmations >= maturity else "immature"
+    elif entry["sent"] > 0:
+        category = "send"
+    else:
+        category = "receive"
+    out = {
+        "txid": hash_to_hex(txid),
+        "category": category,
+        "amount": net / COIN,
+        "confirmations": confirmations,
+    }
+    if height >= 0:
+        idx = node.chainstate.chain[height]
+        if idx is not None:
+            out["blockhash"] = hash_to_hex(idx.hash)
+            out["blocktime"] = idx.header.time
+    return out
+
+
+@rpc_method("listtransactions")
+def listtransactions(node, params):
+    """listtransactions ( "account" count skip ) — newest first."""
+    count = int(params[1]) if len(params) > 1 else 10
+    skip = int(params[2]) if len(params) > 2 else 0
+    w = _wallet(node)
+    entries = list(w.tx_log.items())[::-1][skip:skip + count]
+    return [_tx_log_json(node, w, txid, e) for txid, e in entries][::-1]
+
+
+@rpc_method("gettransaction")
+def gettransaction(node, params):
+    require_params(params, 1, 1, "gettransaction \"txid\"")
+    from ..consensus.serialize import hex_to_hash
+
+    w = _wallet(node)
+    txid = hex_to_hash(params[0])
+    entry = w.tx_log.get(txid)
+    if entry is None:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                       "Invalid or non-wallet transaction id")
+    out = _tx_log_json(node, w, txid, entry)
+    out["fee"] = 0.0  # fee tracking requires full input provenance
+    out["details"] = [out.copy()]
+    return out

@@ -63,6 +63,9 @@ class Wallet:
         self._master: Optional[bytes] = None
         self._pkh_index: dict[bytes, bytes] = {}  # pkh -> pubkey
         self.unlocked_until: float = 0.0
+        # mapWallet analogue: txid -> {height, received, sent, is_coinbase}
+        # insertion-ordered (dict) = wallet tx history for listtransactions
+        self.tx_log: dict[bytes, dict] = {}
 
     # -- encryption (CCryptoKeyStore) --
 
@@ -259,17 +262,37 @@ class Wallet:
                 self.coins.pop(COutPoint(txid, i), None)
             for txin in tx.vin:
                 self.spent.discard(txin.prevout)
+            entry = self.tx_log.get(txid)
+            if entry is not None:
+                if tx.is_coinbase():
+                    self.tx_log.pop(txid, None)  # orphaned generate
+                else:
+                    entry["height"] = -1  # back to unconfirmed
 
     def add_tx_if_mine(self, tx: CTransaction, height: int,
                        is_coinbase: bool) -> None:
+        sent = 0
         for txin in tx.vin:
-            if txin.prevout in self.coins:
+            coin = self.coins.get(txin.prevout)
+            if coin is not None:
                 self.spent.add(txin.prevout)
+                sent += coin.txout.value
         txid = tx.txid
+        received = 0
         for i, out in enumerate(tx.vout):
             if self._is_mine(out.script_pubkey):
                 op = COutPoint(txid, i)
                 self.coins[op] = WalletCoin(op, out, height, is_coinbase)
+                received += out.value
+        if sent or received:
+            # AddToWallet: record/refresh the history entry (a mempool tx
+            # re-entering via a block keeps one entry, height updated)
+            self.tx_log[txid] = {
+                "height": height,
+                "received": received,
+                "sent": sent,
+                "is_coinbase": is_coinbase,
+            }
 
     # -- balance / spend --
 
