@@ -124,6 +124,11 @@ class Node:
             expiry_seconds=config.get_int("mempoolexpiry", 336) * 3600,
         )
         self.min_relay_fee_rate = config.get_int("minrelaytxfee", 1000)
+        # CBlockPolicyEstimator-lite (src/policy/fees.cpp): per-block median
+        # feerate (sat/kB) of confirmed txs this node saw in its mempool
+        from collections import deque
+
+        self._fee_estimates = deque(maxlen=100)
         self.chainstate.on_block_connected.append(self._on_block_connected)
         self.chainstate.on_block_disconnected.append(self._on_block_disconnected)
 
@@ -141,6 +146,15 @@ class Node:
     # -- validation-interface callbacks (CMainSignals analogues) --------
 
     def _on_block_connected(self, block: CBlock, idx) -> None:
+        # fee estimation sample: feerates of the block's txs we had pending
+        rates = []
+        for tx in block.vtx[1:]:
+            entry = self.mempool.entries.get(tx.txid)
+            if entry is not None and entry.size > 0:
+                rates.append(entry.fee * 1000 // entry.size)
+        if rates:
+            rates.sort()
+            self._fee_estimates.append(rates[len(rates) // 2])
         self.mempool.remove_for_block(block.vtx)
         if self.txindex:
             self._txindex_add(block, idx)

@@ -56,6 +56,7 @@ class BatchStats:
     # P3 pipeline overlap: dispatches currently in flight / high-water mark
     in_flight: int = 0
     max_in_flight: int = 0
+    pallas_fallbacks: int = 0  # Mosaic compile failures -> XLA kernel
     buckets_used: dict = field(default_factory=dict)
 
     def snapshot(self) -> dict:
@@ -67,7 +68,13 @@ class BatchStats:
 STATS = BatchStats()
 
 
-def _bucket_for(n: int) -> int:
+def _bucket_for(n: int, pallas: bool = False) -> int:
+    if pallas and n > 2048:
+        # the Pallas kernel runs 4096-lane programs + one 2048 tail, so
+        # 2048-granular padding wastes at most 20% of a big batch (vs 64%
+        # padding 10k to the XLA path's 16384 bucket); compiled-program
+        # shapes stay bounded ({4096, 2048} slices)
+        return ((n + 2047) // 2048) * 2048
     for b in BUCKETS:
         if n <= b:
             return b
@@ -196,9 +203,13 @@ def dispatch_batch(records: Sequence, backend: str = "auto") -> BatchHandle:
 
     from . import secp256k1 as dev
 
-    bucket = _bucket_for(len(records))
+    pallas_active = (
+        not _PALLAS_BROKEN
+        and os.environ.get("BCP_SECP_PALLAS", "1") not in ("0", "false")
+    )
+    bucket = _bucket_for(len(records), pallas=pallas_active)
     arrays = pack_records(records, bucket)
-    device_ok = dev.ecdsa_verify_batch_jit(*map(np.asarray, arrays))
+    device_ok = _dispatch_device(dev, list(map(np.asarray, arrays)))
     STATS.dispatches += 1
     STATS.sigs_verified += len(records)
     STATS.sigs_padded += bucket - len(records)
@@ -207,6 +218,28 @@ def dispatch_batch(records: Sequence, backend: str = "auto") -> BatchHandle:
     STATS.in_flight += 1
     STATS.max_in_flight = max(STATS.max_in_flight, STATS.in_flight)
     return BatchHandle(len(records), bucket, device_ok)
+
+
+_PALLAS_BROKEN = False
+
+
+def _dispatch_device(dev, arrays):
+    """Prefer the Pallas verify kernel (~2.8x the XLA fori_loop form —
+    ops/secp256k1.py's Mosaic notes); fall back to the XLA path on any
+    compile failure (jit compilation is synchronous, so failures surface
+    here) and remember, so a broken Mosaic toolchain costs one attempt."""
+    global _PALLAS_BROKEN
+    use_pallas = (
+        not _PALLAS_BROKEN
+        and os.environ.get("BCP_SECP_PALLAS", "1") not in ("0", "false")
+    )
+    if use_pallas:
+        try:
+            return dev.ecdsa_verify_batch_pallas(*arrays)
+        except Exception:
+            _PALLAS_BROKEN = True
+            STATS.pallas_fallbacks += 1
+    return dev.ecdsa_verify_batch_jit(*arrays)
 
 
 def verify_batch(records: Sequence, backend: str = "auto") -> np.ndarray:

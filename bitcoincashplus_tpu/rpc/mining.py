@@ -125,3 +125,29 @@ def getnetworkhashps(node, params):
 @rpc_method("prioritisetransaction")
 def prioritisetransaction(node, params):
     return True  # accepted, no-op: fee deltas are not modelled
+
+
+@rpc_method("estimatefee")
+def estimatefee(node, params):
+    """estimatefee (nblocks) — src/policy/fees.cpp estimator, simplified to
+    the median of recent per-block confirmed-feerate medians; -1 with no
+    data, exactly like the reference's cold answer."""
+    from ..consensus.tx import COIN
+
+    samples = sorted(node._fee_estimates)
+    if not samples:
+        return -1
+    return samples[len(samples) // 2] / COIN
+
+
+@rpc_method("estimatesmartfee")
+def estimatesmartfee(node, params):
+    from ..consensus.tx import COIN
+
+    nblocks = int(params[0]) if params else 6
+    samples = sorted(node._fee_estimates)
+    if not samples:
+        # smart variant falls back to the relay floor instead of failing
+        return {"feerate": node.min_relay_fee_rate / COIN, "blocks": nblocks,
+                "errors": ["Insufficient data or no feerate found"]}
+    return {"feerate": samples[len(samples) // 2] / COIN, "blocks": nblocks}

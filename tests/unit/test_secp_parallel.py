@@ -1,0 +1,115 @@
+"""Differential tests for the parallel-form field ops (the TPU device path
+of ops/secp256k1: _pcarry_round/_fold_parallel/_exact_norm20 and the
+parallel f_mul/f_carry/f_is_zero) against the Python-int oracle. Runs the
+ops EAGERLY with BCP_SECP_PARALLEL=1 — no XLA compile, so these stay in the
+default CPU suite."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import bitcoincashplus_tpu.ops.secp256k1 as dev
+from bitcoincashplus_tpu.crypto.secp256k1 import P
+
+B = 8
+
+
+@pytest.fixture(autouse=True)
+def _force_parallel(monkeypatch):
+    monkeypatch.setenv("BCP_SECP_PARALLEL", "1")
+
+
+def _vals(rng, n=B):
+    return [int.from_bytes(rng.bytes(32), "big") % P for _ in range(n)]
+
+
+def _pack(vals):
+    return np.stack([dev.to_limbs_np(v) for v in vals], axis=1)
+
+
+def _unpack(arr):
+    return [dev.from_limbs_np(arr[:, b]) for b in range(arr.shape[1])]
+
+
+def _cols_value(cols):
+    return [
+        sum(int(cols[i, b]) << (13 * i) for i in range(cols.shape[0]))
+        for b in range(cols.shape[1])
+    ]
+
+
+@pytest.mark.parametrize("cols", [
+    np.full((39, B), (1 << 31) - 1, np.uint32),   # worst-case magnitude
+    np.full((20, B), (1 << 31) - 1, np.uint32),
+    np.zeros((39, B), np.uint32),
+])
+def test_parallel_carry_extremes(cols):
+    out = np.asarray(dev.f_carry(jnp.asarray(cols)))
+    for want, got in zip(_cols_value(cols), _unpack(out)):
+        assert got % P == want % P
+    assert out.max() <= 10000          # multiply-safe weak bound
+    assert out[19].max() <= 0x1FF + 32  # top-limb weak bound
+
+
+def test_parallel_carry_random():
+    rng = np.random.default_rng(1)
+    cols = rng.integers(0, 1 << 31, (39, B), dtype=np.uint32)
+    out = np.asarray(dev.f_carry(jnp.asarray(cols)))
+    for want, got in zip(_cols_value(cols), _unpack(out)):
+        assert got % P == want % P
+
+
+def test_parallel_mul_random_and_worst_case():
+    rng = np.random.default_rng(2)
+    va, vb = _vals(rng), _vals(rng)
+    out = np.asarray(dev.f_mul(jnp.asarray(_pack(va)), jnp.asarray(_pack(vb))))
+    for a, b_, got in zip(va, vb, _unpack(out)):
+        assert got % P == (a * b_) % P
+    # all limbs at the weak bound: products must not overflow u32 columns
+    w = np.full((20, B), 8200, np.uint32)
+    vw = dev.from_limbs_np(w[:, 0])
+    out = np.asarray(dev.f_mul(jnp.asarray(w), jnp.asarray(w)))
+    assert _unpack(out)[0] % P == (vw * vw) % P
+    assert out.max() <= 10000
+
+
+def test_parallel_mul_chain_maintains_discipline():
+    """50 chained muls: magnitudes must stay multiply-safe forever."""
+    rng = np.random.default_rng(3)
+    va, vb = _vals(rng), _vals(rng)
+    x, b_ = _pack(va), jnp.asarray(_pack(vb))
+    want = list(va)
+    for _ in range(50):
+        x = np.asarray(dev.f_mul(jnp.asarray(x), b_))
+        want = [(w * v) % P for w, v in zip(want, vb)]
+        assert x.max() <= 10000
+    assert [g % P for g in _unpack(x)] == want
+
+
+def test_exact_norm_and_is_zero():
+    rng = np.random.default_rng(4)
+    vals = _vals(rng)
+    vals[3] = 0
+    vals[5] = P  # non-canonical zero (value == p)
+    arr = jnp.asarray(_pack(vals))
+    # weak-ify through a carry first (representation with eps limbs)
+    weak = dev.f_carry(jnp.asarray(np.asarray(arr, np.uint32)))
+    z = np.asarray(dev.f_is_zero(weak))
+    assert list(z) == [v % P == 0 for v in vals]
+    # exact normalization yields canonical 13-bit limbs
+    exact = np.asarray(dev._exact_norm20(weak))
+    assert exact.max() <= 0x1FFF
+    for v, got in zip(vals, _unpack(exact)):
+        assert got % P == v % P
+
+
+def test_f_eq_parallel():
+    rng = np.random.default_rng(6)
+    va = _vals(rng)
+    a = jnp.asarray(_pack(va))
+    b_ = jnp.asarray(_pack(list(reversed(va))))
+    eq = np.asarray(dev.f_eq(a, a))
+    assert eq.all()
+    neq = np.asarray(dev.f_eq(a, b_))
+    expected = [x == y for x, y in zip(va, reversed(va))]
+    assert list(neq) == expected
