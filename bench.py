@@ -122,8 +122,11 @@ def bench_ecdsa_batch():
     assert bool(ok.all())
     sps = len(records) / dt
     from bitcoincashplus_tpu.ops.ecdsa_batch import STATS as _st
+    from bitcoincashplus_tpu.ops.ecdsa_batch import pallas_enabled as _pe
 
-    kernel = "xla" if _st.pallas_fallbacks else "pallas-vmem"
+    # label from the same predicate dispatch uses (a disabled/fallen-back
+    # pallas path must not be reported as pallas)
+    kernel = "pallas-vmem" if _pe() and not _st.pallas_fallbacks else "xla"
     emit("ecdsa_batch_verify_10k", round(sps), "sigs/s", 0.0,
          kernel=kernel,
          note=f"B=10000 through the full dispatch path ({dt:.2f}s); 64 "

@@ -1,0 +1,141 @@
+"""ctypes bindings for the native runtime library (native/bcp_native.cpp).
+
+The reference's runtime around the compute path is C++ (serialization
+templates, src/crypto/sha256.cpp, merkle.cpp); here the equivalent native
+layer accelerates the HOST side of -reindex / block-store scans: wire
+parsing (tx boundaries + txids), batch header hashing, merkle roots. The
+TPU kernels remain the device compute path; Python remains the consensus
+reference — callers treat this as an optional accelerator and every
+function is differential-tested against the Python implementation
+(tests/unit/test_native.py).
+
+`load()` finds (or builds, if a toolchain is present) native/libbcpnative.so
+and returns None when unavailable — callers must keep the Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "..", "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libbcpnative.so")
+
+_lib = None
+_load_attempted = False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """dlopen the native library, (re)building it first when a toolchain is
+    present. Returns None (and remembers) when unavailable.
+
+    The build always runs `make` (its dependency tracking makes a fresh
+    .so a no-op, and skipping it would silently keep loading a stale binary
+    after bcp_native.cpp edits) under an flock — concurrent bcpd processes
+    on a fresh checkout must not race the compiler or dlopen a half-written
+    file (g++ writes -o in place)."""
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    if os.environ.get("BCP_NO_NATIVE"):
+        return None
+    if os.path.isdir(_NATIVE_DIR) and os.access(_NATIVE_DIR, os.W_OK):
+        try:
+            import fcntl
+
+            with open(os.path.join(_NATIVE_DIR, ".build.lock"), "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+                subprocess.run(["make", "-C", _NATIVE_DIR],
+                               capture_output=True, timeout=120, check=True)
+        except Exception:
+            if not os.path.exists(_LIB_PATH):
+                return None  # no toolchain and no prebuilt library
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+
+    lib.bcp_sha256d.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                ctypes.c_char_p]
+    lib.bcp_sha256d.restype = None
+    lib.bcp_hash_headers.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                     ctypes.c_char_p]
+    lib.bcp_hash_headers.restype = None
+    lib.bcp_scan_block.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
+                                   ctypes.c_char_p,
+                                   ctypes.POINTER(ctypes.c_uint64),
+                                   ctypes.c_long]
+    lib.bcp_scan_block.restype = ctypes.c_long
+    lib.bcp_merkle_root.argtypes = [ctypes.c_char_p, ctypes.c_long,
+                                    ctypes.c_char_p]
+    lib.bcp_merkle_root.restype = ctypes.c_long
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def sha256d(data: bytes) -> bytes:
+    lib = load()
+    assert lib is not None, "native library unavailable"
+    out = ctypes.create_string_buffer(32)
+    lib.bcp_sha256d(data, len(data), out)
+    return out.raw
+
+
+def hash_headers(headers: bytes) -> list[bytes]:
+    """n concatenated 80-byte headers -> n sha256d digests."""
+    assert len(headers) % 80 == 0
+    n = len(headers) // 80
+    lib = load()
+    assert lib is not None, "native library unavailable"
+    out = ctypes.create_string_buffer(32 * n)
+    lib.bcp_hash_headers(headers, n, out)
+    return [out.raw[32 * i:32 * i + 32] for i in range(n)]
+
+
+class BlockScan:
+    __slots__ = ("txids", "offsets")
+
+    def __init__(self, txids: list[bytes], offsets: list[tuple[int, int]]):
+        self.txids = txids
+        self.offsets = offsets
+
+
+def scan_block(raw: bytes, max_tx: int = 100_000) -> Optional[BlockScan]:
+    """Wire-scan a serialized block: per-tx txids + [start, end) offsets.
+    None on truncated/corrupt input (callers fall back to the Python
+    deserializer, which raises the detailed error)."""
+    lib = load()
+    assert lib is not None, "native library unavailable"
+    # a serialized tx is >= ~10 bytes: size the buffers by the input, not
+    # the worst case (txindex backfill calls this once per block)
+    max_tx = min(max_tx, len(raw) // 10 + 1)
+    txids = ctypes.create_string_buffer(32 * max_tx)
+    offsets = (ctypes.c_uint64 * (2 * max_tx))()
+    n = lib.bcp_scan_block(raw, len(raw), txids, offsets, max_tx)
+    if n < 0:
+        return None
+    return BlockScan(
+        [txids.raw[32 * i:32 * i + 32] for i in range(n)],
+        [(int(offsets[2 * i]), int(offsets[2 * i + 1])) for i in range(n)],
+    )
+
+
+def merkle_root(txids: list[bytes]) -> tuple[bytes, bool]:
+    """(root, mutated) — ComputeMerkleRoot with the CVE-2012-2459 flag."""
+    lib = load()
+    assert lib is not None, "native library unavailable"
+    n = len(txids)
+    if n == 0:
+        return b"\x00" * 32, False
+    buf = b"".join(txids)
+    out = ctypes.create_string_buffer(32)
+    mutated = lib.bcp_merkle_root(buf, n, out)
+    return out.raw, bool(mutated)

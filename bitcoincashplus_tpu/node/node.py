@@ -58,6 +58,19 @@ class Node:
         self.config = config
         self.params = config.chain_params()
         self.datadir = config.datadir
+        # JAX_PLATFORMS=cpu must actually mean CPU: an accelerator plugin
+        # can still win default-backend selection (tests/conftest.py notes
+        # the same), which silently routes every node jit through it — and
+        # couples regtest/functional nodes to remote-device availability.
+        try:
+            from ..ops.sha256 import backend_is_cpu
+
+            if backend_is_cpu():
+                import jax
+
+                jax.config.update("jax_default_device", jax.devices("cpu")[0])
+        except Exception:
+            pass
         os.makedirs(self.datadir, exist_ok=True)
         log_init(
             logfile_path=os.path.join(self.datadir, "debug.log"),
@@ -369,15 +382,33 @@ class Node:
         self._index_kv.write_batch(puts)
 
     def _build_txindex(self) -> None:
-        """-txindex on a synced datadir: backfill from the active chain."""
+        """-txindex on a synced datadir: backfill from the active chain.
+        Uses the native wire scanner when available (txids without full
+        Python deserialization — the reference keeps this path in C++ too);
+        falls back to the Python deserializer per block."""
         if self.index_db.kv.get(b"Ftxindex") == b"1":
             return
+        from .. import native
+
+        use_native = native.available()
         cs = self.chainstate
         for height in range(cs.chain.height() + 1):
             idx = cs.chain[height]
-            block = cs.get_block(idx.hash)
-            if block is not None:
-                self._txindex_add(block, idx)
+            txids = None
+            if use_native:
+                raw = self.block_store.get_block(idx.hash)
+                if raw is not None:
+                    scan = native.scan_block(raw)
+                    if scan is not None:
+                        txids = scan.txids
+            if txids is None:
+                block = cs.get_block(idx.hash)
+                if block is None:
+                    continue
+                txids = [tx.txid for tx in block.vtx]
+            self._index_kv.write_batch({
+                self._TXINDEX_PREFIX + txid: idx.hash for txid in txids
+            })
         self.index_db.put_flag(b"txindex", True)
 
     def txindex_lookup(self, txid: bytes) -> Optional[bytes]:

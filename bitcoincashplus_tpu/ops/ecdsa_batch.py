@@ -203,11 +203,7 @@ def dispatch_batch(records: Sequence, backend: str = "auto") -> BatchHandle:
 
     from . import secp256k1 as dev
 
-    pallas_active = (
-        not _PALLAS_BROKEN
-        and os.environ.get("BCP_SECP_PALLAS", "1") not in ("0", "false")
-    )
-    bucket = _bucket_for(len(records), pallas=pallas_active)
+    bucket = _bucket_for(len(records), pallas=pallas_enabled())
     arrays = pack_records(records, bucket)
     device_ok = _dispatch_device(dev, list(map(np.asarray, arrays)))
     STATS.dispatches += 1
@@ -223,22 +219,38 @@ def dispatch_batch(records: Sequence, backend: str = "auto") -> BatchHandle:
 _PALLAS_BROKEN = False
 
 
-def _dispatch_device(dev, arrays):
-    """Prefer the Pallas verify kernel (~2.8x the XLA fori_loop form —
-    ops/secp256k1.py's Mosaic notes); fall back to the XLA path on any
-    compile failure (jit compilation is synchronous, so failures surface
-    here) and remember, so a broken Mosaic toolchain costs one attempt."""
-    global _PALLAS_BROKEN
-    use_pallas = (
+def pallas_enabled() -> bool:
+    """Single source of truth for the Pallas-vs-XLA kernel choice — bucket
+    granularity (dispatch_batch) and kernel selection (_dispatch_device)
+    must agree or big batches get Pallas-sized buckets on the XLA kernel,
+    defeating the bounded-recompile bucket design."""
+    return (
         not _PALLAS_BROKEN
         and os.environ.get("BCP_SECP_PALLAS", "1") not in ("0", "false")
     )
-    if use_pallas:
+
+
+def _dispatch_device(dev, arrays):
+    """Prefer the Pallas verify kernel (~2.8x the XLA fori_loop form —
+    ops/secp256k1.py's Mosaic notes); fall back to the XLA path on compile
+    failure (jit compilation is synchronous, so failures surface here).
+    Deterministic Mosaic/lowering failures latch _PALLAS_BROKEN; transient
+    remote-compile-service errors do NOT — the next dispatch retries."""
+    global _PALLAS_BROKEN
+    if pallas_enabled():
         try:
             return dev.ecdsa_verify_batch_pallas(*arrays)
-        except Exception:
-            _PALLAS_BROKEN = True
+        except Exception as e:
             STATS.pallas_fallbacks += 1
+            text = f"{type(e).__name__}: {e}"
+            if ("Mosaic" in text or "NotImplementedError" in text
+                    or "lowering" in text):
+                _PALLAS_BROKEN = True  # this toolchain can't compile it
+            from ..util.log import log_printf
+
+            log_printf("pallas ECDSA kernel failed (%s) — XLA fallback%s",
+                       text[:200],
+                       " (latched)" if _PALLAS_BROKEN else "")
     return dev.ecdsa_verify_batch_jit(*arrays)
 
 

@@ -1,0 +1,248 @@
+// Native runtime components — the C++ layer the reference keeps for its
+// IO/serialization hot paths (src/primitives/*.h serialization templates,
+// src/crypto/sha256.cpp, src/consensus/merkle.cpp). The JAX/Pallas kernels
+// are the TPU compute path; this library serves the HOST side of
+// -reindex/block-store scans: wire-format parsing (tx boundaries + txids)
+// and double-SHA256/merkle work, callable from Python via ctypes
+// (bitcoincashplus_tpu/native.py). Python remains the consensus reference
+// implementation; every native result is differential-tested against it.
+//
+// Build: make -C native   (produces libbcpnative.so)
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+// ---------------------------------------------------------------------------
+// SHA-256 (FIPS-180-4), straightforward portable implementation.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+static const uint32_t K[64] = {
+    0x428a2f98,0x71374491,0xb5c0fbcf,0xe9b5dba5,0x3956c25b,0x59f111f1,
+    0x923f82a4,0xab1c5ed5,0xd807aa98,0x12835b01,0x243185be,0x550c7dc3,
+    0x72be5d74,0x80deb1fe,0x9bdc06a7,0xc19bf174,0xe49b69c1,0xefbe4786,
+    0x0fc19dc6,0x240ca1cc,0x2de92c6f,0x4a7484aa,0x5cb0a9dc,0x76f988da,
+    0x983e5152,0xa831c66d,0xb00327c8,0xbf597fc7,0xc6e00bf3,0xd5a79147,
+    0x06ca6351,0x14292967,0x27b70a85,0x2e1b2138,0x4d2c6dfc,0x53380d13,
+    0x650a7354,0x766a0abb,0x81c2c92e,0x92722c85,0xa2bfe8a1,0xa81a664b,
+    0xc24b8b70,0xc76c51a3,0xd192e819,0xd6990624,0xf40e3585,0x106aa070,
+    0x19a4c116,0x1e376c08,0x2748774c,0x34b0bcb5,0x391c0cb3,0x4ed8aa4a,
+    0x5b9cca4f,0x682e6ff3,0x748f82ee,0x78a5636f,0x84c87814,0x8cc70208,
+    0x90befffa,0xa4506ceb,0xbef9a3f7,0xc67178f2,
+};
+
+static inline uint32_t rotr(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+}
+
+struct Sha256 {
+    uint32_t h[8];
+    uint8_t buf[64];
+    uint64_t total = 0;
+    size_t fill = 0;
+
+    Sha256() {
+        static const uint32_t init[8] = {
+            0x6a09e667,0xbb67ae85,0x3c6ef372,0xa54ff53a,
+            0x510e527f,0x9b05688c,0x1f83d9ab,0x5be0cd19,
+        };
+        memcpy(h, init, sizeof(h));
+    }
+
+    void transform(const uint8_t* p) {
+        uint32_t w[64];
+        for (int i = 0; i < 16; i++)
+            w[i] = (uint32_t(p[4*i]) << 24) | (uint32_t(p[4*i+1]) << 16)
+                 | (uint32_t(p[4*i+2]) << 8) | uint32_t(p[4*i+3]);
+        for (int i = 16; i < 64; i++) {
+            uint32_t s0 = rotr(w[i-15],7) ^ rotr(w[i-15],18) ^ (w[i-15] >> 3);
+            uint32_t s1 = rotr(w[i-2],17) ^ rotr(w[i-2],19) ^ (w[i-2] >> 10);
+            w[i] = w[i-16] + s0 + w[i-7] + s1;
+        }
+        uint32_t a=h[0],b=h[1],c=h[2],d=h[3],e=h[4],f=h[5],g=h[6],hh=h[7];
+        for (int i = 0; i < 64; i++) {
+            uint32_t S1 = rotr(e,6) ^ rotr(e,11) ^ rotr(e,25);
+            uint32_t ch = (e & f) ^ (~e & g);
+            uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+            uint32_t S0 = rotr(a,2) ^ rotr(a,13) ^ rotr(a,22);
+            uint32_t mj = (a & b) ^ (a & c) ^ (b & c);
+            uint32_t t2 = S0 + mj;
+            hh=g; g=f; f=e; e=d+t1; d=c; c=b; b=a; a=t1+t2;
+        }
+        h[0]+=a; h[1]+=b; h[2]+=c; h[3]+=d; h[4]+=e; h[5]+=f; h[6]+=g; h[7]+=hh;
+    }
+
+    void update(const uint8_t* data, size_t len) {
+        total += len;
+        if (fill) {
+            size_t take = 64 - fill;
+            if (take > len) take = len;
+            memcpy(buf + fill, data, take);
+            fill += take; data += take; len -= take;
+            if (fill == 64) { transform(buf); fill = 0; }
+        }
+        while (len >= 64) { transform(data); data += 64; len -= 64; }
+        if (len) { memcpy(buf, data, len); fill = len; }
+    }
+
+    void final(uint8_t out[32]) {
+        uint64_t bits = total * 8;
+        uint8_t pad = 0x80;
+        update(&pad, 1);
+        uint8_t z = 0;
+        while (fill != 56) update(&z, 1);
+        uint8_t lenb[8];
+        for (int i = 0; i < 8; i++) lenb[i] = uint8_t(bits >> (56 - 8*i));
+        update(lenb, 8);
+        for (int i = 0; i < 8; i++) {
+            out[4*i]   = uint8_t(h[i] >> 24);
+            out[4*i+1] = uint8_t(h[i] >> 16);
+            out[4*i+2] = uint8_t(h[i] >> 8);
+            out[4*i+3] = uint8_t(h[i]);
+        }
+    }
+};
+
+static void sha256d(const uint8_t* data, size_t len, uint8_t out[32]) {
+    uint8_t mid[32];
+    Sha256 a; a.update(data, len); a.final(mid);
+    Sha256 b; b.update(mid, 32); b.final(out);
+}
+
+// ---------------------------------------------------------------------------
+// Wire-format scanning (src/primitives/transaction.h serialization layout).
+// Bounds-checked: every reader returns false on truncation, the parse entry
+// points return negative error codes rather than reading past the buffer.
+// ---------------------------------------------------------------------------
+
+struct Reader {
+    const uint8_t* p;
+    size_t len, pos = 0;
+
+    bool skip(size_t n) {
+        if (len - pos < n) return false;
+        pos += n;
+        return true;
+    }
+    bool u32(uint32_t* out) {
+        if (len - pos < 4) return false;
+        memcpy(out, p + pos, 4);  // little-endian hosts only (x86/ARM LE)
+        pos += 4;
+        return true;
+    }
+    bool compact(uint64_t* out) {
+        if (pos >= len) return false;
+        uint8_t tag = p[pos++];
+        if (tag < 253) { *out = tag; return true; }
+        size_t n = tag == 253 ? 2 : tag == 254 ? 4 : 8;
+        if (len - pos < n) return false;
+        uint64_t v = 0;
+        for (size_t i = 0; i < n; i++) v |= uint64_t(p[pos + i]) << (8 * i);
+        pos += n;
+        *out = v;
+        return true;
+    }
+    bool var_bytes() {  // CompactSize length + payload
+        uint64_t n;
+        if (!compact(&n)) return false;
+        if (n > len - pos) return false;  // never allocate on a lie
+        pos += size_t(n);
+        return true;
+    }
+};
+
+// One transaction: advances r past it; writes [start, end) into *start/*end.
+static bool scan_tx(Reader& r, size_t* start, size_t* end) {
+    *start = r.pos;
+    uint32_t version;
+    if (!r.u32(&version)) return false;
+    uint64_t nin;
+    if (!r.compact(&nin)) return false;
+    if (nin > 1000000) return false;  // absurd count = corrupt input
+    for (uint64_t i = 0; i < nin; i++) {
+        if (!r.skip(36)) return false;      // outpoint
+        if (!r.var_bytes()) return false;   // scriptSig
+        if (!r.skip(4)) return false;       // sequence
+    }
+    uint64_t nout;
+    if (!r.compact(&nout)) return false;
+    if (nout > 1000000) return false;
+    for (uint64_t i = 0; i < nout; i++) {
+        if (!r.skip(8)) return false;       // value
+        if (!r.var_bytes()) return false;   // scriptPubKey
+    }
+    if (!r.skip(4)) return false;           // locktime
+    *end = r.pos;
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// sha256d of a buffer.
+void bcp_sha256d(const uint8_t* data, size_t len, uint8_t out32[32]) {
+    sha256d(data, len, out32);
+}
+
+// Batch header hashing: n 80-byte headers -> n 32-byte digests.
+void bcp_hash_headers(const uint8_t* headers, size_t n, uint8_t* out) {
+    for (size_t i = 0; i < n; i++)
+        sha256d(headers + 80 * i, 80, out + 32 * i);
+}
+
+// Scan a serialized block: writes tx count, per-tx txids (32 bytes each,
+// wire order) and [start,end) byte offsets. Returns tx count, or
+//   -1 truncated/corrupt header or tx
+//   -2 more txs than max_tx (caller's buffers too small)
+long bcp_scan_block(const uint8_t* data, size_t len,
+                    uint8_t* txids, uint64_t* offsets, long max_tx) {
+    Reader r{data, len};
+    if (!r.skip(80)) return -1;  // header
+    uint64_t n;
+    if (!r.compact(&n)) return -1;
+    if (max_tx < 0 || n > (uint64_t)max_tx) return -2;  // unsigned compare:
+    // a 2^63+ CompactSize must hit the cap, not wrap negative past it
+    for (uint64_t i = 0; i < n; i++) {
+        size_t s, e;
+        if (!scan_tx(r, &s, &e)) return -1;
+        sha256d(data + s, e - s, txids + 32 * i);
+        offsets[2 * i] = s;
+        offsets[2 * i + 1] = e;
+    }
+    return (long)n;
+}
+
+// Merkle root with the CVE-2012-2459 duplicate-pair mutation flag
+// (src/consensus/merkle.cpp ComputeMerkleRoot): txids = n*32 bytes in,
+// root32 out; returns 1 if a mutation pattern was detected else 0,
+// or -1 on n == 0.
+long bcp_merkle_root(const uint8_t* txids, long n, uint8_t* root32) {
+    if (n <= 0) return -1;
+    // work buffer: level <= n hashes
+    uint8_t* level = new uint8_t[size_t(n) * 32];
+    memcpy(level, txids, size_t(n) * 32);
+    long cnt = n;
+    long mutated = 0;
+    uint8_t pair[64];
+    while (cnt > 1) {
+        long next = 0;
+        for (long i = 0; i < cnt; i += 2) {
+            long j = (i + 1 < cnt) ? i + 1 : i;  // odd: pair with itself
+            if (i + 1 < cnt && memcmp(level + 32*i, level + 32*j, 32) == 0)
+                mutated = 1;  // identical consecutive pair
+            memcpy(pair, level + 32*i, 32);
+            memcpy(pair + 32, level + 32*j, 32);
+            sha256d(pair, 64, level + 32*next);
+            next++;
+        }
+        cnt = next;
+    }
+    memcpy(root32, level, 32);
+    delete[] level;
+    return mutated;
+}
+
+}  // extern "C"

@@ -1,0 +1,63 @@
+"""bcp-tx offline transaction builder (src/bitcoin-tx.cpp equivalent)."""
+
+import json
+
+from bitcoincashplus_tpu.cli.bcp_tx import main
+from bitcoincashplus_tpu.consensus.params import regtest_params
+from bitcoincashplus_tpu.consensus.serialize import ByteReader
+from bitcoincashplus_tpu.consensus.tx import CTransaction
+from bitcoincashplus_tpu.script.interpreter import (
+    SCRIPT_ENABLE_SIGHASH_FORKID,
+    SCRIPT_VERIFY_NULLFAIL,
+    SCRIPT_VERIFY_P2SH,
+    TransactionSignatureChecker,
+    VerifyScript,
+)
+from bitcoincashplus_tpu.wallet.keys import CKey
+
+KEY = CKey(0xFACE)
+TXID = "bb" * 32
+
+
+def _run(capsys, *args) -> str:
+    assert main(list(args)) == 0
+    return capsys.readouterr().out.strip()
+
+
+def test_create_edit_decode(capsys):
+    addr = KEY.p2pkh_address(regtest_params())
+    raw = _run(capsys, "-regtest", "-create", "nversion=2", "locktime=99",
+               f"in={TXID}:1:4000000000", f"out=1.25:{addr}",
+               "outdata=cafebabe")
+    tx = CTransaction.deserialize(ByteReader(bytes.fromhex(raw)))
+    assert tx.version == 2 and tx.locktime == 99
+    assert tx.vin[0].prevout.n == 1 and tx.vin[0].sequence == 4000000000
+    assert tx.vout[0].value == 125_000_000
+    assert tx.vout[1].script_pubkey.startswith(b"\x6a")  # OP_RETURN
+
+    decoded = json.loads(_run(capsys, "-regtest", "-json", raw, "delout=1"))
+    assert decoded["version"] == 2 and len(decoded["vout"]) == 1
+
+    raw2 = _run(capsys, "-regtest", raw, "delin=0")
+    assert len(CTransaction.deserialize(ByteReader(bytes.fromhex(raw2))).vin) == 0
+
+
+def test_sign_produces_valid_spend(capsys):
+    params = regtest_params()
+    addr = KEY.p2pkh_address(params)
+    spk = KEY.p2pkh_script()
+    wif = KEY.to_wif(params)
+    raw = _run(capsys, "-regtest", "-create", f"in={TXID}:0",
+               f"out=0.4:{addr}",
+               f"sign={wif}:{TXID}:0:{spk.hex()}:0.5")
+    tx = CTransaction.deserialize(ByteReader(bytes.fromhex(raw)))
+    flags = (SCRIPT_VERIFY_P2SH | SCRIPT_VERIFY_NULLFAIL
+             | SCRIPT_ENABLE_SIGHASH_FORKID)
+    checker = TransactionSignatureChecker(tx, 0, 50_000_000)
+    VerifyScript(tx.vin[0].script_sig, spk, flags, checker)  # raises on fail
+
+
+def test_bad_input_errors(capsys):
+    assert main(["-regtest", "zz"]) == 1
+    assert main(["-regtest", "-create", "bogus=1"]) == 1
+    assert main(["-regtest", "-create", "out=1.0:notanaddress"]) == 1

@@ -1,0 +1,85 @@
+"""Differential tests for the native C++ runtime library against the
+Python reference implementations (hashlib, consensus/merkle.py, the wire
+serializer). Skipped when no toolchain/library is available."""
+
+import hashlib
+
+import pytest
+
+from bitcoincashplus_tpu import native
+from bitcoincashplus_tpu.consensus.merkle import compute_merkle_root
+from bitcoincashplus_tpu.consensus.params import main_params, regtest_params
+from bitcoincashplus_tpu.consensus.tx import COutPoint, CTransaction, CTxIn, CTxOut
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+def _sha256d_py(b: bytes) -> bytes:
+    return hashlib.sha256(hashlib.sha256(b).digest()).digest()
+
+
+def test_sha256d_matches_hashlib():
+    for msg in (b"", b"a", b"x" * 63, b"y" * 64, b"z" * 65, b"q" * 1000):
+        assert native.sha256d(msg) == _sha256d_py(msg)
+
+
+def test_hash_headers_genesis():
+    genesis = main_params().genesis
+    hdr = genesis.header.serialize()
+    digests = native.hash_headers(hdr * 3)
+    assert digests == [genesis.get_hash()] * 3
+
+
+def test_scan_block_offsets_and_txids():
+    blk = regtest_params().genesis
+    raw = blk.serialize()
+    scan = native.scan_block(raw)
+    assert scan is not None
+    assert scan.txids == [tx.txid for tx in blk.vtx]
+    for tx, (s, e) in zip(blk.vtx, scan.offsets):
+        assert raw[s:e] == tx.serialize()
+
+
+def test_scan_block_multi_tx():
+    from bitcoincashplus_tpu.consensus.block import CBlock
+
+    genesis = regtest_params().genesis
+    txs = [genesis.vtx[0]]
+    for i in range(5):
+        txs.append(CTransaction(
+            vin=(CTxIn(COutPoint(bytes([i]) * 32, i), bytes([0x51] * (i * 7))),),
+            vout=(CTxOut(1000 * i, b"\x51" * (i + 1)), CTxOut(5, b"")),
+        ))
+    blk = CBlock(genesis.header, tuple(txs))
+    raw = blk.serialize()
+    scan = native.scan_block(raw)
+    assert scan is not None
+    assert scan.txids == [tx.txid for tx in txs]
+
+
+def test_scan_block_rejects_truncation():
+    raw = regtest_params().genesis.serialize()
+    for cut in (10, 79, 81, len(raw) - 1):
+        assert native.scan_block(raw[:cut]) is None
+    # oversized CompactSize tx count must not allocate or crash
+    evil = raw[:80] + b"\xfe\xff\xff\xff\xff"
+    assert native.scan_block(evil) is None
+
+
+def test_merkle_root_matches_python():
+    import numpy as np
+
+    rng = np.random.default_rng(9)
+    for n in (1, 2, 3, 7, 64, 101):
+        txids = [rng.bytes(32) for _ in range(n)]
+        root_py, mut_py = compute_merkle_root(txids)
+        root_c, mut_c = native.merkle_root(txids)
+        assert root_c == root_py and mut_c == mut_py
+    # CVE-2012-2459 mutation: duplicated final pair flags on both
+    txids = [rng.bytes(32) for _ in range(3)]
+    mutated = txids + [txids[2]]
+    _, mut_py = compute_merkle_root(mutated)
+    _, mut_c = native.merkle_root(mutated)
+    assert mut_c == mut_py

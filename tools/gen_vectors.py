@@ -508,6 +508,58 @@ def gen_script_vectors():
         push(_num(21)) + op(OPC.OP_CHECKMULTISIG)
     vec(b"\x00\x00", too_many, "", "pubkey-count", ">20 pubkeys")
 
+    # ---- truthiness edges ----
+    vec(push(b"\x80"), b"", "", "eval-false",
+        "negative zero is false (cast_to_bool)")
+    vec(push(b"\x00\x80"), b"", "", "eval-false",
+        "multi-byte negative zero is false")
+    vec(push(b"\x00\x01"), b"", "", "OK", "high zero byte with set bit is true")
+
+    # ---- paired stack ops ----
+    vec(pushnum(1) + pushnum(2) + pushnum(3) + pushnum(4),
+        op(OPC.OP_2SWAP) + pushnum(2) + op(OPC.OP_EQUALVERIFY) + pushnum(1) +
+        op(OPC.OP_EQUALVERIFY) + pushnum(4) + op(OPC.OP_EQUALVERIFY) +
+        pushnum(3) + op(OPC.OP_EQUAL), "", "OK", "2SWAP order")
+    vec(pushnum(1) + pushnum(2) + pushnum(3) + pushnum(4),
+        op(OPC.OP_2OVER) + pushnum(2) + op(OPC.OP_EQUALVERIFY) + pushnum(1) +
+        op(OPC.OP_EQUALVERIFY, OPC.OP_2DROP, OPC.OP_2DROP, OPC.OP_1),
+        "", "OK", "2OVER copies bottom pair")
+    vec(pushnum(1) + pushnum(2) + pushnum(3) + pushnum(4) + pushnum(5) +
+        pushnum(6),
+        op(OPC.OP_2ROT) + pushnum(2) + op(OPC.OP_EQUALVERIFY) + pushnum(1) +
+        op(OPC.OP_EQUALVERIFY, OPC.OP_2DROP, OPC.OP_2DROP, OPC.OP_1),
+        "", "OK", "2ROT rotates bottom pair to top")
+    vec(pushnum(1), op(OPC.OP_2DUP), "", "invalid-stack-operation",
+        "2DUP needs two")
+
+    # ---- SIZE ----
+    vec(push(b"\x01\x02\x03"), op(OPC.OP_SIZE, OPC.OP_3, OPC.OP_EQUALVERIFY,
+                                  OPC.OP_DROP, OPC.OP_1), "", "OK",
+        "SIZE of 3-byte push")
+    vec(b"\x00", op(OPC.OP_SIZE, OPC.OP_0, OPC.OP_EQUALVERIFY, OPC.OP_DROP,
+                    OPC.OP_1), "", "OK", "SIZE of empty push is 0")
+
+    # ---- EQUALVERIFY failure code ----
+    vec(pushnum(1) + pushnum(2), op(OPC.OP_EQUALVERIFY, OPC.OP_1), "",
+        "equalverify", "EQUALVERIFY mismatch")
+
+    # ---- NUMEQUALVERIFY ----
+    vec(pushnum(3) + pushnum(3), op(OPC.OP_NUMEQUALVERIFY, OPC.OP_1), "",
+        "OK", "NUMEQUALVERIFY pass")
+    vec(pushnum(3) + pushnum(4), op(OPC.OP_NUMEQUALVERIFY, OPC.OP_1), "",
+        "numequalverify", "NUMEQUALVERIFY fail")
+
+    # ---- IFDUP on zero does not duplicate ----
+    vec(pushnum(0), op(OPC.OP_IFDUP, OPC.OP_DEPTH, OPC.OP_1,
+                       OPC.OP_EQUALVERIFY, OPC.OP_DROP, OPC.OP_1),
+        "", "OK", "IFDUP leaves zero alone")
+
+    # ---- numeric equivalence across encodings (NUMEQUAL vs EQUAL) ----
+    vec(push(b"\x01\x00"), op(OPC.OP_1, OPC.OP_NUMEQUAL), "", "OK",
+        "0x0100 numerically equals 1")
+    vec(push(b"\x01\x00") + op(OPC.OP_1), op(OPC.OP_EQUAL), "", "eval-false",
+        "0x0100 is not byte-equal to 0x01")
+
     # ---- op count limit (>201 non-push ops) ----
     many_ops = op(OPC.OP_1) + op(*([OPC.OP_DUP, OPC.OP_DROP] * 101))
     vec(b"", many_ops, "", "op-count", "202 ops exceeds MAX_OPS_PER_SCRIPT")
