@@ -452,7 +452,29 @@ class Node:
                 self._rescan_wallet()  # ScanForWalletTransactions
             self.chainstate.on_block_connected.append(self.wallet.block_connected)
             self.chainstate.on_block_disconnected.append(self.wallet.block_disconnected)
+            # -walletnotify=<cmd>: shell hook per wallet-affecting tx as it
+            # confirms (init.cpp/wallet.cpp BlockConnected notify path);
+            # registered AFTER wallet.block_connected so tx_log is current
+            notify = self.config.get("walletnotify")
+            if notify:
+                self.chainstate.on_block_connected.append(
+                    lambda block, idx: self._walletnotify(notify, block)
+                )
         return self.wallet
+
+    def _walletnotify(self, cmd: str, block: CBlock) -> None:
+        import subprocess
+
+        from ..consensus.serialize import hash_to_hex as _h2h
+
+        for tx in block.vtx:
+            if tx.txid in self.wallet.tx_log:
+                try:
+                    subprocess.Popen(
+                        cmd.replace("%s", _h2h(tx.txid)), shell=True
+                    )
+                except OSError as e:
+                    log_printf("walletnotify failed: %r", e)
 
     def _rescan_wallet(self) -> None:
         """CWallet::ScanForWalletTransactions over the active chain — a

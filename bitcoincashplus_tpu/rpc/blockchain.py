@@ -15,6 +15,7 @@ from .rawtransaction import tx_to_json
 from .registry import (
     RPC_INVALID_ADDRESS_OR_KEY,
     RPC_INVALID_PARAMETER,
+    RPC_MISC_ERROR,
     RPCError,
     param_hash,
     require_params,
@@ -330,3 +331,77 @@ def verifychain(node, params):
         return node.verify_db(n_blocks=n_blocks, level=level)
     except Exception:
         return False
+
+
+@rpc_method("getblockstats")
+def getblockstats(node, params):
+    """getblockstats (rpc/blockchain.cpp:~1700): per-block fee/size stats.
+    Fees come from the block's undo data (spent-coin values), the same
+    source the reference uses, so no txindex is needed."""
+    require_params(params, 1, 2, "getblockstats hash_or_height ( stats )")
+    from ..consensus.params import get_block_subsidy
+    from ..validation.coins import BlockUndo
+
+    cs = node.chainstate
+    target = params[0]
+    if isinstance(target, int) or (isinstance(target, str) and
+                                   len(target) != 64):
+        idx = cs.chain[int(target)]
+        if idx is None:
+            raise RPCError(RPC_INVALID_PARAMETER,
+                           "Target block height out of range")
+    else:
+        idx = _block_index_or_raise(node, param_hash(params, 0))
+    block = cs.get_block(idx.hash)
+    if block is None:
+        raise RPCError(RPC_MISC_ERROR, "Block not available")
+
+    raw_undo = node.block_store.get_undo(idx.hash)
+    undo = BlockUndo.from_bytes(raw_undo) if raw_undo else None
+
+    fees, feerates, sizes = [], [], []
+    ins = outs = total_out = 0
+    for t, tx in enumerate(block.vtx[1:]):
+        size = len(tx.serialize())
+        sizes.append(size)
+        ins += len(tx.vin)
+        outs += len(tx.vout)
+        out_sum = sum(o.value for o in tx.vout)
+        total_out += out_sum
+        if undo is not None and t < len(undo.vtxundo):
+            in_sum = sum(c.out.value for c in undo.vtxundo[t].prevouts)
+            fee = in_sum - out_sum
+            fees.append(fee)
+            if size:
+                feerates.append(fee * 1000 // size)
+    outs += len(block.vtx[0].vout)
+    total_out += sum(o.value for o in block.vtx[0].vout)
+
+    def med(v):
+        return sorted(v)[len(v) // 2] if v else 0
+
+    return {
+        "blockhash": hash_to_hex(idx.hash),
+        "height": idx.height,
+        "time": idx.header.time,
+        "mediantime": idx.get_median_time_past(),
+        "txs": len(block.vtx),
+        "ins": ins,
+        "outs": outs,
+        "subsidy": get_block_subsidy(idx.height, node.params.consensus),
+        "totalfee": sum(fees),
+        "avgfee": sum(fees) // len(fees) if fees else 0,
+        "medianfee": med(fees),
+        "minfee": min(fees) if fees else 0,
+        "maxfee": max(fees) if fees else 0,
+        "avgfeerate": sum(feerates) // len(feerates) if feerates else 0,
+        "medianfeerate": med(feerates),
+        "minfeerate": min(feerates) if feerates else 0,
+        "maxfeerate": max(feerates) if feerates else 0,
+        "total_size": sum(sizes),
+        "avgtxsize": sum(sizes) // len(sizes) if sizes else 0,
+        "mediantxsize": med(sizes),
+        "mintxsize": min(sizes) if sizes else 0,
+        "maxtxsize": max(sizes) if sizes else 0,
+        "total_out": total_out,
+    }

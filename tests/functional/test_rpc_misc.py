@@ -87,3 +87,38 @@ def test_signraw_history_and_bans():
         node.rpc.setban("203.0.113.8", "add")
         node.rpc.clearbanned()
         assert node.rpc.listbanned() == []
+
+
+def test_getblockstats_and_walletnotify(tmp_path):
+    import glob
+    import os
+
+    from .framework import wait_until
+
+    notify = f"-walletnotify=touch {tmp_path}/wtx_%s"
+    with FunctionalFramework(num_nodes=1,
+                             extra_args=[["-listen=0", notify]]) as f:
+        node = f.nodes[0]
+        addr = node.rpc.getnewaddress()
+        node.rpc.generatetoaddress(101, addr)
+        dest = _regtest_address(KEY)
+        txid = node.rpc.sendtoaddress(dest, 3.0)
+        tip_hash = node.rpc.generatetoaddress(1, addr)[0]
+
+        # stats by hash and by height agree; fee data comes from undo
+        stats = node.rpc.getblockstats(tip_hash)
+        assert stats["height"] == 102 and stats["txs"] == 2
+        assert stats["totalfee"] > 0
+        assert stats["subsidy"] == 50 * 100_000_000
+        assert stats["ins"] >= 1 and stats["outs"] >= 3
+        by_height = node.rpc.getblockstats(102)
+        assert by_height == stats
+        empty = node.rpc.getblockstats(50)
+        assert empty["txs"] == 1 and empty["totalfee"] == 0
+
+        # walletnotify fired for the confirmed wallet tx (the send)
+        wait_until(
+            lambda: os.path.exists(os.path.join(str(tmp_path), f"wtx_{txid}")),
+            timeout=15,
+        )
+        assert glob.glob(os.path.join(str(tmp_path), "wtx_*"))

@@ -1,0 +1,172 @@
+"""Hostile-input / property tests (SURVEY.md §8.6): random and mutated
+inputs must produce typed errors — never crashes, hangs, or silent
+acceptance. Pure Python, seeded, deterministic."""
+
+import numpy as np
+import pytest
+
+from bitcoincashplus_tpu.consensus.serialize import ByteReader
+from bitcoincashplus_tpu.consensus.tx import CTransaction
+from bitcoincashplus_tpu.mempool.mempool import CTxMemPool
+from bitcoincashplus_tpu.consensus.tx import COutPoint, CTxIn, CTxOut
+from bitcoincashplus_tpu.script.interpreter import (
+    BaseSignatureChecker,
+    ScriptError,
+    EvalScript,
+    VerifyScript,
+)
+from bitcoincashplus_tpu.p2p.protocol import (
+    MessageHeader,
+    NetMessageError,
+    check_payload,
+    deser_headers,
+    deser_inv,
+)
+
+
+class _NullChecker(BaseSignatureChecker):
+    pass
+
+
+def test_random_scripts_never_crash():
+    """4k random byte strings through EvalScript: the only acceptable
+    failure is ScriptError (typed, attributable)."""
+    rng = np.random.default_rng(0xF0)
+    for _ in range(4000):
+        script = rng.bytes(rng.integers(0, 64))
+        stack = [b"\x01"] * int(rng.integers(0, 4))
+        try:
+            EvalScript(stack, script, 0, _NullChecker())
+        except ScriptError:
+            pass
+
+
+def test_random_script_pairs_verify():
+    rng = np.random.default_rng(0xF1)
+    for _ in range(1500):
+        sig = rng.bytes(rng.integers(0, 32))
+        spk = rng.bytes(rng.integers(0, 48))
+        flags = int(rng.integers(0, 1 << 17))
+        try:
+            VerifyScript(sig, spk, flags, _NullChecker())
+        except (ScriptError, AssertionError):
+            # AssertionError only from the CLEANSTACK-without-P2SH pairing
+            # assert, which mirrors the reference's own assert
+            pass
+
+
+def test_mutated_tx_bytes_never_crash():
+    """Bit-flipped and truncated real transactions either round-trip or
+    raise the serializer's typed error."""
+    from bitcoincashplus_tpu.consensus.serialize import DeserializationError
+    from bitcoincashplus_tpu.consensus.params import regtest_params
+
+    base = regtest_params().genesis.vtx[0].serialize()
+    rng = np.random.default_rng(0xF2)
+    for _ in range(1500):
+        raw = bytearray(base)
+        for _ in range(int(rng.integers(1, 4))):
+            raw[int(rng.integers(0, len(raw)))] ^= int(rng.integers(1, 256))
+        cut = int(rng.integers(1, len(raw) + 1))
+        try:
+            CTransaction.deserialize(ByteReader(bytes(raw[:cut])))
+        except (DeserializationError, ValueError):
+            pass
+
+
+def test_p2p_garbage_never_crashes():
+    """Random wire headers / inv / headers payloads raise NetMessageError
+    (the discharge path), never anything else."""
+    rng = np.random.default_rng(0xF3)
+    magic = b"\xfa\xbf\xb5\xda"
+    for _ in range(2000):
+        raw = rng.bytes(24)
+        try:
+            MessageHeader.parse(bytes(raw), magic)
+        except NetMessageError:
+            pass
+    for _ in range(2000):
+        payload = rng.bytes(rng.integers(0, 64))
+        for fn in (deser_inv, deser_headers):
+            try:
+                fn(payload)
+            except NetMessageError:
+                pass
+
+
+def test_mempool_aggregate_invariants_random_ops():
+    """mempool_tests.cpp-style bookkeeping check: after any interleaving of
+    adds and removes, every entry's ancestor/descendant aggregates must
+    equal what a from-scratch graph walk computes."""
+    rng = np.random.default_rng(0xF4)
+    pool = CTxMemPool()
+    txs = {}  # txid -> tx
+
+    def free_outpoint(parent):
+        for n in range(2):
+            op = COutPoint(parent, n)
+            if op not in pool.map_next_tx:
+                return op
+        return None
+
+    def mk_tx(parents):
+        vin = []
+        for p in parents:
+            op = free_outpoint(p)
+            if op is not None:
+                vin.append(CTxIn(op))
+        if not vin:
+            vin = [CTxIn(COutPoint(rng.bytes(32), 0))]
+        vout = (CTxOut(10_000, b"\x51"), CTxOut(10_000, b"\x52"))
+        return CTransaction(vin=tuple(vin), vout=vout)
+
+    def walk(txid, direction):
+        """Transitive closure over in-pool parents/children incl. self."""
+        seen, todo = set(), [txid]
+        while todo:
+            t = todo.pop()
+            if t in seen or t not in pool.entries:
+                continue
+            seen.add(t)
+            e = pool.entries[t]
+            if direction == "up":
+                nxt = {i.prevout.hash for i in e.tx.vin
+                       if i.prevout.hash in pool.entries}
+            else:
+                nxt = {pool.map_next_tx[COutPoint(t, n)]
+                       for n in range(len(e.tx.vout))
+                       if COutPoint(t, n) in pool.map_next_tx}
+            todo.extend(nxt)
+        return seen
+
+    for step in range(300):
+        op = rng.random()
+        if op < 0.7 or not pool.entries:
+            n_parents = int(rng.integers(0, min(3, len(pool.entries) + 1)))
+            parents = list(rng.choice(
+                [t for t in pool.entries], size=n_parents, replace=False
+            )) if n_parents and pool.entries else []
+            tx = mk_tx(parents)
+            if tx.txid in pool.entries:
+                continue
+            txs[tx.txid] = tx
+            from bitcoincashplus_tpu.mempool.mempool import MempoolEntry
+
+            pool.add_unchecked(MempoolEntry(tx, fee=1000, entry_time=step,
+                                            entry_height=1))
+        else:
+            victim = list(pool.entries)[int(rng.integers(0, len(pool.entries)))]
+            pool.remove_recursive(victim)
+
+        # invariant check over every entry
+        for txid, e in pool.entries.items():
+            anc = walk(txid, "up")
+            desc = walk(txid, "down")
+            assert e.count_with_ancestors == len(anc), "ancestor count"
+            assert e.count_with_descendants == len(desc), "descendant count"
+            assert e.size_with_ancestors == sum(
+                pool.entries[t].size for t in anc
+            )
+            assert e.fees_with_descendants == sum(
+                pool.entries[t].fee for t in desc
+            )
