@@ -47,6 +47,8 @@ from .protocol import (
 )
 
 
+MAX_ORPHAN_TX = 100  # DEFAULT_MAX_ORPHAN_TRANSACTIONS
+
 class Peer:
     """CNode — one connected peer."""
 
@@ -120,6 +122,9 @@ class CConnman:
         # Host granularity (no CIDR) matching how we track peers.
         self._banned: dict[str, float] = {}
         self.bantime = 86400  # -bantime default
+        # mapOrphanTransactions (net_processing.cpp): txs whose inputs we
+        # don't know yet, bounded FIFO
+        self._orphans: dict[bytes, CTransaction] = {}
 
     # -- lifecycle ------------------------------------------------------
 
@@ -438,12 +443,42 @@ class CConnman:
             raise NetMessageError("undecodable tx") from None
         peer.known_invs.add(tx.txid)
         with self.node.cs_main:
-            try:
-                self.node.accept_to_mempool(tx)
-            except MempoolError as e:
+            self._accept_tx(peer, tx)
+
+    def _accept_tx(self, peer: Peer, tx: CTransaction) -> None:
+        """ATMP + the mapOrphanTransactions dance (net_processing.cpp:~900):
+        a tx with unknown inputs parks in a bounded orphan pool and is
+        retried when any parent is accepted; accepted txs relay onward and
+        trigger orphan reprocessing. Caller holds cs_main."""
+        try:
+            self.node.accept_to_mempool(tx)
+        except MempoolError as e:
+            if e.reason == "missing-inputs":
+                if len(self._orphans) >= MAX_ORPHAN_TX:
+                    # evict a random-ish (FIFO) orphan like LimitOrphanTxSize
+                    self._orphans.pop(next(iter(self._orphans)))
+                self._orphans[tx.txid] = tx
+                log_print("net", "orphan tx %s parked (%d pooled)",
+                          tx.txid_hex[:16], len(self._orphans))
+            else:
                 log_print("net", "tx %s rejected: %s", tx.txid_hex[:16], e.reason)
-                return
-        self.relay_tx(tx.txid, skip_peer=peer.id)
+            return
+        self.relay_tx(tx.txid, skip_peer=peer.id if peer else 0)
+        # any orphans that spend this tx can be retried now
+        dependents = [
+            o for o in self._orphans.values()
+            if any(i.prevout.hash == tx.txid for i in o.vin)
+        ]
+        for o in dependents:
+            self._orphans.pop(o.txid, None)
+            self._accept_tx(peer, o)
+
+    def _msg_mempool(self, peer: Peer, payload: bytes) -> None:
+        """BIP35 'mempool': answer with an inv of current mempool txids."""
+        with self.node.cs_main:
+            txids = list(self.node.mempool.entries)
+        if txids:
+            peer.send("inv", ser_inv([(MSG_TX, h) for h in txids[:50_000]]))
 
     # -- relay ----------------------------------------------------------
 

@@ -405,3 +405,37 @@ def getblockstats(node, params):
         "maxtxsize": max(sizes) if sizes else 0,
         "total_out": total_out,
     }
+
+
+@rpc_method("getmempoolancestors")
+def getmempoolancestors(node, params):
+    """getmempoolancestors (rpc/blockchain.cpp): in-pool ancestors of a
+    mempool tx, txid list or verbose entry map."""
+    require_params(params, 1, 2, "getmempoolancestors \"txid\" ( verbose )")
+    txid = param_hash(params, 0)
+    pool = node.mempool
+    if txid not in pool.entries:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                       "Transaction not in mempool")
+    anc = pool.calculate_ancestors(pool.entries[txid].tx) - {txid}
+    verbose = params[1] if len(params) > 1 else False
+    if not verbose:
+        return [hash_to_hex(t) for t in sorted(anc)]
+    return {hash_to_hex(t): _mempool_entry_json(pool, pool.entries[t])
+            for t in anc}
+
+
+@rpc_method("getmempooldescendants")
+def getmempooldescendants(node, params):
+    require_params(params, 1, 2, "getmempooldescendants \"txid\" ( verbose )")
+    txid = param_hash(params, 0)
+    pool = node.mempool
+    if txid not in pool.entries:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                       "Transaction not in mempool")
+    desc = pool.calculate_descendants(txid) - {txid}
+    verbose = params[1] if len(params) > 1 else False
+    if not verbose:
+        return [hash_to_hex(t) for t in sorted(desc)]
+    return {hash_to_hex(t): _mempool_entry_json(pool, pool.entries[t])
+            for t in desc}

@@ -166,3 +166,72 @@ def _expect_disconnect(s: socket.socket, timeout: float = 15.0) -> bool:
     finally:
         s.close()
     return False
+
+
+def test_orphan_tx_parking_and_mempool_msg():
+    """Child-before-parent relay: the child parks in the orphan pool and is
+    accepted when the parent arrives (net_processing mapOrphanTransactions);
+    BIP35 'mempool' answers with an inv of the pool."""
+    from bitcoincashplus_tpu.consensus.serialize import ByteReader
+    from bitcoincashplus_tpu.consensus.tx import (
+        COutPoint,
+        CTransaction,
+        CTxIn,
+        CTxOut,
+    )
+    from bitcoincashplus_tpu.p2p.protocol import MSG_TX, deser_inv
+    from bitcoincashplus_tpu.script.sighash import SIGHASH_ALL
+    from bitcoincashplus_tpu.wallet.signing import sign_transaction
+    from .framework import wait_until
+
+    with FunctionalFramework(num_nodes=1) as f:
+        node = f.nodes[0]
+        node.rpc.generatetoaddress(101, ADDR)
+        blk1 = node.rpc.getblock(node.rpc.getblockhash(1), 2)
+        cb = blk1["tx"][0]
+
+        # parent spends the coinbase; child spends the parent
+        prev = bytes.fromhex(cb["txid"])[::-1]
+        spk = KEY.p2pkh_script()
+        value = 50 * 100_000_000
+        parent = sign_transaction(
+            CTransaction(vin=(CTxIn(COutPoint(prev, 0)),),
+                         vout=(CTxOut(value - 10_000, spk),)),
+            [(spk, value)], lambda i: KEY if i == KEY.pubkey_hash else None,
+            SIGHASH_ALL, enable_forkid=True,
+        )
+        child = sign_transaction(
+            CTransaction(vin=(CTxIn(COutPoint(parent.txid, 0)),),
+                         vout=(CTxOut(value - 20_000, spk),)),
+            [(spk, value - 10_000)],
+            lambda i: KEY if i == KEY.pubkey_hash else None,
+            SIGHASH_ALL, enable_forkid=True,
+        )
+
+        magic = regtest_params().netmagic
+        s = socket.create_connection(("127.0.0.1", node.p2p_port), timeout=10)
+        s.sendall(pack_message(magic, "version", VersionPayload().serialize()))
+        _read_msg(s)
+        _read_msg(s)
+        s.sendall(pack_message(magic, "verack"))
+
+        # child FIRST: must not enter the mempool yet
+        s.sendall(pack_message(magic, "tx", child.serialize()))
+        time.sleep(1.0)
+        assert node.rpc.getrawmempool() == []
+        # parent arrives: both are accepted
+        s.sendall(pack_message(magic, "tx", parent.serialize()))
+        wait_until(lambda: len(node.rpc.getrawmempool()) == 2, timeout=20)
+
+        # BIP35 mempool message: node answers with a 2-entry tx inv
+        s.sendall(pack_message(magic, "mempool"))
+        deadline = time.time() + 15
+        got = set()
+        while time.time() < deadline and len(got) < 2:
+            hdr, payload = _read_msg(s)
+            if hdr[4:16].rstrip(b"\x00") == b"inv":
+                for t, h in deser_inv(payload):
+                    if t == MSG_TX:
+                        got.add(h)
+        assert got == {parent.txid, child.txid}
+        s.close()
