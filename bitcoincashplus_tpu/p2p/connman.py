@@ -48,6 +48,8 @@ from .protocol import (
 
 
 MAX_ORPHAN_TX = 100  # DEFAULT_MAX_ORPHAN_TRANSACTIONS
+PING_INTERVAL = 120       # net.cpp PING_INTERVAL
+TIMEOUT_INTERVAL = 1200   # net.cpp TIMEOUT_INTERVAL (20 min)
 
 class Peer:
     """CNode — one connected peer."""
@@ -140,6 +142,7 @@ class CConnman:
         asyncio.set_event_loop(self.loop)
         if self.listen_port:  # 0 = -listen=0 (outbound only)
             self.loop.run_until_complete(self._start_server())
+        self.loop.create_task(self._keepalive_loop())
         self._started.set()
         self.loop.run_forever()
         # drain: close transports
@@ -147,6 +150,24 @@ class CConnman:
             task.cancel()
         self.loop.run_until_complete(asyncio.sleep(0))
         self.loop.close()
+
+    async def _keepalive_loop(self) -> None:
+        """InactivityCheck + PingPeriodicity (net.cpp:~1300): ping every
+        PING_INTERVAL; drop peers silent past TIMEOUT_INTERVAL."""
+        while True:
+            await asyncio.sleep(PING_INTERVAL)
+            now = time.time()
+            for peer in list(self.peers.values()):
+                quiet = now - max(peer.last_recv, peer.connected_at)
+                if quiet > TIMEOUT_INTERVAL:
+                    log_print("net", "peer=%d inactivity timeout — dropping",
+                              peer.id)
+                    peer.writer.close()
+                elif peer.handshaked:
+                    try:
+                        peer.send("ping", ser_ping(secrets.randbits(64)))
+                    except Exception:
+                        pass
 
     async def _start_server(self) -> None:
         self._server = await asyncio.start_server(

@@ -439,3 +439,13 @@ def getmempooldescendants(node, params):
         return [hash_to_hex(t) for t in sorted(desc)]
     return {hash_to_hex(t): _mempool_entry_json(pool, pool.entries[t])
             for t in desc}
+
+
+@rpc_method("preciousblock")
+def preciousblock(node, params):
+    """preciousblock \"hash\": prefer this block over equal-work
+    competitors (validation.cpp PreciousBlock)."""
+    require_params(params, 1, 1, "preciousblock \"blockhash\"")
+    idx = _block_index_or_raise(node, param_hash(params, 0))
+    node.chainstate.precious_block(idx)
+    return None

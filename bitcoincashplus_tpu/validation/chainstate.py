@@ -76,6 +76,7 @@ class ChainstateManager:
         self.get_time = get_time
         self._candidates: set[CBlockIndex] = set()  # setBlockIndexCandidates
         self._seq = 0
+        self._precious_seq = 0  # PreciousBlock's nBlockReverseSequenceId
         self._invalid: set[CBlockIndex] = set()
         # setDirtyBlockIndex analogue: indexes whose on-disk record is stale
         self._dirty_index: set[CBlockIndex] = set()
@@ -325,7 +326,8 @@ class ChainstateManager:
         if (
             idx.chain_tx > 0  # whole ancestor path has block data
             and idx.is_valid(BlockStatus.VALID_TRANSACTIONS)
-            and (tip is None or idx.chain_work > tip.chain_work)
+            and (tip is None or (idx.chain_work, -idx.sequence_id)
+                 > (tip.chain_work, -tip.sequence_id))
         ):
             self._candidates.add(idx)
 
@@ -461,11 +463,16 @@ class ChainstateManager:
 
     def activate_best_chain(self) -> None:
         """ActivateBestChain (src/validation.cpp:~2500): step toward the
-        most-work valid chain, disconnecting/connecting as needed."""
+        most-work valid chain, disconnecting/connecting as needed. The
+        comparison is CBlockIndexWorkComparator's (work, then earlier
+        sequence wins) so preciousblock's negative sequence ids can win an
+        equal-work tie; a later-received equal-work block still loses."""
         while True:
             tip = self.chain.tip()
             target = self._find_most_work_chain()
-            if target is None or (tip is not None and target.chain_work <= tip.chain_work):
+            if target is None or (tip is not None and (
+                target.chain_work, -target.sequence_id
+            ) <= (tip.chain_work, -tip.sequence_id)):
                 self._prune_candidates()
                 return
             if not self._activate_step(target):
@@ -599,7 +606,8 @@ class ChainstateManager:
             return
         self._candidates = {
             c for c in self._candidates
-            if c.chain_work > tip.chain_work and not (c.status & BlockStatus.FAILED_MASK)
+            if (c.chain_work, -c.sequence_id) > (tip.chain_work, -tip.sequence_id)
+            and not (c.status & BlockStatus.FAILED_MASK)
         }
 
     # ------------------------------------------------------------------
@@ -614,6 +622,18 @@ class ChainstateManager:
         self.accept_block(block)
         self.activate_best_chain()
         return True
+
+    def precious_block(self, idx: CBlockIndex) -> None:
+        """PreciousBlock (src/validation.cpp:~2900): treat the block as if
+        it had been received before every competitor — a decreasing
+        negative sequence id wins the equal-work tie in the comparator."""
+        if idx in self.chain:
+            return  # already the active chain's block at its height
+        self._precious_seq -= 1
+        idx.sequence_id = self._precious_seq
+        self._dirty_index.add(idx)
+        self._try_add_candidate(idx)
+        self.activate_best_chain()
 
     def invalidate_block(self, idx: CBlockIndex) -> None:
         """InvalidateBlock RPC backend: mark invalid and walk the tip back."""

@@ -271,3 +271,30 @@ class TestUndoRoundtrip:
         assert rt.vtxundo[0].prevouts[0] == coin
         assert rt.vtxundo[0].prevouts[1] == cb
         assert rt.vtxundo[1].prevouts == [coin]
+
+
+class TestPreciousBlock:
+    def test_precious_wins_equal_work_tie(self, chainstate):
+        """PreciousBlock semantics: first-seen wins an equal-work race until
+        preciousblock re-ranks the competitor; precious can flip back too."""
+        _mine_on(chainstate, 1)
+        tip = chainstate.tip()
+        t = chainstate.get_time()
+        blk_a = _hand_mine(tip.hash, tip.height + 1, t + 10, tip.bits, ())
+        blk_b = _hand_mine(tip.hash, tip.height + 1, t + 11, tip.bits, ())
+        assert blk_a.get_hash() != blk_b.get_hash()
+        chainstate.process_new_block(blk_a)
+        chainstate.process_new_block(blk_b)
+        assert chainstate.tip().hash == blk_a.get_hash()  # first seen
+
+        idx_b = chainstate.block_index[blk_b.get_hash()]
+        chainstate.precious_block(idx_b)
+        assert chainstate.tip().hash == blk_b.get_hash()
+
+        idx_a = chainstate.block_index[blk_a.get_hash()]
+        chainstate.precious_block(idx_a)
+        assert chainstate.tip().hash == blk_a.get_hash()
+
+        # precious on the active tip is a no-op
+        chainstate.precious_block(idx_a)
+        assert chainstate.tip().hash == blk_a.get_hash()
