@@ -68,7 +68,10 @@ class Node:
             if backend_is_cpu():
                 import jax
 
-                jax.config.update("jax_default_device", jax.devices("cpu")[0])
+                # hide accelerator plugins entirely (config, not env: the
+                # env var alone doesn't stop plugin init, and an unreachable
+                # device tunnel would hang the node's first jit)
+                jax.config.update("jax_platforms", "cpu")
         except Exception:
             pass
         os.makedirs(self.datadir, exist_ok=True)

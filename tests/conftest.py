@@ -25,6 +25,10 @@ import tempfile  # noqa: E402
 
 import jax  # noqa: E402  (env must be set first)
 
+# jax_platforms=cpu BEFORE any backend query: the env var alone does not
+# stop the accelerator plugin from initializing on jax.devices(), and a
+# wedged/unreachable device tunnel would hang the whole suite at import.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
 # Persistent compilation cache: the ECDSA batch kernel costs ~90s of XLA
