@@ -67,6 +67,7 @@ class Peer:
         self.addr = f"{peername[0]}:{peername[1]}"
         self.version: Optional[VersionPayload] = None
         self.got_verack = False
+        self.prefers_headers = False  # BIP130 sendheaders
         self.known_invs: set[bytes] = set()
         self.connected_at = time.time()
         self.last_recv = 0.0
@@ -333,6 +334,9 @@ class CConnman:
 
     def _msg_verack(self, peer: Peer, payload: bytes) -> None:
         peer.got_verack = True
+        # BIP130: ask for headers-first block announcements (we already
+        # process unsolicited headers via _msg_headers)
+        peer.send("sendheaders")
         # start headers sync (the reference sends getheaders on verack)
         with self.node.cs_main:
             locator = self.node.chainstate.chain.get_locator()
@@ -343,6 +347,11 @@ class CConnman:
 
     def _msg_pong(self, peer: Peer, payload: bytes) -> None:
         pass
+
+    def _msg_sendheaders(self, peer: Peer, payload: bytes) -> None:
+        """BIP130: peer wants new-block announcements as headers messages
+        instead of inv (net_processing.cpp SENDHEADERS handling)."""
+        peer.prefers_headers = True
 
     def _msg_getheaders(self, peer: Peer, payload: bytes) -> None:
         locator, hash_stop = deser_getheaders(payload)
@@ -504,8 +513,24 @@ class CConnman:
     # -- relay ----------------------------------------------------------
 
     def _on_tip_changed(self, tip) -> None:
-        if tip is not None:
-            self.relay_block(tip.hash)
+        if tip is None:
+            return
+        header = tip.header
+
+        def _announce():
+            for peer in self.peers.values():
+                if not peer.handshaked or tip.hash in peer.known_invs:
+                    continue
+                peer.known_invs.add(tip.hash)
+                try:
+                    if peer.prefers_headers:  # BIP130 direct headers announce
+                        peer.send("headers", ser_headers([header]))
+                    else:
+                        peer.send("inv", ser_inv([(MSG_BLOCK, tip.hash)]))
+                except Exception:
+                    pass
+        if self.loop is not None:
+            self.loop.call_soon_threadsafe(_announce)
 
     def _broadcast_inv(self, inv_type: int, h: bytes, skip_peer: int = 0) -> None:
         def _do():
