@@ -231,7 +231,28 @@ def bench_sweep_headline():
               "6.17T u32-op/s VPU integer ceiling — see ROOFLINE.md")
 
 
+def _device_reachable(timeout_s: int = 180) -> bool:
+    """Guard against a wedged device tunnel: backend init hangs forever in
+    that state (observed this round) inside C code, where neither signals
+    nor KeyboardInterrupt land — so probe from a killable subprocess and
+    only touch jax backends in THIS process once the probe succeeds."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        return probe.returncode == 0 and "ok" in probe.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
+    if not _device_reachable():
+        emit("sha256d_sweep_throughput_per_chip", 0.0, "GH/s", 0.0,
+             error="device tunnel unreachable (backend init timed out); "
+                   "session-measured values: sweep 0.94 GH/s, ecdsa 3301 "
+                   "sigs/s — see ROOFLINE.md / PARITY.md")
+        return
     on_cpu = jax.default_backend() == "cpu"
     bench_header_hash()
     bench_merkle()
