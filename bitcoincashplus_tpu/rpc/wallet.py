@@ -254,3 +254,59 @@ def gettransaction(node, params):
     out["fee"] = 0.0  # fee tracking requires full input provenance
     out["details"] = [out.copy()]
     return out
+
+
+def _received_by_spk(w, minconf: int, tip: int) -> dict:
+    """spk -> total satoshis received across wallet coins (spent or not),
+    rpcwallet.cpp GetReceived semantics: receipts count even if later
+    spent, gated on confirmations."""
+    out = {}
+    for coin in w.coins.values():
+        conf = 0 if coin.height < 0 else tip - coin.height + 1
+        if conf < minconf:
+            continue
+        spk = coin.txout.script_pubkey
+        out[spk] = out.get(spk, 0) + coin.txout.value
+    return out
+
+
+@rpc_method("getreceivedbyaddress")
+def getreceivedbyaddress(node, params):
+    require_params(params, 1, 2, "getreceivedbyaddress \"address\" ( minconf )")
+    from ..wallet.keys import address_to_script
+
+    spk = address_to_script(params[0], node.params)
+    if spk is None:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Invalid address")
+    minconf = int(params[1]) if len(params) > 1 else 1
+    w = _wallet(node)
+    tip = node.chainstate.tip().height
+    return _received_by_spk(w, minconf, tip).get(spk, 0) / COIN
+
+
+@rpc_method("listreceivedbyaddress")
+def listreceivedbyaddress(node, params):
+    minconf = int(params[0]) if params else 1
+    include_empty = bool(params[1]) if len(params) > 1 else False
+    from ..wallet.keys import script_to_address
+
+    w = _wallet(node)
+    tip = node.chainstate.tip().height
+    received = _received_by_spk(w, minconf, tip)
+    out = []
+    seen_spks = set(received)
+    if include_empty:
+        from ..script.script import p2pkh_script
+
+        for pkh in w._pkh_index:
+            seen_spks.add(p2pkh_script(pkh))
+    for spk in seen_spks:
+        addr = script_to_address(spk, node.params)
+        if addr is None:
+            continue
+        out.append({
+            "address": addr,
+            "amount": received.get(spk, 0) / COIN,
+            "confirmations": minconf,
+        })
+    return sorted(out, key=lambda r: r["address"])

@@ -23,6 +23,12 @@ def test_wallet_rpc_lifecycle():
         bal = node.rpc.getbalance()
         assert bal == 100.0  # two mature 50-coin coinbases
 
+        # received-by accounting counts all receipts at >= minconf
+        assert node.rpc.getreceivedbyaddress(addr) == 101 * 50.0
+        rows = node.rpc.listreceivedbyaddress()
+        assert any(r["address"] == addr and r["amount"] == 101 * 50.0
+                   for r in rows)
+
         # plain spend to a foreign address
         dest = _regtest_address(KEY)
         txid = node.rpc.sendtoaddress(dest, 1.5)
