@@ -179,6 +179,47 @@ def rfc6979_nonce(secret: int, e: int, extra: bytes = b"") -> int:
         v = hmac.new(k, v, hashlib.sha256).digest()
 
 
+# ---- Recoverable ECDSA (secp256k1 recovery module:
+# secp256k1_ecdsa_sign_recoverable / secp256k1_ecdsa_recover) ----
+
+def ecdsa_sign_recoverable(secret: int, e: int) -> tuple[int, int, int]:
+    """Returns (r, s, recid) with low-s normalization. recid bit 0 is the
+    parity of R.y (flipped when s is negated), bit 1 flags R.x >= n
+    (secp256k1_ecdsa_sig_sign's recid computation)."""
+    k = rfc6979_nonce(secret, e)
+    pt = point_mul(k, G)
+    x, y = pt
+    r = x % N
+    assert r != 0
+    recid = (2 if x >= N else 0) | (y & 1)
+    s = pow(k, N - 2, N) * (e + r * secret) % N
+    assert s != 0
+    if s > N // 2:
+        s = N - s
+        recid ^= 1
+    return r, s, recid
+
+
+def ecdsa_recover(r: int, s: int, recid: int, e: int):
+    """Recover the signing pubkey point, or None (secp256k1_ecdsa_recover:
+    Q = r^-1 (s·R − e·G) with R reconstructed from r/recid)."""
+    if not (1 <= r < N) or not (1 <= s < N) or not (0 <= recid <= 3):
+        return None
+    x = r + (N if recid & 2 else 0)
+    if x >= P:
+        return None
+    y2 = (x * x * x + B) % P
+    y = pow(y2, (P + 1) // 4, P)
+    if y * y % P != y2:
+        return None
+    if (y & 1) != (recid & 1):
+        y = P - y
+    r_inv = pow(r, N - 2, N)
+    q = point_add(point_mul(s * r_inv % N, (x, y)),
+                  point_mul(-e * r_inv % N, G))
+    return q
+
+
 # ---- DER (src/pubkey.cpp CPubKey::CheckLowS / ecdsa_signature_parse_der_lax) ----
 
 def sig_der_encode(r: int, s: int) -> bytes:

@@ -202,9 +202,13 @@ def accept_to_memory_pool(
     if sigops > MAX_STANDARD_TX_SIGOPS:
         raise MempoolError("bad-txns-too-many-sigops", str(sigops))
 
+    # nModifiedFees: a prioritisetransaction delta counts toward the fee
+    # floor and every mining/eviction score, like the reference
+    modified_fee = fee + pool.map_deltas.get(txid, 0)
     min_fee = get_min_relay_fee(tx.size(), min_fee_rate)
-    if fee < min_fee:
-        raise MempoolError("mempool-min-fee-not-met", f"{fee} < {min_fee}")
+    if modified_fee < min_fee:
+        raise MempoolError("mempool-min-fee-not-met",
+                           f"{modified_fee} < {min_fee}")
 
     ancestors = pool.check_ancestor_limits(tx, fee)
 
@@ -213,11 +217,12 @@ def accept_to_memory_pool(
 
     entry = MempoolEntry(
         tx,
-        fee,
+        modified_fee,
         now if now is not None else int(_time.time()),
         height,
         sigops=sigops,
         spends_coinbase=spends_coinbase,
+        base_fee=fee,
     )
     pool.add_unchecked(entry, ancestors)
     removed = pool.trim_to_size()

@@ -33,7 +33,7 @@ class MempoolEntry:
     """CTxMemPoolEntry (src/txmempool.h:~60)."""
 
     __slots__ = (
-        "tx", "fee", "time", "entry_height", "size", "sigops",
+        "tx", "fee", "base_fee", "time", "entry_height", "size", "sigops",
         "spends_coinbase",
         # cached aggregates (IncludeSelf): reference's nCountWithAncestors…
         "count_with_ancestors", "size_with_ancestors", "fees_with_ancestors",
@@ -43,8 +43,13 @@ class MempoolEntry:
 
     def __init__(self, tx: CTransaction, fee: int, entry_time: int,
                  entry_height: int, sigops: int = 0,
-                 spends_coinbase: bool = False):
+                 spends_coinbase: bool = False,
+                 base_fee: Optional[int] = None):
         self.tx = tx
+        # `fee` is the MODIFIED fee (base + prioritisetransaction delta) —
+        # it drives every score/aggregate, like the reference's
+        # nModifiedFees; `base_fee` is what the tx actually pays.
+        self.base_fee = fee if base_fee is None else base_fee
         self.fee = fee
         self.time = entry_time
         self.entry_height = entry_height
@@ -94,6 +99,10 @@ class CTxMemPool:
         self.total_fee = 0
         # bumped on every mutation; getblocktemplate longpoll + caching key
         self.sequence = 0
+        # mapDeltas (PrioritiseTransaction): txid -> fee delta in satoshis.
+        # Outlives pool membership — a delta set before the tx arrives is
+        # applied when it enters via AcceptToMemoryPool.
+        self.map_deltas: dict[bytes, int] = {}
 
     # ------------------------------------------------------------------
     # queries
@@ -229,6 +238,24 @@ class CTxMemPool:
         self.sequence += 1
         return entry
 
+    def prioritise(self, txid: bytes, fee_delta: int) -> None:
+        """PrioritiseTransaction (txmempool.cpp:~800): accumulate a fee
+        delta for txid and, if it is in the pool, push the delta through
+        its own and its relatives' fee aggregates."""
+        self.map_deltas[txid] = self.map_deltas.get(txid, 0) + fee_delta
+        entry = self.entries.get(txid)
+        if entry is None:
+            return
+        entry.fee += fee_delta
+        entry.fees_with_ancestors += fee_delta
+        entry.fees_with_descendants += fee_delta
+        for a in self.calculate_ancestors(entry.tx):
+            self.entries[a].fees_with_descendants += fee_delta
+        for d in self.calculate_descendants_of_outputs(entry.tx):
+            self.entries[d].fees_with_ancestors += fee_delta
+        self.total_fee += fee_delta
+        self.sequence += 1
+
     def calculate_descendants_of_outputs(self, tx: CTransaction) -> set[bytes]:
         out: set[bytes] = set()
         for i in range(len(tx.vout)):
@@ -253,6 +280,9 @@ class CTxMemPool:
         """removeForBlock: drop confirmed txs, then conflicts (anything
         spending an outpoint a block tx just spent)."""
         for tx in block_txs:
+            # ClearPrioritisation: a confirmed tx's fee delta is spent
+            # (coinbases included — their txids can carry stray deltas)
+            self.map_deltas.pop(tx.txid, None)
             if tx.is_coinbase():
                 continue
             if tx.txid in self.entries:

@@ -81,8 +81,8 @@ class BlockAssembler:
             )
             for entry in selected:
                 txs.append(entry.tx)
-                fees.append(entry.fee)
-                total_fees += entry.fee
+                fees.append(entry.base_fee)
+                total_fees += entry.base_fee
 
         coinbase = CTransaction(
             version=1,

@@ -159,6 +159,15 @@ class Node:
         self.connman = None  # set by start_p2p
         self.wallet = None  # set by load_wallet
 
+        # LoadMempool (src/validation.cpp): replay mempool.dat unless
+        # -persistmempool=0 or we just rebuilt the chainstate
+        self.persist_mempool = config.get_bool("persistmempool", True)
+        self._mempool_dat = os.path.join(self.datadir, "mempool.dat")
+        if self.persist_mempool and not reindex:
+            from ..mempool.persist import load_mempool
+
+            load_mempool(self, self._mempool_dat)
+
     # -- validation-interface callbacks (CMainSignals analogues) --------
 
     def _on_block_connected(self, block: CBlock, idx) -> None:
@@ -167,7 +176,9 @@ class Node:
         for tx in block.vtx[1:]:
             entry = self.mempool.entries.get(tx.txid)
             if entry is not None and entry.size > 0:
-                rates.append(entry.fee * 1000 // entry.size)
+                # estimator samples what the tx actually paid, not
+                # prioritisetransaction-modified fees
+                rates.append(entry.base_fee * 1000 // entry.size)
         if rates:
             rates.sort()
             self._fee_estimates.append(rates[len(rates) // 2])
@@ -506,6 +517,16 @@ class Node:
             self.connman.close()
             self.connman = None
         with self.cs_main:
+            if self.persist_mempool:
+                from ..mempool.persist import dump_mempool
+
+                try:
+                    n = dump_mempool(self.mempool, self._mempool_dat)
+                    log_print("mempool", "DumpMempool: %d entries", n)
+                except OSError as e:
+                    # a failed dump must not abort the rest of shutdown
+                    # (chainstate flush + store closes still run)
+                    log_printf("DumpMempool failed: %r", e)
             self.chainstate.flush()
             self.block_store.close()
             self._index_kv.close()

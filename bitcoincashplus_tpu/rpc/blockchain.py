@@ -219,7 +219,8 @@ def getrawmempool(node, params):
 def _mempool_entry_json(pool, e) -> dict:
     return {
         "size": e.size,
-        "fee": e.fee / 1e8,
+        "fee": e.base_fee / 1e8,
+        "modifiedfee": e.fee / 1e8,
         "time": e.time,
         "height": e.entry_height,
         "descendantcount": e.count_with_descendants,
@@ -240,6 +241,15 @@ def getmempoolentry(node, params):
     if e is None:
         raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Transaction not in mempool")
     return _mempool_entry_json(node.mempool, e)
+
+
+@rpc_method("savemempool")
+def savemempool(node, params):
+    """savemempool — dump the mempool to disk now (mempool.dat)."""
+    from ..mempool.persist import dump_mempool
+
+    dump_mempool(node.mempool, node._mempool_dat)
+    return None
 
 
 @rpc_method("getmempoolinfo")

@@ -51,6 +51,30 @@ def getmemoryinfo(node, params):
                        "total": usage.ru_maxrss * 1024}}
 
 
+@rpc_method("verifymessage")
+def verifymessage(node, params):
+    require_params(params, 3, 3,
+                   "verifymessage \"address\" \"signature\" \"message\"")
+    from ..wallet.message import verify_message
+
+    return verify_message(str(params[0]), str(params[1]), str(params[2]),
+                          node.params)
+
+
+@rpc_method("signmessagewithprivkey")
+def signmessagewithprivkey(node, params):
+    require_params(params, 2, 2,
+                   "signmessagewithprivkey \"privkey\" \"message\"")
+    from ..wallet.keys import CKey
+    from ..wallet.message import sign_message
+    from .registry import RPC_INVALID_ADDRESS_OR_KEY
+
+    key = CKey.from_wif(str(params[0]), node.params)
+    if key is None:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Invalid private key")
+    return sign_message(key, str(params[1]))
+
+
 @rpc_method("validateaddress")
 def validateaddress(node, params):
     require_params(params, 1, 1, "validateaddress \"address\"")

@@ -124,7 +124,18 @@ def getnetworkhashps(node, params):
 
 @rpc_method("prioritisetransaction")
 def prioritisetransaction(node, params):
-    return True  # accepted, no-op: fee deltas are not modelled
+    """prioritisetransaction "txid" priority_delta fee_delta — the priority
+    delta is accepted-and-ignored (priority was removed from this lineage's
+    successor policy); the fee delta (satoshis) feeds mapDeltas."""
+    from .registry import require_params
+
+    require_params(params, 3, 3,
+                   "prioritisetransaction \"txid\" priority_delta fee_delta")
+    from ..consensus.serialize import hex_to_hash
+
+    txid = hex_to_hash(params[0])
+    node.mempool.prioritise(txid, int(params[2]))
+    return True
 
 
 @rpc_method("estimatefee")

@@ -16,6 +16,7 @@ from .registry import (
     RPC_INVALID_ADDRESS_OR_KEY,
     RPC_INVALID_PARAMETER,
     RPC_MISC_ERROR,
+    RPC_TYPE_ERROR,
     RPCError,
     require_params,
     rpc_method,
@@ -201,6 +202,33 @@ def importprivkey(node, params):
         raise RPCError(RPC_WALLET_UNLOCK_NEEDED, str(e)) from None
     node._rescan_wallet()
     return None
+
+@rpc_method("signmessage")
+def signmessage(node, params):
+    require_params(params, 2, 2, "signmessage \"address\" \"message\"")
+    from ..wallet.keys import address_to_script
+    from ..wallet.message import sign_message
+    from ..script.script import get_script_ops
+
+    w = _wallet(node)
+    if w.is_locked:
+        raise RPCError(RPC_WALLET_UNLOCK_NEEDED,
+                       "Error: Please enter the wallet passphrase with "
+                       "walletpassphrase first.")
+    spk = address_to_script(params[0], node.params)
+    if spk is None:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Invalid address")
+    try:
+        pkh = list(get_script_ops(spk))[2][1]
+    except Exception:
+        pkh = None
+    if pkh is None or len(pkh) != 20:  # P2SH scripts land here too
+        raise RPCError(RPC_TYPE_ERROR, "Address does not refer to key")
+    key = w.keys_by_pkh.get(pkh)
+    if key is None:
+        raise RPCError(RPC_WALLET_ERROR, "Private key not available")
+    return sign_message(key, str(params[1]))
+
 
 def _tx_log_json(node, w, txid: bytes, entry: dict) -> dict:
     """One listtransactions/gettransaction row (rpcwallet.cpp WalletTxToJSON)."""

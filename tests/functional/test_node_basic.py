@@ -153,13 +153,25 @@ def test_single_node_end_to_end():
         assert "batch" in tpu and "connectblock" in tpu
         assert tpu["connectblock"]["blocks"] >= 102
 
-        # -- clean restart resumes ---------------------------------------
+        # -- clean restart resumes (chain AND mempool) --------------------
+        block2 = node.rpc.getblock(hashes[1], 2)
+        raw3 = _spend_coinbase(node, block2["tx"][0]["txid"],
+                               CKey(0xF00D), 10_0000_0000)
+        persisted_txid = node.rpc.sendrawtransaction(raw3)
+        node.rpc.prioritisetransaction(persisted_txid, 0, 5000)
         tip = node.rpc.getbestblockhash()
         height = node.rpc.getblockcount()
         node.stop()
         node.start(extra=["-txindex", "-listen=0"])
         assert node.rpc.getblockcount() == height
         assert node.rpc.getbestblockhash() == tip
+        # mempool.dat round-trip: the tx is back, with its fee delta
+        assert node.rpc.getrawmempool() == [persisted_txid]
+        entry = node.rpc.getmempoolentry(persisted_txid)
+        assert entry["modifiedfee"] == pytest.approx(entry["fee"] + 5000 / 1e8)
+        node.rpc.generatetoaddress(1, params_addr)  # mine it out
+        assert node.rpc.getrawmempool() == []
+        height += 1
         # chain still extends after restart
         node.rpc.generatetoaddress(1, params_addr)
         assert node.rpc.getblockcount() == height + 1

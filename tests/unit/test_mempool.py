@@ -350,3 +350,117 @@ class TestATMP:
         cs.process_new_block(blk)
         assert cs.tip().hash == blk.get_hash()
         assert pool_tx.txid not in pool  # conflict removed
+
+
+class TestPrioritise:
+    def test_delta_moves_mining_score(self):
+        pool = CTxMemPool()
+        a, b = _root_tx(1), _root_tx(2)
+        pool.add_unchecked(_entry(a, fee=1000))
+        pool.add_unchecked(_entry(b, fee=1000))
+        pool.prioritise(a.txid, 5000)
+        ea, eb = pool.get(a.txid), pool.get(b.txid)
+        assert ea.fee == 6000 and ea.base_fee == 1000
+        assert ea.ancestor_fee_rate() > eb.ancestor_fee_rate()
+        sel = pool.select_for_block(10_000_000, 1, 0)
+        assert sel[0].txid == a.txid
+        # de-prioritise back below b
+        pool.prioritise(a.txid, -7000)
+        assert pool.map_deltas[a.txid] == -2000
+        sel = pool.select_for_block(10_000_000, 1, 0)
+        assert sel[0].txid == b.txid
+
+    def test_delta_propagates_to_relatives(self):
+        pool = CTxMemPool()
+        parent = _root_tx(1)
+        child = _fake_tx([COutPoint(parent.txid, 0)], salt=2)
+        pool.add_unchecked(_entry(parent, fee=1000))
+        pool.add_unchecked(_entry(child, fee=1000))
+        pool.prioritise(child.txid, 4000)
+        assert pool.get(parent.txid).fees_with_descendants == 6000
+        assert pool.get(child.txid).fees_with_ancestors == 6000
+        pool.prioritise(parent.txid, 2000)
+        assert pool.get(child.txid).fees_with_ancestors == 8000
+        assert pool.total_fee == 8000
+        # removal keeps aggregates consistent
+        pool.remove_recursive(child.txid)
+        assert pool.get(parent.txid).fees_with_descendants == 3000
+
+    def test_delta_applies_on_entry(self, node):
+        """mapDeltas set BEFORE the tx arrives boosts it at ATMP time."""
+        cs, pool, sigcache = node
+        op, value = _coinbase_out(cs, 1)
+        tx = _spend(op, value, fee=100)  # below the 1000 sat/kB floor
+        with pytest.raises(MempoolError, match="min-fee"):
+            accept_to_memory_pool(pool, cs, tx, sigcache=sigcache)
+        pool.prioritise(tx.txid, 10_000)
+        entry = accept_to_memory_pool(pool, cs, tx, sigcache=sigcache)
+        assert entry.base_fee == 100 and entry.fee == 10_100
+
+
+class TestMempoolPersist:
+    class _Shim:
+        """Just enough node for load_mempool: pool + ATMP closure."""
+
+        def __init__(self, cs, pool, sigcache):
+            self.mempool = pool
+            self._cs, self._sigcache = cs, sigcache
+
+        def accept_to_mempool(self, tx, now=None):
+            return accept_to_memory_pool(self.mempool, self._cs, tx,
+                                         sigcache=self._sigcache, now=now)
+
+    def test_dump_load_roundtrip(self, node, tmp_path):
+        from bitcoincashplus_tpu.mempool.persist import dump_mempool, load_mempool
+
+        cs, pool, sigcache = node
+        op1, v1 = _coinbase_out(cs, 1)
+        parent = _spend(op1, v1, n_out=2)
+        child = _spend(COutPoint(parent.txid, 0), parent.vout[0].value)
+        accept_to_memory_pool(pool, cs, parent, sigcache=sigcache)
+        accept_to_memory_pool(pool, cs, child, sigcache=sigcache)
+        pool.prioritise(child.txid, 777)
+        pool.map_deltas[b"\xaa" * 32] = 123  # delta for a tx we never saw
+        path = str(tmp_path / "mempool.dat")
+        assert dump_mempool(pool, path) == 2
+
+        pool2 = CTxMemPool()
+        shim = self._Shim(cs, pool2, SignatureCache())
+        accepted, failed, expired = load_mempool(shim, path)
+        assert (accepted, failed, expired) == (2, 0, 0)
+        assert parent.txid in pool2 and child.txid in pool2
+        assert pool2.get(child.txid).fee == pool.get(child.txid).fee
+        assert pool2.map_deltas[b"\xaa" * 32] == 123
+        assert pool2.get(child.txid).base_fee + 777 == pool2.get(child.txid).fee
+
+    def test_expired_entries_skipped(self, node, tmp_path):
+        from bitcoincashplus_tpu.mempool.persist import dump_mempool, load_mempool
+
+        cs, pool, sigcache = node
+        op, value = _coinbase_out(cs, 1)
+        tx = _spend(op, value)
+        accept_to_memory_pool(pool, cs, tx, sigcache=sigcache, now=1000)
+        path = str(tmp_path / "mempool.dat")
+        dump_mempool(pool, path)
+        pool2 = CTxMemPool()
+        shim = self._Shim(cs, pool2, SignatureCache())
+        accepted, failed, expired = load_mempool(
+            shim, path, now=1000 + pool2.expiry_seconds + 1)
+        assert (accepted, expired) == (0, 1)
+
+    def test_corrupt_file_survives(self, node, tmp_path):
+        from bitcoincashplus_tpu.mempool.persist import load_mempool
+
+        cs, pool, sigcache = node
+        path = str(tmp_path / "mempool.dat")
+        with open(path, "wb") as f:
+            f.write(b"\x01\x00\x00\x00\x00\x00\x00\x00\xff\xff")
+        shim = self._Shim(cs, CTxMemPool(), SignatureCache())
+        load_mempool(shim, path)  # must not raise
+
+    def test_missing_file_noop(self, node, tmp_path):
+        from bitcoincashplus_tpu.mempool.persist import load_mempool
+
+        cs, pool, sigcache = node
+        shim = self._Shim(cs, CTxMemPool(), SignatureCache())
+        assert load_mempool(shim, str(tmp_path / "nope.dat")) == (0, 0, 0)
