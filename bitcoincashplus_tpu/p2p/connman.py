@@ -26,10 +26,16 @@ from ..mempool.mempool import MempoolError
 from ..util.log import log_print, log_printf
 from ..validation.chain import BlockStatus
 from ..validation.chainstate import BlockValidationError
+from .bloom import (
+    MAX_BLOOM_FILTER_SIZE,
+    CBloomFilter,
+    deser_filterload,
+)
 from .protocol import (
     HEADER_SIZE,
     MAX_HEADERS_RESULTS,
     MSG_BLOCK,
+    MSG_FILTERED_BLOCK,
     MSG_TX,
     MessageHeader,
     NetMessageError,
@@ -68,6 +74,12 @@ class Peer:
         self.version: Optional[VersionPayload] = None
         self.got_verack = False
         self.prefers_headers = False  # BIP130 sendheaders
+        # BIP37 SPV state: None = no filter (relay per relay_txs);
+        # set by filterload, updated by matches per nFlags
+        self.bloom_filter: Optional[CBloomFilter] = None
+        # fRelayTxes: seeded from the version message's relay byte;
+        # filterload/filterclear force it back on (BIP37 semantics)
+        self.relay_txs = True
         self.known_invs: set[bytes] = set()
         self.connected_at = time.time()
         self.last_recv = 0.0
@@ -328,6 +340,7 @@ class CConnman:
         if version.nonce == self._nonce:
             raise NetMessageError("connected to self")
         peer.version = version
+        peer.relay_txs = version.relay
         if not peer.outbound:
             peer.send("version", self._version_payload().serialize())
         peer.send("verack")
@@ -443,6 +456,29 @@ class CConnman:
                 if raw is not None:
                     peer.send("block", raw)
                     await peer.writer.drain()
+            elif inv_type == MSG_FILTERED_BLOCK:
+                # BIP37: merkleblock + the matched txs (net_processing.cpp
+                # ProcessGetData MSG_FILTERED_BLOCK branch). No filter
+                # loaded → ignore the request, like the reference.
+                if peer.bloom_filter is None:
+                    continue
+                with self.node.cs_main:
+                    raw = self.node.block_store.get_block(h)
+                    if raw is None:
+                        continue
+                    block = CBlock.from_bytes(raw)
+                    from ..consensus.merkleblock import CMerkleBlock
+
+                    mb = CMerkleBlock.from_block(block, peer.bloom_filter)
+                peer.send("merkleblock", mb.serialize())
+                # always follow with the matched txs: once mined they are
+                # gone from the mempool, so a skipped send here would be
+                # the peer's last chance to ever obtain them
+                matched = set(mb.matched_txids)
+                for tx in block.vtx:
+                    if tx.txid in matched:
+                        peer.send("tx", tx.serialize())
+                await peer.writer.drain()
             elif inv_type == MSG_TX:
                 with self.node.cs_main:
                     tx = self.node.mempool.get_tx(h)
@@ -504,11 +540,49 @@ class CConnman:
             self._accept_tx(peer, o)
 
     def _msg_mempool(self, peer: Peer, payload: bytes) -> None:
-        """BIP35 'mempool': answer with an inv of current mempool txids."""
+        """BIP35 'mempool': answer with an inv of current mempool txids
+        (bloom-filtered when the peer loaded one, like the reference)."""
         with self.node.cs_main:
-            txids = list(self.node.mempool.entries)
+            if peer.bloom_filter is not None:
+                txids = [
+                    txid for txid, e in self.node.mempool.entries.items()
+                    if peer.bloom_filter.is_relevant_and_update(e.tx)
+                ]
+            else:
+                txids = list(self.node.mempool.entries)
         if txids:
             peer.send("inv", ser_inv([(MSG_TX, h) for h in txids[:50_000]]))
+
+    # -- BIP37 bloom filtering (net_processing.cpp FILTERLOAD/ADD/CLEAR) --
+
+    def _msg_filterload(self, peer: Peer, payload: bytes) -> None:
+        try:
+            f = deser_filterload(payload)
+        except Exception:
+            raise NetMessageError("bad filterload") from None
+        if not f.is_within_size_constraints():
+            raise NetMessageError("oversized bloom filter")
+        peer.bloom_filter = f
+        peer.relay_txs = True
+
+    def _msg_filteradd(self, peer: Peer, payload: bytes) -> None:
+        from ..consensus.serialize import ByteReader, deser_compact_size
+
+        try:
+            r = ByteReader(payload)
+            n = deser_compact_size(r)
+            data = r.read_bytes(n)
+        except Exception:
+            raise NetMessageError("bad filteradd") from None
+        # MAX_SCRIPT_ELEMENT_SIZE bound, and adding without a loaded filter
+        # is misbehavior (net_processing.cpp)
+        if len(data) > 520 or peer.bloom_filter is None:
+            raise NetMessageError("filteradd without filter or oversized")
+        peer.bloom_filter.insert(data)
+
+    def _msg_filterclear(self, peer: Peer, payload: bytes) -> None:
+        peer.bloom_filter = None
+        peer.relay_txs = True  # "relay all transactions" per BIP37
 
     # -- relay ----------------------------------------------------------
 
@@ -533,11 +607,27 @@ class CConnman:
             self.loop.call_soon_threadsafe(_announce)
 
     def _broadcast_inv(self, inv_type: int, h: bytes, skip_peer: int = 0) -> None:
+        # tx relay honors BIP37: a peer with a loaded bloom filter only
+        # hears about relevant txs; version.relay=False without a filter
+        # suppresses tx invs entirely (net_processing.cpp SendMessages)
+        tx = None
+        if inv_type == MSG_TX:
+            with self.node.cs_main:
+                tx = self.node.mempool.get_tx(h)
+
+        def _want(peer: Peer) -> bool:
+            if inv_type != MSG_TX:
+                return True
+            if peer.bloom_filter is not None:
+                return tx is not None and \
+                    peer.bloom_filter.is_relevant_and_update(tx)
+            return peer.relay_txs
+
         def _do():
             for peer in self.peers.values():
                 if peer.id == skip_peer or not peer.handshaked:
                     continue
-                if h in peer.known_invs:
+                if h in peer.known_invs or not _want(peer):
                     continue
                 peer.known_invs.add(h)
                 try:

@@ -28,6 +28,7 @@ MAX_LOCATOR_SZ = 101
 # CInv types (src/protocol.h)
 MSG_TX = 1
 MSG_BLOCK = 2
+MSG_FILTERED_BLOCK = 3  # BIP37: getdata answered with merkleblock
 
 HEADER_SIZE = 24
 

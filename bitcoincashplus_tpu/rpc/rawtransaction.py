@@ -284,3 +284,80 @@ def signrawtransaction(node, params):
     if errors:
         out["errors"] = errors
     return out
+
+
+@rpc_method("gettxoutproof")
+def gettxoutproof(node, params):
+    """gettxoutproof ["txid",...] ( "blockhash" ) — hex-serialized
+    CMerkleBlock proving the txids' inclusion (rpc/rawtransaction.cpp)."""
+    require_params(params, 1, 2, "gettxoutproof [\"txid\",...] ( \"blockhash\" )")
+    from ..consensus.merkleblock import CMerkleBlock
+
+    if not isinstance(params[0], list) or not params[0]:
+        raise RPCError(RPC_INVALID_PARAMETER, "Parameter 1 must be a non-empty array")
+    txids = set()
+    for t in params[0]:
+        try:
+            h = hex_to_hash(t)
+        except Exception:
+            raise RPCError(RPC_INVALID_PARAMETER,
+                           f"Invalid txid: {t!r}") from None
+        if h in txids:
+            raise RPCError(RPC_INVALID_PARAMETER, f"Duplicated txid: {t}")
+        txids.add(h)
+
+    block_hash = None
+    if len(params) > 1:
+        block_hash = param_hash(params, 1)
+        if node.chainstate.block_index.get(block_hash) is None:
+            raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Block not found")
+    else:
+        # locate via txindex (or the UTXO set for an unspent output)
+        any_txid = next(iter(txids))
+        if node.txindex:
+            block_hash = node.txindex_lookup(any_txid)
+        if block_hash is None:
+            from ..consensus.tx import COutPoint
+
+            for n in range(64):
+                coin = node.chainstate.coins.get_coin(COutPoint(any_txid, n))
+                if coin is not None and coin.height >= 0:
+                    idx = node.chainstate.chain[coin.height]
+                    if idx is not None:
+                        block_hash = idx.hash
+                    break
+        if block_hash is None:
+            raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                           "Transaction not yet in block")
+    block = node.chainstate.get_block(block_hash)
+    if block is None:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Block not available")
+    in_block = {tx.txid for tx in block.vtx}
+    if not txids <= in_block:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                       "Not all transactions found in specified or retrieved block")
+    return CMerkleBlock.from_block(block, txid_set=txids).serialize().hex()
+
+
+@rpc_method("verifytxoutproof")
+def verifytxoutproof(node, params):
+    """verifytxoutproof "proof" — txids the proof commits to, [] if the
+    proven block is not in the active chain, error if malformed."""
+    require_params(params, 1, 1, "verifytxoutproof \"proof\"")
+    from ..consensus.merkleblock import CMerkleBlock
+
+    try:
+        mb = CMerkleBlock.from_bytes(bytes.fromhex(params[0]))
+    except Exception:
+        raise RPCError(RPC_DESERIALIZATION_ERROR, "Bad proof") from None
+    got = mb.pmt.extract_matches()
+    if got is None:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Invalid proof")
+    root, matches = got
+    if root != mb.header.hash_merkle_root:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                       "Merkle root in proof does not match block header")
+    idx = node.chainstate.block_index.get(mb.header.get_hash())
+    if idx is None or node.chainstate.chain[idx.height] is not idx:
+        return []  # proof is internally valid but block isn't in our chain
+    return [hash_to_hex(txid) for _pos, txid in matches]

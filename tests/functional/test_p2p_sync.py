@@ -235,3 +235,78 @@ def test_orphan_tx_parking_and_mempool_msg():
                         got.add(h)
         assert got == {parent.txid, child.txid}
         s.close()
+
+
+def test_bip37_spv_flow():
+    """SPV fake peer: filterload → mine a block paying the watched key →
+    getdata(MSG_FILTERED_BLOCK) returns merkleblock + matched tx; the
+    proof verifies against the header; gettxoutproof/verifytxoutproof
+    round-trips the same proof over RPC."""
+    from bitcoincashplus_tpu.consensus.block import CBlockHeader
+    from bitcoincashplus_tpu.consensus.merkleblock import CMerkleBlock
+    from bitcoincashplus_tpu.consensus.serialize import ByteReader, hash_to_hex, hex_to_hash
+    from bitcoincashplus_tpu.consensus.tx import CTransaction
+    from bitcoincashplus_tpu.p2p.bloom import (
+        BLOOM_UPDATE_ALL,
+        CBloomFilter,
+        ser_filterload,
+    )
+    from bitcoincashplus_tpu.p2p.protocol import MSG_FILTERED_BLOCK, ser_inv
+
+    with FunctionalFramework(num_nodes=1, extra_args=[["-txindex"]]) as f:
+        node = f.nodes[0]
+        magic = regtest_params().netmagic
+        node.rpc.generatetoaddress(101, node.rpc.getnewaddress())
+
+        # wallet pays a watched key
+        watched = CKey(0x511511)
+        waddr = watched.p2pkh_address(regtest_params())
+        txid_hex = node.rpc.sendtoaddress(waddr, 1.0)
+        block_hash = node.rpc.generatetoaddress(1, ADDR)[0]
+
+        # -- SPV peer connects, loads a filter on the watched pubkey hash --
+        s = socket.create_connection(("127.0.0.1", node.p2p_port), timeout=10)
+        s.sendall(pack_message(magic, "version", VersionPayload().serialize()))
+        _read_msg(s)  # version
+        _read_msg(s)  # verack
+        s.sendall(pack_message(magic, "verack"))
+        f37 = CBloomFilter(5, 0.000001, 0, BLOOM_UPDATE_ALL)
+        f37.insert(watched.pubkey_hash)
+        s.sendall(pack_message(magic, "filterload", ser_filterload(f37)))
+        s.sendall(pack_message(magic, "getdata", ser_inv(
+            [(MSG_FILTERED_BLOCK, hex_to_hash(block_hash))]
+        )))
+        # responses: skip handshake chatter until merkleblock arrives
+        deadline = time.time() + 20
+        merkleblock = None
+        txs = []
+        while time.time() < deadline:
+            header, payload = _read_msg(s)
+            cmd = header[4:16].rstrip(b"\x00").decode()
+            if cmd == "merkleblock":
+                merkleblock = payload
+            elif cmd == "tx" and merkleblock is not None:
+                txs.append(payload)
+                break
+        s.close()
+        assert merkleblock is not None, "no merkleblock received"
+        mb = CMerkleBlock.from_bytes(merkleblock)
+        root, matches = mb.pmt.extract_matches()
+        assert root == mb.header.hash_merkle_root
+        assert hash_to_hex(mb.header.get_hash()) == block_hash
+        matched_txids = [t for _p, t in matches]
+        assert hex_to_hash(txid_hex) in matched_txids
+        assert any(CTransaction.from_bytes(t).txid == hex_to_hash(txid_hex)
+                   for t in txs)
+
+        # -- RPC proof round-trip ---------------------------------------
+        proof = node.rpc.gettxoutproof([txid_hex])
+        assert node.rpc.verifytxoutproof(proof) == [txid_hex]
+        proof2 = node.rpc.gettxoutproof([txid_hex], block_hash)
+        assert node.rpc.verifytxoutproof(proof2) == [txid_hex]
+        # tampering the proof breaks it
+        bad = bytearray(bytes.fromhex(proof))
+        bad[40] ^= 0x01  # inside the merkle root field of the header
+        from bitcoincashplus_tpu.rpc.client import JSONRPCException
+        with pytest.raises(JSONRPCException):
+            node.rpc.verifytxoutproof(bytes(bad).hex())
