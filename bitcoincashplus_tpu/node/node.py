@@ -217,13 +217,19 @@ class Node:
     def accept_to_mempool(self, tx, now: Optional[int] = None):
         """AcceptToMemoryPool with this node's policy knobs; caller holds
         cs_main (or is single-threaded)."""
-        return accept_to_memory_pool(
+        entry = accept_to_memory_pool(
             self.mempool, self.chainstate, tx,
             sigcache=self.sigcache,
             min_fee_rate=self.min_relay_fee_rate,
             backend="cpu" if self.backend == "cpu" else "auto",
             now=now,
         )
+        # TransactionAddedToMempool (validationinterface): a loaded wallet
+        # tracks unconfirmed receives/spends so it won't double-spend coins
+        # already committed by in-pool txs (e.g. after a mempool.dat reload)
+        if self.wallet is not None:
+            self.wallet.add_tx_if_mine(tx, -1, False)
+        return entry
 
     # -- mining ---------------------------------------------------------
 
@@ -464,6 +470,10 @@ class Node:
             self.wallet.load()
             if self.wallet._pkh_index or self.wallet.keys_by_pubkey:
                 self._rescan_wallet()  # ScanForWalletTransactions
+            # replay the (possibly mempool.dat-reloaded) pool so pending
+            # spends of wallet coins are marked before any CreateTransaction
+            for e in self.mempool.entries.values():
+                self.wallet.add_tx_if_mine(e.tx, -1, False)
             self.chainstate.on_block_connected.append(self.wallet.block_connected)
             self.chainstate.on_block_disconnected.append(self.wallet.block_disconnected)
             # -walletnotify=<cmd>: shell hook per wallet-affecting tx as it

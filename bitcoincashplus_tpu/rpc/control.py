@@ -116,3 +116,21 @@ def gettpuinfo(node, params):
         },
         "connectblock": dict(node.chainstate.bench),
     }
+
+
+@rpc_method("createmultisig")
+def createmultisig(node, params):
+    """createmultisig nrequired ["key",...] — address + redeemScript
+    (src/rpc/misc.cpp). Keys must be hex pubkeys (no wallet lookup)."""
+    require_params(params, 2, 2, "createmultisig nrequired [\"key\",...]")
+    from ..crypto.hashes import hash160
+    from ..script.script import p2sh_script
+    from ..wallet.keys import script_to_address
+    from .wallet import _parse_multisig_params
+
+    m, redeem = _parse_multisig_params(node, None, params)
+    return {
+        "address": script_to_address(p2sh_script(hash160(redeem)),
+                                     node.params),
+        "redeemScript": redeem.hex(),
+    }

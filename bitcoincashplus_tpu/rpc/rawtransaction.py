@@ -361,3 +361,71 @@ def verifytxoutproof(node, params):
     if idx is None or node.chainstate.chain[idx.height] is not idx:
         return []  # proof is internally valid but block isn't in our chain
     return [hash_to_hex(txid) for _pos, txid in matches]
+
+
+@rpc_method("fundrawtransaction")
+def fundrawtransaction(node, params):
+    """fundrawtransaction "hexstring" — add wallet inputs (and change)
+    until the outputs + fee are covered; inputs stay UNSIGNED
+    (src/wallet/rpcwallet.cpp fundrawtransaction)."""
+    require_params(params, 1, 2, "fundrawtransaction \"hexstring\"")
+    from ..consensus.tx import COIN
+    from .wallet import RPC_WALLET_ERROR, _wallet, _wallet_fee
+
+    tx = _parse_tx_hex(params[0])
+    w = _wallet(node)
+    tip = node.chainstate.tip().height
+    fee = _wallet_fee(node)
+    out_value = tx.total_output_value()
+    # value already provided by existing inputs (wallet coins only)
+    in_value = 0
+    for txin in tx.vin:
+        coin = w.coins.get(txin.prevout)
+        if coin is not None:
+            in_value += coin.txout.value
+    need = out_value + fee - in_value
+    selected = []
+    if need > 0:
+        coins = sorted(
+            (c for c in w.available_coins(tip)
+             if w.can_sign(c.txout.script_pubkey)
+             and not any(i.prevout == c.outpoint for i in tx.vin)),
+            key=lambda c: c.txout.value, reverse=True,
+        )
+        got = 0
+        for c in coins:
+            selected.append(c)
+            got += c.txout.value
+            if got >= need:
+                break
+        if got < need:
+            raise RPCError(RPC_WALLET_ERROR, "Insufficient funds")
+        in_value += got
+    change = in_value - out_value - fee
+    vout = list(tx.vout)
+    changepos = -1
+    if change > 546:
+        from ..wallet.wallet import WalletError
+
+        try:
+            change_key = w.derive_new_key()
+        except WalletError as e:
+            from .wallet import RPC_WALLET_UNLOCK_NEEDED
+
+            raise RPCError(RPC_WALLET_UNLOCK_NEEDED, str(e)) from None
+        w.add_key(change_key)
+        changepos = len(vout)
+        vout.append(CTxOut(change, change_key.p2pkh_script()))
+    else:
+        fee += max(change, 0)  # dust change folds into the fee — report it
+    funded = CTransaction(
+        tx.version,
+        tuple(tx.vin) + tuple(CTxIn(c.outpoint) for c in selected),
+        tuple(vout),
+        tx.locktime,
+    )
+    return {
+        "hex": funded.serialize().hex(),
+        "fee": fee / COIN,
+        "changepos": changepos,
+    }

@@ -54,14 +54,15 @@ def listunspent(node, params):
     w = _wallet(node)
     tip = node.chainstate.tip().height
     out = []
-    for coin in w.available_coins(tip):
+    for coin in w.available_coins(tip, include_watch_only=True):
         out.append({
             "txid": hash_to_hex(coin.outpoint.hash),
             "vout": coin.outpoint.n,
             "amount": coin.txout.value / COIN,
             "confirmations": tip - coin.height + 1,
             "scriptPubKey": coin.txout.script_pubkey.hex(),
-            "spendable": not w.is_locked,
+            "spendable": (not w.is_locked
+                          and w.can_sign(coin.txout.script_pubkey)),
         })
     return out
 
@@ -76,7 +77,8 @@ def sendtoaddress(node, params):
     w = _wallet(node)
     try:
         tx = w.create_transaction(
-            address, amount, node.chainstate.tip().height, enable_forkid=True
+            address, amount, node.chainstate.tip().height,
+            fee=_wallet_fee(node), enable_forkid=True,
         )
     except WalletError as e:
         raise RPCError(RPC_WALLET_UNLOCK_NEEDED, str(e)) from None
@@ -105,6 +107,13 @@ def getwalletinfo(node, params):
         info["unlocked_until"] = (
             0 if w.is_locked else int(w.unlocked_until)
         )
+    if w.hd_seed is not None:
+        from ..crypto.hashes import hash160
+        from ..wallet.bip32 import ExtKey
+
+        info["hdmasterkeyid"] = hash160(
+            ExtKey.from_seed(w.hd_seed).pubkey_bytes()
+        ).hex()
     return info
 
 
@@ -338,3 +347,313 @@ def listreceivedbyaddress(node, params):
             "confirmations": minconf,
         })
     return sorted(out, key=lambda r: r["address"])
+
+
+@rpc_method("backupwallet")
+def backupwallet(node, params):
+    require_params(params, 1, 1, "backupwallet \"destination\"")
+    import shutil
+
+    w = _wallet(node)
+    w.save()
+    if not w.path:
+        raise RPCError(RPC_WALLET_ERROR, "wallet has no backing file")
+    try:
+        shutil.copyfile(w.path, str(params[0]))
+    except OSError as e:
+        raise RPCError(RPC_WALLET_ERROR, f"Error: {e}") from None
+    return None
+
+
+@rpc_method("dumpwallet")
+def dumpwallet(node, params):
+    """dumpwallet "filename" — human-readable key dump (rpcdump.cpp):
+    one WIF per line with its hdkeypath; the HD seed leads the file."""
+    require_params(params, 1, 1, "dumpwallet \"filename\"")
+    import time as _t
+
+    w = _wallet(node)
+    if w.is_locked:
+        raise RPCError(RPC_WALLET_UNLOCK_NEEDED,
+                       "Error: Please enter the wallet passphrase with "
+                       "walletpassphrase first.")
+    lines = [
+        "# Wallet dump created by bcpd",
+        f"# * Created on {int(_t.time())}",
+    ]
+    if w.hd_seed is not None:
+        from ..wallet.bip32 import ExtKey
+
+        lines.append("# extended private masterkey: "
+                     + ExtKey.from_seed(w.hd_seed).serialize())
+    for key in w.keys_by_pubkey.values():
+        path = w.key_paths.get(key.pubkey, "")
+        tag = f"hdkeypath={path}" if path else "imported"
+        lines.append(f"{key.to_wif(node.params)} 0 {tag} "
+                     f"# addr={key.p2pkh_address(node.params)}")
+    lines.append("# End of dump")
+    import os as _os
+
+    try:
+        # 0600 like the wallet file itself — this is every private key
+        fd = _os.open(str(params[0]),
+                      _os.O_WRONLY | _os.O_CREAT | _os.O_TRUNC, 0o600)
+        with _os.fdopen(fd, "w") as f:
+            f.write("\n".join(lines) + "\n")
+    except OSError as e:
+        raise RPCError(RPC_WALLET_ERROR, f"Error: {e}") from None
+    return None
+
+
+@rpc_method("importwallet")
+def importwallet(node, params):
+    """importwallet "filename" — re-add every WIF line from a dump."""
+    require_params(params, 1, 1, "importwallet \"filename\"")
+    w = _wallet(node)
+    if w.is_locked:
+        raise RPCError(RPC_WALLET_UNLOCK_NEEDED,
+                       "Error: Please enter the wallet passphrase with "
+                       "walletpassphrase first.")
+    try:
+        with open(str(params[0])) as f:
+            content = f.read()
+    except OSError as e:
+        raise RPCError(RPC_WALLET_ERROR, f"Error: {e}") from None
+    n = 0
+    for line in content.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key = CKey.from_wif(line.split()[0], node.params)
+        if key is not None and key.pubkey not in w.keys_by_pubkey:
+            w.add_key(key, persist=False)
+            n += 1
+    w.save()
+    if n:
+        node._rescan_wallet()
+    return None
+
+
+@rpc_method("keypoolrefill")
+def keypoolrefill(node, params):
+    """keypoolrefill ( newsize ) — keys derive on demand from the HD chain,
+    so the pool never empties while unlocked; kept for parity."""
+    w = _wallet(node)
+    if w.is_locked:
+        raise RPCError(RPC_WALLET_UNLOCK_NEEDED,
+                       "Error: Please enter the wallet passphrase with "
+                       "walletpassphrase first.")
+    return None
+
+
+def _wallet_fee(node) -> int:
+    """Flat per-tx fee: -paytxfee/settxfee rate if set (treated per-kB
+    against the typical ~1 kB wallet tx), else the relay floor."""
+    return max(1000, getattr(node, "paytxfee", 0))
+
+
+@rpc_method("settxfee")
+def settxfee(node, params):
+    require_params(params, 1, 1, "settxfee amount")
+    rate = float(params[0])
+    if rate < 0:
+        raise RPCError(RPC_INVALID_PARAMETER, "amount cannot be negative")
+    node.paytxfee = int(round(rate * COIN))
+    return True
+
+
+@rpc_method("sendmany")
+def sendmany(node, params):
+    """sendmany "" {"address":amount,...} — one tx, many outputs."""
+    require_params(params, 2, 4,
+                   "sendmany \"account\" {\"address\":amount,...}")
+    from ..wallet.keys import address_to_script
+
+    if not isinstance(params[1], dict) or not params[1]:
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "Parameter 2 must be a non-empty object")
+    outputs = []
+    for addr, amt in params[1].items():
+        spk = address_to_script(addr, node.params)
+        if spk is None:
+            raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                           f"Invalid address: {addr}")
+        value = int(round(float(amt) * COIN))
+        if value <= 0:
+            raise RPCError(RPC_INVALID_PARAMETER, "Invalid amount for send")
+        outputs.append((spk, value))
+    w = _wallet(node)
+    try:
+        tx = w.create_transaction_multi(
+            outputs, node.chainstate.tip().height,
+            fee=_wallet_fee(node), enable_forkid=True,
+        )
+    except WalletError as e:
+        raise RPCError(RPC_WALLET_UNLOCK_NEEDED, str(e)) from None
+    except ValueError as e:
+        raise RPCError(RPC_WALLET_ERROR, str(e)) from None
+    try:
+        node.accept_to_mempool(tx)
+    except MempoolError as e:
+        raise RPCError(RPC_WALLET_ERROR, f"transaction rejected: {e}") from None
+    if node.connman is not None:
+        node.connman.relay_tx(tx.txid)
+    return tx.txid_hex
+
+
+@rpc_method("lockunspent")
+def lockunspent(node, params):
+    """lockunspent unlock ([{"txid":..,"vout":..},...]) — true unlocks."""
+    require_params(params, 1, 2, "lockunspent unlock ( [{\"txid\":...}] )")
+    from ..consensus.serialize import hex_to_hash
+    from ..consensus.tx import COutPoint
+
+    unlock = bool(params[0])
+    w = _wallet(node)
+    if len(params) < 2 or not params[1]:
+        if unlock:
+            w.locked_coins.clear()  # unlock-all form
+            return True
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "Invalid parameter, expected locked outputs")
+    for item in params[1]:
+        try:
+            op = COutPoint(hex_to_hash(item["txid"]), int(item["vout"]))
+        except Exception:
+            raise RPCError(RPC_INVALID_PARAMETER,
+                           "Invalid parameter, invalid outpoint") from None
+        if unlock:
+            w.locked_coins.discard(op)
+        else:
+            w.locked_coins.add(op)
+    return True
+
+
+@rpc_method("listlockunspent")
+def listlockunspent(node, params):
+    w = _wallet(node)
+    return [
+        {"txid": hash_to_hex(op.hash), "vout": op.n}
+        for op in sorted(w.locked_coins, key=lambda o: (o.hash, o.n))
+    ]
+
+
+@rpc_method("listsinceblock")
+def listsinceblock(node, params):
+    """listsinceblock ( "blockhash" ) — wallet txs at heights above the
+    given block (or all), plus the lastblock cursor."""
+    from ..consensus.serialize import hex_to_hash
+
+    w = _wallet(node)
+    since_height = -1
+    if params and params[0]:
+        idx = node.chainstate.block_index.get(hex_to_hash(params[0]))
+        if idx is None:
+            raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Block not found")
+        since_height = idx.height
+    txs = []
+    for txid, entry in w.tx_log.items():
+        if entry.get("abandoned"):
+            continue
+        if entry["height"] < 0 or entry["height"] > since_height:
+            txs.append(_tx_log_json(node, w, txid, entry))
+    return {
+        "transactions": txs,
+        "lastblock": hash_to_hex(node.chainstate.tip().hash),
+    }
+
+
+@rpc_method("abandontransaction")
+def abandontransaction(node, params):
+    require_params(params, 1, 1, "abandontransaction \"txid\"")
+    from ..consensus.serialize import hex_to_hash
+
+    txid = hex_to_hash(params[0])
+    if txid in node.mempool:
+        raise RPCError(RPC_MISC_ERROR,
+                       "Transaction not eligible for abandonment")
+    w = _wallet(node)
+    if txid not in w.tx_log:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                       "Invalid or non-wallet transaction id")
+    try:
+        w.abandon_transaction(txid)
+    except WalletError:
+        raise RPCError(RPC_MISC_ERROR,
+                       "Transaction not eligible for abandonment") from None
+    return None
+
+
+@rpc_method("addmultisigaddress")
+def addmultisigaddress(node, params):
+    """addmultisigaddress nrequired ["key",...] — watch the P2SH script."""
+    require_params(params, 2, 3,
+                   "addmultisigaddress nrequired [\"key\",...]")
+    from ..crypto.hashes import hash160
+    from ..script.script import p2sh_script
+    from ..wallet.keys import script_to_address
+
+    w = _wallet(node)
+    m, redeem = _parse_multisig_params(node, w, params)
+    spk = p2sh_script(hash160(redeem))
+    w.watched_scripts.add(spk)
+    w.save()
+    return script_to_address(spk, node.params)
+
+
+def _parse_multisig_params(node, wallet, params):
+    """Shared createmultisig/addmultisigaddress validation → (m, redeem)."""
+    from ..script.script import multisig_script
+
+    m = int(params[0])
+    keys_param = params[1]
+    if not isinstance(keys_param, list) or not keys_param:
+        raise RPCError(RPC_INVALID_PARAMETER, "keys must be a non-empty array")
+    if m < 1:
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "a multisignature address must require at least one key")
+    if m > len(keys_param):
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "not enough keys supplied (got %d, need %d)"
+                       % (len(keys_param), m))
+    from ..script.script import MAX_PUBKEYS_PER_MULTISIG
+
+    if len(keys_param) > MAX_PUBKEYS_PER_MULTISIG:
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "Number of addresses involved in the multisignature "
+                       f"address creation > {MAX_PUBKEYS_PER_MULTISIG}")
+    pubkeys = []
+    for item in keys_param:
+        item = str(item)
+        pk = None
+        if len(item) in (66, 130):
+            try:
+                pk = bytes.fromhex(item)
+            except ValueError:
+                pk = None
+        if pk is None and wallet is not None:
+            # address form: look up the wallet key
+            from ..wallet.keys import address_to_script
+            from ..script.script import get_script_ops
+
+            spk = address_to_script(item, node.params)
+            if spk is not None:
+                try:
+                    pkh = list(get_script_ops(spk))[2][1]
+                    key = wallet.keys_by_pkh.get(pkh)
+                    if key is not None:
+                        pk = key.pubkey
+                    elif pkh in wallet._pkh_index:
+                        pk = wallet._pkh_index[pkh]
+                except Exception:
+                    pk = None
+        if pk is None:
+            raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                           f"Invalid public key or address: {item}")
+        from ..crypto.secp256k1 import pubkey_parse
+
+        if pubkey_parse(pk) is None:
+            raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                           f"Invalid public key: {item}")
+        pubkeys.append(pk)
+    return m, multisig_script(m, pubkeys)

@@ -56,6 +56,10 @@ class Wallet:
         self.keys_by_pubkey: dict[bytes, CKey] = {}
         self.coins: dict[COutPoint, WalletCoin] = {}
         self.spent: set[COutPoint] = set()
+        # lockunspent: outpoints excluded from coin selection (setLockedCoins)
+        self.locked_coins: set[COutPoint] = set()
+        # addmultisigaddress/importaddress watch-only scripts (CScript set)
+        self.watched_scripts: set[bytes] = set()
         # CCryptoKeyStore state: pubkey -> (ciphertext, compressed). The
         # pkh index survives Lock so IsMine keeps answering while locked.
         self.master_key_record: Optional[MasterKey] = None
@@ -66,6 +70,13 @@ class Wallet:
         # mapWallet analogue: txid -> {height, received, sent, is_coinbase}
         # insertion-ordered (dict) = wallet tx history for listtransactions
         self.tx_log: dict[bytes, dict] = {}
+        # HD chain (CHDChain, 0.13+ wallets): new keys derive from the
+        # seed at m/0'/0'/i' (DeriveNewChildKey). None = legacy random
+        # keys (e.g. a pre-HD wallet file).
+        self.hd_seed: Optional[bytes] = None
+        self.encrypted_hd_seed: Optional[bytes] = None
+        self.hd_counter = 0
+        self.key_paths: dict[bytes, str] = {}  # pubkey -> hdkeypath
 
     # -- encryption (CCryptoKeyStore) --
 
@@ -88,6 +99,10 @@ class Wallet:
         for pubkey, key in self.keys_by_pubkey.items():
             ct = encrypt_secret(master, key.secret.to_bytes(32, "big"), pubkey)
             self.encrypted_keys[pubkey] = (ct, key.compressed)
+        if self.hd_seed is not None:
+            self.encrypted_hd_seed = encrypt_secret(
+                master, self.hd_seed, self._HD_SEED_TAG)
+            self.hd_seed = None
         self.master_key_record = record
         self.lock()
         self.save()
@@ -99,6 +114,7 @@ class Wallet:
         self.unlocked_until = 0.0
         self.keys_by_pkh.clear()
         self.keys_by_pubkey.clear()
+        self.hd_seed = None  # plaintext seed never survives a Lock
 
     def unlock(self, passphrase: str, timeout: float = 0) -> bool:
         """Unlock: False on wrong passphrase. timeout 0 = until lock()."""
@@ -119,6 +135,12 @@ class Wallet:
         for key in restored:
             self.keys_by_pkh[key.pubkey_hash] = key
             self.keys_by_pubkey[key.pubkey] = key
+        if self.encrypted_hd_seed is not None:
+            seed = decrypt_secret(master, self.encrypted_hd_seed,
+                                  self._HD_SEED_TAG)
+            if seed is None:
+                return False
+            self.hd_seed = seed
         self._master = master
         self.unlocked_until = time.time() + timeout if timeout else 0.0
         return True
@@ -145,6 +167,13 @@ class Wallet:
             new_encrypted[pubkey] = (
                 encrypt_secret(fresh, sec, pubkey), compressed
             )
+        if self.encrypted_hd_seed is not None:
+            seed = decrypt_secret(master, self.encrypted_hd_seed,
+                                  self._HD_SEED_TAG)
+            if seed is None:
+                return False
+            self.encrypted_hd_seed = encrypt_secret(fresh, seed,
+                                                    self._HD_SEED_TAG)
         self.encrypted_keys = new_encrypted
         self.master_key_record = record
         if self._master is not None:
@@ -169,8 +198,36 @@ class Wallet:
         if persist:
             self.save()
 
+    # IV tag for sealing the HD seed (it has no pubkey of its own)
+    _HD_SEED_TAG = b"\x04hdseed" * 4
+
+    def derive_new_key(self) -> CKey:
+        """CWallet::DeriveNewChildKey — next key at m/0'/0'/i' from the HD
+        seed; falls back to a random key for legacy (pre-HD) wallets."""
+        if self.is_locked:
+            raise WalletError("cannot derive keys from a locked wallet")
+        if self.hd_seed is None:
+            if self.is_crypted or self.keys_by_pubkey or self._pkh_index:
+                # legacy wallet (had keys before HD existed): stay random
+                return CKey.generate()
+            self.hd_seed = os.urandom(32)
+        from .bip32 import ExtKey
+
+        master = ExtKey.from_seed(self.hd_seed)
+        account = master.derive_path("m/0'/0'")
+        while True:
+            i = self.hd_counter
+            self.hd_counter += 1
+            try:
+                node = account.derive(i | 0x80000000)
+            except ValueError:
+                continue  # invalid index (~2^-127): skip, like the reference
+            key = CKey(node.secret)
+            self.key_paths[key.pubkey] = f"m/0'/0'/{i}'"
+            return key
+
     def get_new_address(self) -> str:
-        key = CKey.generate()
+        key = self.derive_new_key()
         self.add_key(key)
         return key.p2pkh_address(self.params)
 
@@ -181,21 +238,33 @@ class Wallet:
             return
         if self.is_crypted:
             payload = {
-                "version": 1,
+                "version": 2,
                 "master_key": self.master_key_record.to_dict(),
                 "encrypted_keys": [
                     {"pubkey": pk.hex(), "ct": ct.hex(), "compressed": comp}
                     for pk, (ct, comp) in self.encrypted_keys.items()
                 ],
             }
+            if self.encrypted_hd_seed is not None:
+                payload["hd_seed_ct"] = self.encrypted_hd_seed.hex()
         else:
             payload = {
-                "version": 1,
+                "version": 2,
                 "keys": [
                     {"wif": k.to_wif(self.params)}
                     for k in self.keys_by_pubkey.values()
                 ],
             }
+            if self.hd_seed is not None:
+                payload["hd_seed"] = self.hd_seed.hex()
+        payload["hd_counter"] = self.hd_counter
+        payload["key_paths"] = {
+            pk.hex(): path for pk, path in self.key_paths.items()
+        }
+        if self.watched_scripts:
+            payload["watched_scripts"] = [
+                s.hex() for s in self.watched_scripts
+            ]
         tmp = self.path + ".tmp"
         # 0600: the plaintext form carries WIF keys (same treatment as the
         # RPC .cookie); encrypted form too — no reason to leak either
@@ -224,6 +293,18 @@ class Wallet:
                 key = CKey.from_wif(rec["wif"], self.params)
                 if key is not None:
                     self.add_key(key, persist=False)
+            if "hd_seed" in payload:
+                self.hd_seed = bytes.fromhex(payload["hd_seed"])
+        if "hd_seed_ct" in payload:
+            self.encrypted_hd_seed = bytes.fromhex(payload["hd_seed_ct"])
+        self.hd_counter = payload.get("hd_counter", 0)
+        self.key_paths = {
+            bytes.fromhex(pk): path
+            for pk, path in payload.get("key_paths", {}).items()
+        }
+        self.watched_scripts = {
+            bytes.fromhex(s) for s in payload.get("watched_scripts", [])
+        }
 
     def key_for_id(self, ident: bytes) -> Optional[CKey]:
         """Solver callback: 20-byte pubkey hash or raw pubkey."""
@@ -235,6 +316,8 @@ class Wallet:
         """IsMine (src/script/ismine.cpp) for the templates we hold keys to.
         Answers from the lock-surviving indexes so a locked wallet still
         tracks its coins (CCryptoKeyStore::HaveKey semantics)."""
+        if script_pubkey in self.watched_scripts:
+            return True
         kind = classify_script(script_pubkey)
         try:
             if kind == "pubkeyhash":
@@ -287,34 +370,89 @@ class Wallet:
         if sent or received:
             # AddToWallet: record/refresh the history entry (a mempool tx
             # re-entering via a block keeps one entry, height updated)
-            self.tx_log[txid] = {
+            entry = {
                 "height": height,
                 "received": received,
                 "sent": sent,
                 "is_coinbase": is_coinbase,
             }
+            if height < 0:
+                # keep the raw tx while unconfirmed (mapWallet holds the
+                # CWalletTx); needed by abandontransaction
+                entry["tx"] = tx
+            self.tx_log[txid] = entry
+
+    def abandon_transaction(self, txid: bytes) -> None:
+        """AbandonTransaction (wallet.cpp): free the inputs of an
+        unconfirmed wallet tx and forget its outputs so the coins become
+        spendable again. Caller ensures the tx is not in mempool/chain."""
+        entry = self.tx_log.get(txid)
+        if entry is None or entry["height"] >= 0 or "tx" not in entry:
+            raise WalletError("transaction is confirmed or not in wallet")
+        tx = entry["tx"]
+        for txin in tx.vin:
+            self.spent.discard(txin.prevout)
+        for i in range(len(tx.vout)):
+            self.coins.pop(COutPoint(txid, i), None)
+        entry["abandoned"] = True
 
     # -- balance / spend --
 
-    def available_coins(self, tip_height: int) -> list[WalletCoin]:
-        """AvailableCoins: unspent, mature."""
+    def available_coins(self, tip_height: int,
+                        include_watch_only: bool = False) -> list[WalletCoin]:
+        """AvailableCoins: unspent, mature, spendable (watch-only coins —
+        e.g. addmultisigaddress scripts — only with include_watch_only,
+        mirroring the reference's fIncludeWatching split)."""
         maturity = self.params.consensus.coinbase_maturity
         out = []
         for op, coin in self.coins.items():
-            if op in self.spent:
+            if op in self.spent or op in self.locked_coins:
                 continue
             if coin.is_coinbase and tip_height - coin.height + 1 < maturity:
+                continue
+            if not include_watch_only and not self.can_sign(
+                    coin.txout.script_pubkey):
                 continue
             out.append(coin)
         return out
 
     def balance(self, tip_height: int) -> int:
+        """getbalance: spendable funds only (watch-only excluded)."""
         return sum(c.txout.value for c in self.available_coins(tip_height))
+
+    def can_sign(self, script_pubkey: bytes) -> bool:
+        """Do we hold the key for this script (vs merely watching it)?"""
+        kind = classify_script(script_pubkey)
+        try:
+            if kind == "pubkeyhash":
+                pkh = list(get_script_ops(script_pubkey))[2][1]
+                return pkh in self.keys_by_pkh or pkh in self._pkh_index
+            if kind == "pubkey":
+                pk = list(get_script_ops(script_pubkey))[0][1]
+                return pk in self.keys_by_pubkey or pk in self.encrypted_keys
+        except Exception:
+            return False
+        return False
 
     def create_transaction(
         self,
         address: str,
         amount: int,
+        tip_height: int,
+        fee: int = 1000,
+        enable_forkid: bool = False,
+    ) -> CTransaction:
+        script_pubkey = address_to_script(address, self.params)
+        if script_pubkey is None:
+            raise ValueError(f"bad address {address}")
+        return self.create_transaction_multi(
+            [(script_pubkey, amount)], tip_height, fee=fee,
+            enable_forkid=enable_forkid,
+        )
+
+    def create_transaction_multi(
+        self,
+        outputs: list[tuple[bytes, int]],
         tip_height: int,
         fee: int = 1000,
         enable_forkid: bool = False,
@@ -325,9 +463,7 @@ class Wallet:
             raise WalletError(
                 "wallet is locked; unlock with walletpassphrase first"
             )
-        script_pubkey = address_to_script(address, self.params)
-        if script_pubkey is None:
-            raise ValueError(f"bad address {address}")
+        amount = sum(v for _s, v in outputs)
         coins = sorted(
             self.available_coins(tip_height),
             key=lambda c: c.txout.value, reverse=True,
@@ -341,10 +477,10 @@ class Wallet:
         if total < amount + fee:
             raise ValueError(f"insufficient funds: {total} < {amount + fee}")
 
-        vout = [CTxOut(amount, script_pubkey)]
+        vout = [CTxOut(v, s) for s, v in outputs]
         change = total - amount - fee
         if change > 546:  # dust threshold (policy)
-            change_key = CKey.generate()
+            change_key = self.derive_new_key()
             self.add_key(change_key)
             vout.append(CTxOut(change, change_key.p2pkh_script()))
 

@@ -1,0 +1,94 @@
+"""BIP32 derivation — the BIP's published test vectors 1 and 2 plus
+CKDpub/CKDpriv consistency properties (src/test/bip32_tests.cpp)."""
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from bitcoincashplus_tpu.wallet.bip32 import HARDENED, ExtKey
+
+# BIP32 test vector 1 (seed 000102030405060708090a0b0c0d0e0f)
+TV1 = [
+    ("m",
+     "xprv9s21ZrQH143K3QTDL4LXw2F7HEK3wJUD2nW2nRk4stbPy6cq3jPPqjiChkVvvNKmPGJxWUtg6LnF5kejMRNNU3TGtRBeJgk33yuGBxrMPHi",
+     "xpub661MyMwAqRbcFtXgS5sYJABqqG9YLmC4Q1Rdap9gSE8NqtwybGhePY2gZ29ESFjqJoCu1Rupje8YtGqsefD265TMg7usUDFdp6W1EGMcet8"),
+    ("m/0'",
+     "xprv9uHRZZhk6KAJC1avXpDAp4MDc3sQKNxDiPvvkX8Br5ngLNv1TxvUxt4cV1rGL5hj6KCesnDYUhd7oWgT11eZG7XnxHrnYeSvkzY7d2bhkJ7",
+     "xpub68Gmy5EdvgibQVfPdqkBBCHxA5htiqg55crXYuXoQRKfDBFA1WEjWgP6LHhwBZeNK1VTsfTFUHCdrfp1bgwQ9xv5ski8PX9rL2dZXvgGDnw"),
+    ("m/0'/1",
+     "xprv9wTYmMFdV23N2TdNG573QoEsfRrWKQgWeibmLntzniatZvR9BmLnvSxqu53Kw1UmYPxLgboyZQaXwTCg8MSY3H2EU4pWcQDnRnrVA1xe8fs",
+     "xpub6ASuArnXKPbfEwhqN6e3mwBcDTgzisQN1wXN9BJcM47sSikHjJf3UFHKkNAWbWMiGj7Wf5uMash7SyYq527Hqck2AxYysAA7xmALppuCkwQ"),
+    ("m/0'/1/2'",
+     "xprv9z4pot5VBttmtdRTWfWQmoH1taj2axGVzFqSb8C9xaxKymcFzXBDptWmT7FwuEzG3ryjH4ktypQSAewRiNMjANTtpgP4mLTj34bhnZX7UiM",
+     "xpub6D4BDPcP2GT577Vvch3R8wDkScZWzQzMMUm3PWbmWvVJrZwQY4VUNgqFJPMM3No2dFDFGTsxxpG5uJh7n7epu4trkrX7x7DogT5Uv6fcLW5"),
+    ("m/0'/1/2'/2",
+     "xprvA2JDeKCSNNZky6uBCviVfJSKyQ1mDYahRjijr5idH2WwLsEd4Hsb2Tyh8RfQMuPh7f7RtyzTtdrbdqqsunu5Mm3wDvUAKRHSC34sJ7in334",
+     "xpub6FHa3pjLCk84BayeJxFW2SP4XRrFd1JYnxeLeU8EqN3vDfZmbqBqaGJAyiLjTAwm6ZLRQUMv1ZACTj37sR62cfN7fe5JnJ7dh8zL4fiyLHV"),
+    ("m/0'/1/2'/2/1000000000",
+     "xprvA41z7zogVVwxVSgdKUHDy1SKmdb533PjDz7J6N6mV6uS3ze1ai8FHa8kmHScGpWmj4WggLyQjgPie1rFSruoUihUZREPSL39UNdE3BBDu76",
+     "xpub6H1LXWLaKsWFhvm6RVpEL9P4KfRZSW7abD2ttkWP3SSQvnyA8FSVqNTEcYFgJS2UaFcxupHiYkro49S8yGasTvXEYBVPamhGW6cFJodrTHy"),
+]
+
+# BIP32 test vector 2 (the long fffcf9f6... seed)
+TV2_SEED = bytes.fromhex(
+    "fffcf9f6f3f0edeae7e4e1dedbd8d5d2cfccc9c6c3c0bdbab7b4b1aeaba8a5a2"
+    "9f9c999693908d8a8784817e7b7875726f6c696663605d5a5754514e4b484542")
+TV2 = [
+    ("m",
+     "xprv9s21ZrQH143K31xYSDQpPDxsXRTUcvj2iNHm5NUtrGiGG5e2DtALGdso3pGz6ssrdK4PFmM8NSpSBHNqPqm55Qn3LqFtT2emdEXVYsCzC2U",
+     "xpub661MyMwAqRbcFW31YEwpkMuc5THy2PSt5bDMsktWQcFF8syAmRUapSCGu8ED9W6oDMSgv6Zz8idoc4a6mr8BDzTJY47LJhkJ8UB7WEGuduB"),
+    ("m/0",
+     "xprv9vHkqa6EV4sPZHYqZznhT2NPtPCjKuDKGY38FBWLvgaDx45zo9WQRUT3dKYnjwih2yJD9mkrocEZXo1ex8G81dwSM1fwqWpWkeS3v86pgKt",
+     "xpub69H7F5d8KSRgmmdJg2KhpAK8SR3DjMwAdkxj3ZuxV27CprR9LgpeyGmXUbC6wb7ERfvrnKZjXoUmmDznezpbZb7ap6r1D3tgFxHmwMkQTPH"),
+]
+
+
+class TestVectors:
+    def test_vector1(self):
+        seed = bytes.fromhex("000102030405060708090a0b0c0d0e0f")
+        master = ExtKey.from_seed(seed)
+        for path, xprv, xpub in TV1:
+            node = master.derive_path(path)
+            assert node.serialize() == xprv, path
+            assert node.neuter().serialize() == xpub, path
+
+    def test_vector2(self):
+        master = ExtKey.from_seed(TV2_SEED)
+        for path, xprv, xpub in TV2:
+            node = master.derive_path(path)
+            assert node.serialize() == xprv, path
+            assert node.neuter().serialize() == xpub, path
+
+    def test_parse_roundtrip(self):
+        master = ExtKey.from_seed(b"\x07" * 32)
+        node = master.derive_path("m/0'/0'/7'")
+        back = ExtKey.parse(node.serialize())
+        assert back.secret == node.secret
+        assert back.chain_code == node.chain_code
+        assert back.depth == node.depth == 3
+        pub = ExtKey.parse(node.neuter().serialize())
+        assert pub.secret is None and pub.point == node.point
+        assert ExtKey.parse("xprvJunk") is None
+
+
+class TestProperties:
+    @settings(max_examples=15, deadline=None)
+    @given(st.binary(min_size=16, max_size=64), st.integers(0, 2**31 - 1))
+    def test_ckdpub_matches_ckdpriv(self, seed, i):
+        """N(CKDpriv(k, i)) == CKDpub(N(k), i) for non-hardened i."""
+        try:
+            master = ExtKey.from_seed(seed)
+        except ValueError:
+            return
+        via_priv = master.derive(i).neuter()
+        via_pub = master.neuter().derive(i)
+        assert via_priv.pubkey_bytes() == via_pub.pubkey_bytes()
+        assert via_priv.chain_code == via_pub.chain_code
+
+    def test_hardened_from_pub_raises(self):
+        master = ExtKey.from_seed(b"\x01" * 32)
+        pub = master.neuter()
+        try:
+            pub.derive(HARDENED)
+            assert False, "hardened derivation from xpub must fail"
+        except ValueError:
+            pass

@@ -193,3 +193,90 @@ class TestWalletEncryption:
         _time.sleep(0.1)
         wallet.maybe_relock()
         assert wallet.is_locked
+
+
+class TestHDWallet:
+    def test_new_wallet_is_hd_and_deterministic(self, tmp_path):
+        """A fresh wallet derives m/0'/0'/i' keys; reloading the file and
+        deriving again continues the same chain (restart determinism)."""
+        from bitcoincashplus_tpu.wallet.bip32 import ExtKey
+        from bitcoincashplus_tpu.consensus.params import regtest_params
+
+        params = regtest_params()
+        path = str(tmp_path / "wallet.json")
+        w = Wallet(params, path=path)
+        a0 = w.get_new_address()
+        a1 = w.get_new_address()
+        assert w.hd_seed is not None and w.hd_counter == 2
+        # paths recorded
+        paths = set(w.key_paths.values())
+        assert paths == {"m/0'/0'/0'", "m/0'/0'/1'"}
+        # derivation is reproducible from the seed alone
+        master = ExtKey.from_seed(w.hd_seed)
+        k0 = master.derive_path("m/0'/0'/0'")
+        from bitcoincashplus_tpu.wallet.keys import CKey
+
+        assert CKey(k0.secret).p2pkh_address(params) == a0
+
+        # reload: same seed, counter continues, old keys present
+        w2 = Wallet(params, path=path)
+        w2.load()
+        assert w2.hd_seed == w.hd_seed and w2.hd_counter == 2
+        assert set(w2.key_paths.values()) == paths
+        a2 = w2.get_new_address()
+        assert a2 not in (a0, a1)
+        assert w2.key_paths[w2.keys_by_pkh[
+            list(w2.keys_by_pkh)[-1]].pubkey] == "m/0'/0'/2'"
+
+    def test_encrypt_seals_seed_and_unlock_restores(self, tmp_path):
+        from bitcoincashplus_tpu.consensus.params import regtest_params
+
+        params = regtest_params()
+        path = str(tmp_path / "wallet.json")
+        w = Wallet(params, path=path)
+        a0 = w.get_new_address()
+        seed = w.hd_seed
+        w.encrypt("hunter2")
+        assert w.hd_seed is None and w.encrypted_hd_seed is not None
+        # locked wallet can't derive
+        with pytest.raises(Exception):
+            w.get_new_address()
+        assert w.unlock("hunter2")
+        assert w.hd_seed == seed
+        a1 = w.get_new_address()  # HD derivation continues while unlocked
+        assert w.key_paths[w.keys_by_pubkey[
+            list(w.keys_by_pubkey)[-1]].pubkey].endswith("/1'")
+
+        # reload from disk: seed ciphertext survives; unlock restores
+        w2 = Wallet(params, path=path)
+        w2.load()
+        assert w2.encrypted_hd_seed is not None
+        assert w2.unlock("hunter2")
+        assert w2.hd_seed == seed
+
+    def test_passphrase_change_reseals_seed(self, tmp_path):
+        from bitcoincashplus_tpu.consensus.params import regtest_params
+
+        params = regtest_params()
+        w = Wallet(params, path=str(tmp_path / "w.json"))
+        w.get_new_address()
+        seed = w.hd_seed
+        w.encrypt("old")
+        assert w.unlock("old")
+        assert w.change_passphrase("old", "new")
+        w.lock()
+        assert not w.unlock("old")
+        assert w.unlock("new")
+        assert w.hd_seed == seed
+
+    def test_legacy_wallet_stays_random(self, tmp_path):
+        """A wallet that already has imported keys but no seed keeps
+        generating random keys (no retroactive HD adoption)."""
+        from bitcoincashplus_tpu.consensus.params import regtest_params
+
+        params = regtest_params()
+        w = Wallet(params)
+        w.add_key(CKey(0x1234), persist=False)
+        w.get_new_address()
+        assert w.hd_seed is None
+        assert w.key_paths == {}
