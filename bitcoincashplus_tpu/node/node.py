@@ -86,6 +86,12 @@ class Node:
         self.cs_main = threading.RLock()
         self.shutdown_event = threading.Event()
         self.start_time = int(time.time())
+        # wake channel for blocking RPCs (getblocktemplate longpoll,
+        # waitfornewblock): notified on tip/mempool change. Waiters poll
+        # their predicate under cs_main between short cv waits — notifiers
+        # fire while holding cs_main, so waiters must never hold the cv
+        # while taking cs_main in the other order.
+        self.notify_cv = threading.Condition()
 
         reindex = config.get_bool("reindex")
         blocks_dir = os.path.join(self.datadir, "blocks")
@@ -170,6 +176,29 @@ class Node:
 
     # -- validation-interface callbacks (CMainSignals analogues) --------
 
+    def notify_waiters(self) -> None:
+        """Wake longpoll/waitforblock RPC waiters."""
+        with self.notify_cv:
+            self.notify_cv.notify_all()
+
+    def wait_for(self, pred, timeout: float):
+        """Run pred() under cs_main until it returns non-None or timeout
+        (seconds). Returns pred's value or the final (timed-out) value."""
+        deadline = time.time() + max(timeout, 0.0)
+        while True:
+            with self.cs_main:
+                val = pred()
+            if val is not None:
+                return val
+            remaining = deadline - time.time()
+            if remaining <= 0 or self.shutdown_event.is_set():
+                with self.cs_main:
+                    return pred()
+            with self.notify_cv:
+                # bounded wait: a notify can race the re-check, so cap the
+                # sleep instead of trusting wakeups alone
+                self.notify_cv.wait(min(remaining, 0.5))
+
     def _on_block_connected(self, block: CBlock, idx) -> None:
         # fee estimation sample: feerates of the block's txs we had pending
         rates = []
@@ -202,6 +231,7 @@ class Node:
                 subprocess.Popen(cmd.replace("%s", _h2h(idx.hash)), shell=True)
             except OSError as e:
                 log_printf("blocknotify failed: %r", e)
+        self.notify_waiters()
 
     def _on_block_disconnected(self, block: CBlock, idx) -> None:
         # BlockDisconnected: return the block's transactions to the mempool
@@ -229,6 +259,7 @@ class Node:
         # already committed by in-pool txs (e.g. after a mempool.dat reload)
         if self.wallet is not None:
             self.wallet.add_tx_if_mine(tx, -1, False)
+        self.notify_waiters()
         return entry
 
     # -- mining ---------------------------------------------------------

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import secrets
+import struct
 import threading
 import time
 from typing import Optional
@@ -35,6 +36,7 @@ from .protocol import (
     HEADER_SIZE,
     MAX_HEADERS_RESULTS,
     MSG_BLOCK,
+    MSG_CMPCT_BLOCK,
     MSG_FILTERED_BLOCK,
     MSG_TX,
     MessageHeader,
@@ -56,6 +58,14 @@ from .protocol import (
 MAX_ORPHAN_TX = 100  # DEFAULT_MAX_ORPHAN_TRANSACTIONS
 PING_INTERVAL = 120       # net.cpp PING_INTERVAL
 TIMEOUT_INTERVAL = 1200   # net.cpp TIMEOUT_INTERVAL (20 min)
+RELAY_TX_CACHE_TIME = 900  # mapRelay retention (15 min, net_processing.cpp)
+
+# BIP61 reject codes (src/consensus/validation.h REJECT_*)
+REJECT_MALFORMED = 0x01
+REJECT_INVALID = 0x10
+REJECT_DUPLICATE = 0x12
+REJECT_NONSTANDARD = 0x40
+REJECT_INSUFFICIENTFEE = 0x42
 
 class Peer:
     """CNode — one connected peer."""
@@ -80,6 +90,13 @@ class Peer:
         # fRelayTxes: seeded from the version message's relay byte;
         # filterload/filterclear force it back on (BIP37 semantics)
         self.relay_txs = True
+        # BIP152: peer sent sendcmpct(announce=1) → announce new tips as
+        # cmpctblock (high-bandwidth mode)
+        self.cmpct_announce = False
+        # one in-flight compact-block reconstruction (PartiallyDownloadedBlock)
+        self.pending_cmpct = None
+        # BIP133 feefilter: don't announce txs below this rate (sat/kB)
+        self.min_fee_filter = 0
         self.known_invs: set[bytes] = set()
         self.connected_at = time.time()
         self.last_recv = 0.0
@@ -140,6 +157,12 @@ class CConnman:
         # mapOrphanTransactions (net_processing.cpp): txs whose inputs we
         # don't know yet, bounded FIFO
         self._orphans: dict[bytes, CTransaction] = {}
+        # -addnode / addnode RPC "add" targets (vAddedNodes, net.cpp)
+        self.added_nodes: list[str] = []
+        # mapRelay (net_processing.cpp): recently relayed txs kept
+        # RELAY_TX_CACHE_TIME so getdata can be served after the tx leaves
+        # the mempool (e.g. it was just mined)
+        self._relay_memory: dict[bytes, tuple[CTransaction, float]] = {}
 
     # -- lifecycle ------------------------------------------------------
 
@@ -170,6 +193,10 @@ class CConnman:
         while True:
             await asyncio.sleep(PING_INTERVAL)
             now = time.time()
+            # expire mapRelay entries past their retention
+            self._relay_memory = {
+                h: v for h, v in self._relay_memory.items() if v[1] > now
+            }
             for peer in list(self.peers.values()):
                 quiet = now - max(peer.last_recv, peer.connected_at)
                 if quiet > TIMEOUT_INTERVAL:
@@ -350,6 +377,13 @@ class CConnman:
         # BIP130: ask for headers-first block announcements (we already
         # process unsolicited headers via _msg_headers)
         peer.send("sendheaders")
+        # BIP152: offer compact-block relay, version 1, low-bandwidth
+        # (announce=0: we ask peers to announce via headers/inv and pull
+        # cmpctblock on demand; peers may still sendcmpct(1) at us)
+        peer.send("sendcmpct", struct.pack("<BQ", 0, 1))
+        # BIP133: tell the peer our relay floor so it doesn't waste invs
+        peer.send("feefilter",
+                  struct.pack("<Q", self.node.min_relay_fee_rate))
         # start headers sync (the reference sends getheaders on verack)
         with self.node.cs_main:
             locator = self.node.chainstate.chain.get_locator()
@@ -456,6 +490,15 @@ class CConnman:
                 if raw is not None:
                     peer.send("block", raw)
                     await peer.writer.drain()
+            elif inv_type == MSG_CMPCT_BLOCK:
+                with self.node.cs_main:
+                    raw = self.node.block_store.get_block(h)
+                if raw is not None:
+                    from .compact import HeaderAndShortIDs
+
+                    peer.send("cmpctblock", HeaderAndShortIDs.from_block(
+                        CBlock.from_bytes(raw)).serialize())
+                    await peer.writer.drain()
             elif inv_type == MSG_FILTERED_BLOCK:
                 # BIP37: merkleblock + the matched txs (net_processing.cpp
                 # ProcessGetData MSG_FILTERED_BLOCK branch). No filter
@@ -482,6 +525,11 @@ class CConnman:
             elif inv_type == MSG_TX:
                 with self.node.cs_main:
                     tx = self.node.mempool.get_tx(h)
+                if tx is None:
+                    # mapRelay: a just-mined tx can still be served
+                    kept = self._relay_memory.get(h)
+                    if kept is not None and kept[1] > time.time():
+                        tx = kept[0]
                 if tx is not None:
                     peer.send("tx", tx.serialize())
                     await peer.writer.drain()
@@ -491,16 +539,8 @@ class CConnman:
             block = CBlock.from_bytes(payload)
         except Exception:
             raise NetMessageError("undecodable block") from None
-        h = block.get_hash()
-        self._requested_blocks.pop(h, None)
-        peer.known_invs.add(h)
-        with self.node.cs_main:
-            try:
-                self.node.chainstate.process_new_block(block)
-            except BlockValidationError as e:
-                if e.reason not in ("duplicate", "prev-blk-not-found"):
-                    log_print("net", "peer=%d sent invalid block %s: %s",
-                              peer.id, hash_to_hex(h)[:16], e.reason)
+        self._requested_blocks.pop(block.get_hash(), None)
+        self._process_block_obj(peer, block)
 
     def _msg_tx(self, peer: Peer, payload: bytes) -> None:
         try:
@@ -528,6 +568,10 @@ class CConnman:
                           tx.txid_hex[:16], len(self._orphans))
             else:
                 log_print("net", "tx %s rejected: %s", tx.txid_hex[:16], e.reason)
+                if peer is not None:
+                    code = (REJECT_INSUFFICIENTFEE
+                            if "fee" in e.reason else REJECT_INVALID)
+                    self._send_reject(peer, "tx", code, e.reason, tx.txid)
             return
         self.relay_tx(tx.txid, skip_peer=peer.id if peer else 0)
         # any orphans that spend this tx can be retried now
@@ -584,6 +628,155 @@ class CConnman:
         peer.bloom_filter = None
         peer.relay_txs = True  # "relay all transactions" per BIP37
 
+    # -- BIP152 compact blocks (net_processing.cpp SENDCMPCT/CMPCTBLOCK/
+    # GETBLOCKTXN/BLOCKTXN) ----------------------------------------------
+
+    def _msg_feefilter(self, peer: Peer, payload: bytes) -> None:
+        """BIP133: peer's minimum announce feerate (sat/kB)."""
+        if len(payload) != 8:
+            raise NetMessageError("bad feefilter")
+        (peer.min_fee_filter,) = struct.unpack("<Q", payload)
+
+    def _send_reject(self, peer: Peer, message: str, code: int,
+                     reason: str, h: bytes = b"") -> None:
+        """BIP61 reject (net_processing.cpp PushMessage(REJECT, ...))."""
+        from ..consensus.serialize import ser_compact_size
+
+        msg = message.encode()
+        rsn = reason.encode()[:111]  # MAX_REJECT_MESSAGE_LENGTH
+        payload = (ser_compact_size(len(msg)) + msg + bytes([code])
+                   + ser_compact_size(len(rsn)) + rsn + h)
+        try:
+            peer.send("reject", payload)
+        except Exception:
+            pass
+
+    def _msg_reject(self, peer: Peer, payload: bytes) -> None:
+        """Incoming rejects are logged, never acted on (like the
+        reference's -debug=net logging of REJECT)."""
+        log_print("net", "peer=%d reject: %s", peer.id, payload[:64].hex())
+
+    def _msg_sendcmpct(self, peer: Peer, payload: bytes) -> None:
+        if len(payload) != 9:
+            raise NetMessageError("bad sendcmpct")
+        announce, version = struct.unpack("<BQ", payload)
+        if version == 1:  # other versions are ignored, like the reference
+            peer.cmpct_announce = bool(announce)
+
+    def _msg_cmpctblock(self, peer: Peer, payload: bytes) -> None:
+        from .compact import BlockTransactionsRequest, HeaderAndShortIDs
+        from ..consensus.serialize import ByteReader
+
+        try:
+            hsids = HeaderAndShortIDs.deserialize(ByteReader(payload))
+        except Exception:
+            raise NetMessageError("undecodable cmpctblock") from None
+        h = hsids.header.get_hash()
+        with self.node.cs_main:
+            cs = self.node.chainstate
+            idx = cs.block_index.get(h)
+            if idx is not None and (idx.status & BlockStatus.HAVE_DATA):
+                return  # already have it
+            # header must be valid before we spend effort reconstructing
+            try:
+                cs.accept_block_header(hsids.header)
+            except BlockValidationError as e:
+                if e.reason == "prev-blk-not-found":
+                    # can't contextually validate — fall back to headers sync
+                    peer.send("getheaders",
+                              ser_getheaders(cs.chain.get_locator()))
+                    return
+                raise NetMessageError(
+                    f"invalid cmpctblock header: {e.reason}") from None
+            # map short IDs over the mempool
+            from .compact import short_id, short_id_keys
+
+            k0, k1 = short_id_keys(hsids.header, hsids.nonce)
+            by_sid = {
+                short_id(k0, k1, txid): e.tx
+                for txid, e in self.node.mempool.entries.items()
+            }
+            block, missing = hsids.reconstruct(by_sid.get)
+        if block is not None:
+            self._requested_blocks.pop(h, None)
+            self._process_block_obj(peer, block)
+            return
+        if peer.pending_cmpct is not None:
+            # a second announcement would orphan the in-flight
+            # reconstruction — fetch the old block in full instead
+            old_h = peer.pending_cmpct[0].header.get_hash()
+            peer.send("getdata", ser_inv([(MSG_BLOCK, old_h)]))
+        # keep the shortid->tx map so blocktxn doesn't re-hash the mempool
+        peer.pending_cmpct = (hsids, by_sid)
+        req = BlockTransactionsRequest(h, missing)
+        peer.send("getblocktxn", req.serialize())
+
+    def _msg_getblocktxn(self, peer: Peer, payload: bytes) -> None:
+        from .compact import BlockTransactions, BlockTransactionsRequest
+        from ..consensus.serialize import ByteReader
+
+        try:
+            req = BlockTransactionsRequest.deserialize(ByteReader(payload))
+        except Exception:
+            raise NetMessageError("bad getblocktxn") from None
+        with self.node.cs_main:
+            raw = self.node.block_store.get_block(req.block_hash)
+        if raw is None:
+            return
+        block = CBlock.from_bytes(raw)
+        try:
+            txs = [block.vtx[i] for i in req.indexes]
+        except IndexError:
+            raise NetMessageError("getblocktxn index out of range") from None
+        peer.send("blocktxn",
+                  BlockTransactions(req.block_hash, txs).serialize())
+
+    def _msg_blocktxn(self, peer: Peer, payload: bytes) -> None:
+        from .compact import BlockTransactions
+        from ..consensus.serialize import ByteReader
+
+        try:
+            bt = BlockTransactions.deserialize(ByteReader(payload))
+        except Exception:
+            raise NetMessageError("bad blocktxn") from None
+        if peer.pending_cmpct is None:
+            return  # unsolicited
+        hsids, by_sid = peer.pending_cmpct
+        if hsids.header.get_hash() != bt.block_hash:
+            # stale reply for an overwritten reconstruction: fetch in full
+            peer.send("getdata", ser_inv([(MSG_BLOCK, bt.block_hash)]))
+            return
+        peer.pending_cmpct = None
+        # retry reconstruction with the cached map + the supplied txs; the
+        # shortid check inside reconstruct() rejects wrong fills
+        from .compact import short_id, short_id_keys
+
+        k0, k1 = short_id_keys(hsids.header, hsids.nonce)
+        for tx in bt.txs:
+            by_sid[short_id(k0, k1, tx.txid)] = tx
+        block, missing = hsids.reconstruct(by_sid.get)
+        if block is None:
+            # reconstruction failed — fall back to a full block fetch
+            h = hsids.header.get_hash()
+            peer.send("getdata", ser_inv([(MSG_BLOCK, h)]))
+            return
+        self._requested_blocks.pop(block.get_hash(), None)
+        self._process_block_obj(peer, block)
+
+    def _process_block_obj(self, peer: Peer, block: CBlock) -> None:
+        """Shared block-acceptance tail for block/cmpctblock/blocktxn."""
+        h = block.get_hash()
+        peer.known_invs.add(h)
+        with self.node.cs_main:
+            try:
+                self.node.chainstate.process_new_block(block)
+            except BlockValidationError as e:
+                if e.reason not in ("duplicate", "prev-blk-not-found"):
+                    log_print("net", "peer=%d sent invalid block %s: %s",
+                              peer.id, hash_to_hex(h)[:16], e.reason)
+                    self._send_reject(peer, "block", REJECT_INVALID,
+                                      e.reason, h)
+
     # -- relay ----------------------------------------------------------
 
     def _on_tip_changed(self, tip) -> None:
@@ -592,12 +785,27 @@ class CConnman:
         header = tip.header
 
         def _announce():
-            for peer in self.peers.values():
+            # runs on the event loop: peer-dict iteration is single-threaded
+            # here, and the compact form is serialized lazily at most once
+            cmpct_payload = None
+            for peer in list(self.peers.values()):
                 if not peer.handshaked or tip.hash in peer.known_invs:
                     continue
                 peer.known_invs.add(tip.hash)
                 try:
-                    if peer.prefers_headers:  # BIP130 direct headers announce
+                    if peer.cmpct_announce:
+                        if cmpct_payload is None:
+                            with self.node.cs_main:
+                                raw = self.node.block_store.get_block(tip.hash)
+                            if raw is not None:
+                                from .compact import HeaderAndShortIDs
+
+                                cmpct_payload = HeaderAndShortIDs.from_block(
+                                    CBlock.from_bytes(raw)).serialize()
+                        if cmpct_payload is not None:
+                            peer.send("cmpctblock", cmpct_payload)
+                            continue
+                    if peer.prefers_headers:  # BIP130 headers announce
                         peer.send("headers", ser_headers([header]))
                     else:
                         peer.send("inv", ser_inv([(MSG_BLOCK, tip.hash)]))
@@ -611,13 +819,22 @@ class CConnman:
         # hears about relevant txs; version.relay=False without a filter
         # suppresses tx invs entirely (net_processing.cpp SendMessages)
         tx = None
+        fee_rate = 0
         if inv_type == MSG_TX:
             with self.node.cs_main:
-                tx = self.node.mempool.get_tx(h)
+                entry = self.node.mempool.get(h)
+                if entry is not None:
+                    tx = entry.tx
+                    fee_rate = entry.fee * 1000 // max(entry.size, 1)
+                    # mapRelay: remember for serving getdata post-mining
+                    self._relay_memory[h] = (
+                        tx, time.time() + RELAY_TX_CACHE_TIME)
 
         def _want(peer: Peer) -> bool:
             if inv_type != MSG_TX:
                 return True
+            if peer.min_fee_filter and fee_rate < peer.min_fee_filter:
+                return False  # BIP133
             if peer.bloom_filter is not None:
                 return tx is not None and \
                     peer.bloom_filter.is_relevant_and_update(tx)

@@ -29,6 +29,7 @@ MAX_LOCATOR_SZ = 101
 MSG_TX = 1
 MSG_BLOCK = 2
 MSG_FILTERED_BLOCK = 3  # BIP37: getdata answered with merkleblock
+MSG_CMPCT_BLOCK = 4     # BIP152: getdata answered with cmpctblock
 
 HEADER_SIZE = 24
 

@@ -459,3 +459,41 @@ def preciousblock(node, params):
     idx = _block_index_or_raise(node, param_hash(params, 0))
     node.chainstate.precious_block(idx)
     return None
+
+
+@rpc_method("getchaintxstats")
+def getchaintxstats(node, params):
+    """getchaintxstats ( nblocks "blockhash" ) — tx rate over a window
+    ending at the given block (src/rpc/blockchain.cpp)."""
+    cs = node.chainstate
+    final = cs.tip()
+    if len(params) > 1 and params[1]:
+        final = _block_index_or_raise(node, param_hash(params, 1))
+        if cs.chain[final.height] is not final:
+            raise RPCError(RPC_INVALID_PARAMETER,
+                           "Block is not in main chain")
+    # default window: one month of target spacing, clamped to the chain
+    spacing = node.params.consensus.pow_target_spacing
+    if params and params[0] is not None:
+        window = int(params[0])
+    else:
+        window = max(0, min(final.height - 1, 30 * 24 * 3600 // spacing))
+    # Core's bound: 0 <= blockcount < height (0 = totals only)
+    if window < 0 or (window > 0 and window >= final.height):
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "Invalid block count: should be between 0 and the "
+                       "block's height - 1")
+    out = {
+        "time": final.header.time,
+        "txcount": final.chain_tx,
+        "window_final_block_hash": hash_to_hex(final.hash),
+        "window_block_count": window,
+    }
+    if window > 0:
+        first = cs.chain[final.height - window]
+        interval = final.get_median_time_past() - first.get_median_time_past()
+        out["window_tx_count"] = final.chain_tx - first.chain_tx
+        out["window_interval"] = interval
+        if interval > 0:
+            out["txrate"] = (final.chain_tx - first.chain_tx) / interval
+    return out

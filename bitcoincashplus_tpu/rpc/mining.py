@@ -38,8 +38,27 @@ def generatetoaddress(node, params):
 
 @rpc_method("getblocktemplate")
 def getblocktemplate(node, params):
-    """getblocktemplate (src/rpc/mining.cpp:~350) — BIP22 shape, no longpoll
-    blocking (template_request 'longpollid' returns the current template)."""
+    """getblocktemplate (src/rpc/mining.cpp:~350) — BIP22 shape. A
+    template_request with 'longpollid' blocks (~60s max) until the tip or
+    the mempool changes, like the reference's checktxtime/hashWatchedChain
+    wait loop."""
+    request = params[0] if params and isinstance(params[0], dict) else {}
+    longpollid = request.get("longpollid")
+    if longpollid:
+        def changed():
+            tip = node.chainstate.tip()
+            cur = hash_to_hex(tip.hash) + f"{node.mempool.sequence}"
+            return True if cur != longpollid else None
+
+        node.wait_for(changed, timeout=60.0)
+    with node.cs_main:
+        return _template_json(node)
+
+
+getblocktemplate.no_cs_main = True
+
+
+def _template_json(node):
     tmpl = node.assembler().create_new_block(script_pubkey=b"\x51")  # OP_TRUE placeholder
     block = tmpl.block
     cs = node.chainstate
@@ -162,3 +181,68 @@ def estimatesmartfee(node, params):
         return {"feerate": node.min_relay_fee_rate / COIN, "blocks": nblocks,
                 "errors": ["Insufficient data or no feerate found"]}
     return {"feerate": samples[len(samples) // 2] / COIN, "blocks": nblocks}
+
+
+def _tip_json(node):
+    tip = node.chainstate.tip()
+    return {"hash": hash_to_hex(tip.hash), "height": tip.height}
+
+
+@rpc_method("waitfornewblock")
+def waitfornewblock(node, params):
+    """waitfornewblock ( timeout_ms ) — block until the tip changes."""
+    # Core semantics: timeout 0 (or absent) = wait indefinitely
+    timeout = (int(params[0]) / 1000) if params and params[0] else float("inf")
+    with node.cs_main:
+        start = node.chainstate.tip().hash
+
+    node.wait_for(
+        lambda: _tip_json(node) if node.chainstate.tip().hash != start else None,
+        timeout,
+    )
+    with node.cs_main:
+        return _tip_json(node)
+
+
+waitfornewblock.no_cs_main = True
+
+
+@rpc_method("waitforblock")
+def waitforblock(node, params):
+    """waitforblock "hash" ( timeout_ms )"""
+    require_params(params, 1, 2, "waitforblock \"blockhash\" ( timeout )")
+    from ..consensus.serialize import hex_to_hash
+
+    target = hex_to_hash(params[0])
+    timeout = (int(params[1]) / 1000) if len(params) > 1 and params[1] else float("inf")
+
+    def reached():
+        cs = node.chainstate
+        idx = cs.block_index.get(target)
+        if idx is not None and cs.chain[idx.height] is idx:
+            return _tip_json(node)
+        return None
+
+    node.wait_for(reached, timeout)
+    with node.cs_main:
+        return _tip_json(node)
+
+
+waitforblock.no_cs_main = True
+
+
+@rpc_method("waitforblockheight")
+def waitforblockheight(node, params):
+    """waitforblockheight height ( timeout_ms )"""
+    require_params(params, 1, 2, "waitforblockheight height ( timeout )")
+    height = int(params[0])
+    timeout = (int(params[1]) / 1000) if len(params) > 1 and params[1] else float("inf")
+    node.wait_for(
+        lambda: _tip_json(node) if node.chainstate.tip().height >= height else None,
+        timeout,
+    )
+    with node.cs_main:
+        return _tip_json(node)
+
+
+waitforblockheight.no_cs_main = True

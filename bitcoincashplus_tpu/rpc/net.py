@@ -57,12 +57,55 @@ def addnode(node, params):
     target, cmd = params[0], params[1]
     if cmd in ("add", "onetry"):
         host, _, port = target.rpartition(":")
+        if cmd == "add":
+            if target in node.connman.added_nodes:
+                raise RPCError(-23, "Error: Node already added")
+            node.connman.added_nodes.append(target)
         node.connman.connect_to(host or "127.0.0.1", int(port))
     elif cmd == "remove":
+        try:
+            node.connman.added_nodes.remove(target)
+        except ValueError:
+            pass
         node.connman.disconnect(target)
     else:
         raise RPCError(RPC_INVALID_PARAMETER, f"unknown command {cmd!r}")
     return None
+
+
+@rpc_method("getaddednodeinfo")
+def getaddednodeinfo(node, params):
+    """getaddednodeinfo — the addnode-list with live-connection status
+    (src/rpc/net.cpp getaddednodeinfo)."""
+    if node.connman is None:
+        return []
+    targets = node.connman.added_nodes
+    if params and params[-1] and isinstance(params[-1], str):
+        if params[-1] not in targets:
+            raise RPCError(-24, "Error: Node has not been added.")
+        targets = [params[-1]]
+    import socket as _socket
+
+    peers = {p.addr: p for p in node.connman.peers.values()}
+    out = []
+    for t in targets:
+        # resolve a hostname-form target so it matches peer.addr, which
+        # records getpeername's numeric ip:port
+        host, _, port = t.rpartition(":")
+        try:
+            resolved = f"{_socket.gethostbyname(host or '127.0.0.1')}:{port}"
+        except OSError:
+            resolved = t
+        peer = peers.get(t) or peers.get(resolved)
+        entry = {"addednode": t, "connected": peer is not None,
+                 "addresses": []}
+        if peer is not None:
+            entry["addresses"] = [{
+                "address": peer.addr,
+                "connected": "inbound" if not peer.outbound else "outbound",
+            }]
+        out.append(entry)
+    return out
 
 
 @rpc_method("disconnectnode")

@@ -81,8 +81,13 @@ class RPCServer:
             return _error_obj(req_id, RPC_METHOD_NOT_FOUND, "Method not found")
         log_print("rpc", "ThreadRPCServer method=%s", method)
         try:
-            with self.node.cs_main:
+            if getattr(handler, "no_cs_main", False):
+                # blocking handlers (longpoll, waitfor*) manage cs_main
+                # themselves so other RPC threads aren't starved
                 result = handler(self.node, params)
+            else:
+                with self.node.cs_main:
+                    result = handler(self.node, params)
         except RPCError as e:
             return _error_obj(req_id, e.code, e.message)
         except Exception as e:  # the reference wraps these the same way

@@ -310,3 +310,182 @@ def test_bip37_spv_flow():
         from bitcoincashplus_tpu.rpc.client import JSONRPCException
         with pytest.raises(JSONRPCException):
             node.rpc.verifytxoutproof(bytes(bad).hex())
+
+
+def test_bip152_compact_blocks():
+    """Compact-block relay both directions against a live node:
+    (a) a fake peer opts into high-bandwidth mode, mines from a template,
+    and submits the block as cmpctblock only — the node reconstructs it
+    from its own mempool and connects it; (b) the node announces the next
+    block to that peer as cmpctblock, and serves getblocktxn."""
+    import struct as _struct
+
+    from bitcoincashplus_tpu.consensus.serialize import ByteReader, hex_to_hash
+    from bitcoincashplus_tpu.p2p.compact import (
+        BlockTransactions,
+        BlockTransactionsRequest,
+        HeaderAndShortIDs,
+    )
+    from .test_node_basic import _mine_template, _spend_coinbase
+
+    with FunctionalFramework(num_nodes=1) as f:
+        node = f.nodes[0]
+        magic = regtest_params().netmagic
+        addr = node.rpc.getnewaddress()
+        node.rpc.generatetoaddress(101, addr)
+        # put a tx in the node's mempool so reconstruction has work to do
+        node.rpc.sendtoaddress(ADDR, 1.0)
+
+        s = socket.create_connection(("127.0.0.1", node.p2p_port), timeout=10)
+        s.sendall(pack_message(magic, "version", VersionPayload().serialize()))
+        _read_msg(s)
+        _read_msg(s)
+        s.sendall(pack_message(magic, "verack"))
+        # opt into high-bandwidth announcements
+        s.sendall(pack_message(magic, "sendcmpct", _struct.pack("<BQ", 1, 1)))
+
+        # -- (a) fake peer mines and relays via cmpctblock ---------------
+        tmpl = node.rpc.getblocktemplate()
+        assert len(tmpl["transactions"]) == 1
+        block = _mine_template(tmpl, ADDR)
+        hs = HeaderAndShortIDs.from_block(block, nonce=77)
+        assert len(hs.shortids) == 1  # the mempool tx travels as a shortid
+        s.sendall(pack_message(magic, "cmpctblock", hs.serialize()))
+        wait_until(lambda: node.rpc.getbestblockhash() == block.hash_hex,
+                   timeout=20)
+
+        # -- (b) node announces its next block as cmpctblock -------------
+        node.rpc.sendtoaddress(ADDR, 0.5)
+        mined = node.rpc.generatetoaddress(1, addr)[0]
+        deadline = time.time() + 20
+        announced = None
+        while time.time() < deadline and announced is None:
+            header, payload = _read_msg(s)
+            cmd = header[4:16].rstrip(b"\x00").decode()
+            if cmd == "cmpctblock":
+                announced = HeaderAndShortIDs.deserialize(ByteReader(payload))
+        assert announced is not None
+        from bitcoincashplus_tpu.consensus.serialize import hash_to_hex
+        assert hash_to_hex(announced.header.get_hash()) == mined
+
+        # pretend we know nothing: request every non-prefilled tx
+        total = announced.total_tx_count()
+        missing = [i for i in range(total)
+                   if i not in [p[0] for p in announced.prefilled]]
+        req = BlockTransactionsRequest(hex_to_hash(mined), missing)
+        s.sendall(pack_message(magic, "getblocktxn", req.serialize()))
+        bt = None
+        deadline = time.time() + 20
+        while time.time() < deadline and bt is None:
+            header, payload = _read_msg(s)
+            cmd = header[4:16].rstrip(b"\x00").decode()
+            if cmd == "blocktxn":
+                bt = BlockTransactions.deserialize(ByteReader(payload))
+        assert bt is not None and len(bt.txs) == len(missing)
+        # reconstruct and match the node's actual block
+        from bitcoincashplus_tpu.p2p.compact import short_id, short_id_keys
+        k0, k1 = short_id_keys(announced.header, announced.nonce)
+        pool = {short_id(k0, k1, t.txid): t for t in bt.txs}
+        got, still_missing = announced.reconstruct(pool.get)
+        assert still_missing == [] and got is not None
+        raw = node.rpc.getblock(mined, 0)
+        assert got.serialize().hex() == raw
+        s.close()
+
+
+def test_feefilter_reject_and_relay_memory():
+    """BIP133 feefilter suppresses low-fee invs; BIP61 reject answers an
+    invalid tx; mapRelay serves getdata for a just-mined tx."""
+    import struct as _struct
+
+    from bitcoincashplus_tpu.consensus.serialize import ByteReader, hex_to_hash
+    from bitcoincashplus_tpu.consensus.tx import (
+        COutPoint,
+        CTransaction,
+        CTxIn,
+        CTxOut,
+    )
+    from bitcoincashplus_tpu.p2p.protocol import MSG_TX, deser_inv, ser_inv
+
+    with FunctionalFramework(num_nodes=1) as f:
+        node = f.nodes[0]
+        magic = regtest_params().netmagic
+        addr = node.rpc.getnewaddress()
+        node.rpc.generatetoaddress(2, ADDR)  # blocks 1+2 pay our test KEY
+        node.rpc.generatetoaddress(100, addr)
+
+        s = socket.create_connection(("127.0.0.1", node.p2p_port), timeout=10)
+        s.sendall(pack_message(magic, "version", VersionPayload().serialize()))
+        _read_msg(s)
+        _read_msg(s)
+        s.sendall(pack_message(magic, "verack"))
+        # we should be told the node's relay floor
+        got_feefilter = None
+        deadline = time.time() + 10
+        while time.time() < deadline and got_feefilter is None:
+            header, payload = _read_msg(s)
+            if header[4:16].rstrip(b"\x00") == b"feefilter":
+                (got_feefilter,) = _struct.unpack("<Q", payload)
+        assert got_feefilter == 1000  # default minrelaytxfee sat/kB
+
+        # -- set an absurd filter: the node must NOT inv us the next tx --
+        s.sendall(pack_message(magic, "feefilter",
+                               _struct.pack("<Q", 10**9)))
+        time.sleep(0.5)
+        txid = node.rpc.sendtoaddress(ADDR, 1.0)
+        s.settimeout(3)
+        saw_inv = False
+        try:
+            while True:
+                header, payload = _read_msg(s)
+                if header[4:16].rstrip(b"\x00") == b"inv":
+                    items = deser_inv(payload)
+                    if any(t == MSG_TX for t, _h in items):
+                        saw_inv = True
+        except (socket.timeout, OSError):
+            pass
+        assert not saw_inv, "low-fee tx inv leaked through the feefilter"
+        s.settimeout(30)
+
+        # -- drop the filter; mine the tx; mapRelay serves getdata -------
+        s.sendall(pack_message(magic, "feefilter", _struct.pack("<Q", 0)))
+        node.rpc.generatetoaddress(1, addr)  # tx leaves the mempool
+        assert node.rpc.getrawmempool() == []
+        s.sendall(pack_message(magic, "getdata",
+                               ser_inv([(MSG_TX, hex_to_hash(txid))])))
+        got_tx = None
+        deadline = time.time() + 15
+        while time.time() < deadline and got_tx is None:
+            header, payload = _read_msg(s)
+            if header[4:16].rstrip(b"\x00") == b"tx":
+                got_tx = CTransaction.from_bytes(payload)
+        assert got_tx is not None and got_tx.txid == hex_to_hash(txid)
+
+        # -- invalid tx gets a BIP61 reject ------------------------------
+        # a bit-flipped signature on an otherwise valid spend of our own
+        # mature coinbase → mandatory-script-verify-flag-failed (code 0x10)
+        blk2 = node.rpc.getblock(node.rpc.getblockhash(2), 2)
+        good = CTransaction.from_bytes(bytes.fromhex(
+            _spend_tx(blk2["tx"][0], 1_0000_0000)))
+        sig = bytearray(good.vin[0].script_sig)
+        sig[10] ^= 0x01
+        bad = CTransaction(
+            good.version,
+            (CTxIn(good.vin[0].prevout, bytes(sig), good.vin[0].sequence),),
+            good.vout, good.locktime,
+        )
+        s.sendall(pack_message(magic, "tx", bad.serialize()))
+        got_reject = None
+        deadline = time.time() + 15
+        while time.time() < deadline and got_reject is None:
+            header, payload = _read_msg(s)
+            if header[4:16].rstrip(b"\x00") == b"reject":
+                got_reject = payload
+        assert got_reject is not None
+        r = ByteReader(got_reject)
+        from bitcoincashplus_tpu.consensus.serialize import deser_compact_size
+        n = deser_compact_size(r)
+        assert r.read_bytes(n) == b"tx"
+        code = r.read_bytes(1)[0]
+        assert code in (0x10, 0x42)
+        s.close()

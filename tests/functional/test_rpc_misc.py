@@ -1,6 +1,8 @@
 """signrawtransaction, wallet tx history, and ban-list RPC functional
 coverage (rpcwallet/rpcdump/rpc net parity additions)."""
 
+import time
+
 import pytest
 
 from .framework import FunctionalFramework
@@ -122,3 +124,72 @@ def test_getblockstats_and_walletnotify(tmp_path):
             timeout=15,
         )
         assert glob.glob(os.path.join(str(tmp_path), "wtx_*"))
+
+
+def test_longpoll_and_wait_rpcs():
+    """getblocktemplate longpoll + waitfornewblock block until the chain
+    moves; getchaintxstats and getaddednodeinfo answer."""
+    import threading
+
+    from bitcoincashplus_tpu.rpc.client import RPCClient
+
+    with FunctionalFramework(num_nodes=1) as f:
+        node = f.nodes[0]
+        addr = node.rpc.getnewaddress()
+        node.rpc.generatetoaddress(5, addr)
+
+        # -- longpoll: blocks until a new block arrives ------------------
+        tmpl = node.rpc.getblocktemplate()
+        lpid = tmpl["longpollid"]
+        result = {}
+
+        def longpoller():
+            c = RPCClient(port=node.rpc_port, datadir=node.datadir)
+            c.timeout = 90
+            result["tmpl"] = c.call("getblocktemplate", {"longpollid": lpid})
+
+        t = threading.Thread(target=longpoller)
+        t.start()
+        time.sleep(1.0)
+        assert t.is_alive()  # still blocked — nothing changed
+        node.rpc.generatetoaddress(1, addr)
+        t.join(30)
+        assert not t.is_alive()
+        assert result["tmpl"]["height"] == tmpl["height"] + 1
+
+        # -- waitfornewblock --------------------------------------------
+        result2 = {}
+
+        def waiter():
+            c = RPCClient(port=node.rpc_port, datadir=node.datadir)
+            c.timeout = 90
+            result2["tip"] = c.call("waitfornewblock", 60_000)
+
+        t2 = threading.Thread(target=waiter)
+        t2.start()
+        time.sleep(0.5)
+        assert t2.is_alive()
+        mined = node.rpc.generatetoaddress(1, addr)[0]
+        t2.join(30)
+        assert not t2.is_alive()
+        assert result2["tip"]["hash"] == mined
+
+        # waitforblockheight for an already-reached height returns now
+        h = node.rpc.getblockcount()
+        got = node.rpc.waitforblockheight(h, 1000)
+        assert got["height"] == h
+
+        # -- getchaintxstats --------------------------------------------
+        stats = node.rpc.getchaintxstats(5)
+        assert stats["window_block_count"] == 5
+        assert stats["window_tx_count"] == 5  # coinbase-only blocks
+        assert stats["txcount"] == node.rpc.getblockcount() + 1  # + genesis
+
+        # -- getaddednodeinfo -------------------------------------------
+        assert node.rpc.getaddednodeinfo() == []
+        node.rpc.addnode("127.0.0.1:1", "add")  # nothing listens there
+        info = node.rpc.getaddednodeinfo()
+        assert info[0]["addednode"] == "127.0.0.1:1"
+        assert info[0]["connected"] is False
+        node.rpc.addnode("127.0.0.1:1", "remove")
+        assert node.rpc.getaddednodeinfo() == []

@@ -1,0 +1,123 @@
+"""BIP152 compact blocks — shortid derivation, wire round-trips,
+reconstruction (src/test/blockencodings_tests.cpp analogues)."""
+
+import struct
+
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from bitcoincashplus_tpu.consensus.block import CBlock, CBlockHeader
+from bitcoincashplus_tpu.consensus.merkle import compute_merkle_root
+from bitcoincashplus_tpu.consensus.serialize import ByteReader
+from bitcoincashplus_tpu.consensus.tx import (
+    COutPoint,
+    CTransaction,
+    CTxIn,
+    CTxOut,
+)
+from bitcoincashplus_tpu.crypto.siphash import siphash24
+from bitcoincashplus_tpu.p2p.compact import (
+    BlockTransactions,
+    BlockTransactionsRequest,
+    HeaderAndShortIDs,
+    short_id,
+    short_id_keys,
+)
+
+
+def test_siphash_reference_vectors():
+    """SipHash-2-4 paper vectors (same table crypto_tests.cpp pins)."""
+    k0, k1 = 0x0706050403020100, 0x0F0E0D0C0B0A0908
+    expect = [0x726FDB47DD0E0E31, 0x74F839C593DC67FD,
+              0x0D6C8009D9A94F5A, 0x85676696D7FB7E2D]
+    for n, e in enumerate(expect):
+        assert siphash24(k0, k1, bytes(range(n))) == e
+
+
+def _tx(salt: int) -> CTransaction:
+    return CTransaction(
+        vin=(CTxIn(COutPoint(bytes([salt]) * 32, 0), bytes([salt])),),
+        vout=(CTxOut(1000 + salt, b"\x51"),),
+    )
+
+
+def _block(n_tx: int) -> CBlock:
+    txs = tuple(_tx(i + 1) for i in range(n_tx))
+    root, _ = compute_merkle_root([t.txid for t in txs])
+    return CBlock(CBlockHeader(hash_merkle_root=root, bits=0x207FFFFF), txs)
+
+
+class TestHeaderAndShortIDs:
+    def test_wire_roundtrip(self):
+        blk = _block(5)
+        hs = HeaderAndShortIDs.from_block(blk, nonce=42)
+        wire = hs.serialize()
+        back = HeaderAndShortIDs.deserialize(ByteReader(wire))
+        assert back.nonce == 42
+        assert back.shortids == hs.shortids
+        assert len(back.shortids) == 4  # coinbase prefilled
+        assert back.prefilled[0][0] == 0
+        assert back.prefilled[0][1].txid == blk.vtx[0].txid
+        assert back.header.get_hash() == blk.header.get_hash()
+
+    def test_shortids_are_48bit_and_keyed(self):
+        blk = _block(3)
+        a = HeaderAndShortIDs.from_block(blk, nonce=1)
+        b = HeaderAndShortIDs.from_block(blk, nonce=2)
+        assert all(s < (1 << 48) for s in a.shortids)
+        assert a.shortids != b.shortids  # nonce changes the key
+
+    def test_reconstruct_full_mempool(self):
+        blk = _block(6)
+        hs = HeaderAndShortIDs.from_block(blk, nonce=7)
+        k0, k1 = short_id_keys(blk.header, 7)
+        pool = {short_id(k0, k1, t.txid): t for t in blk.vtx[1:]}
+        got, missing = hs.reconstruct(pool.get)
+        assert missing == [] and got is not None
+        assert got.serialize() == blk.serialize()
+
+    def test_reconstruct_reports_missing(self):
+        blk = _block(6)
+        hs = HeaderAndShortIDs.from_block(blk, nonce=7)
+        k0, k1 = short_id_keys(blk.header, 7)
+        # mempool knows only txs 1 and 3 (absolute indexes)
+        pool = {short_id(k0, k1, blk.vtx[i].txid): blk.vtx[i] for i in (1, 3)}
+        got, missing = hs.reconstruct(pool.get)
+        assert got is None
+        assert missing == [2, 4, 5]
+        # supply them via BlockTransactions and complete
+        for i in missing:
+            pool[short_id(k0, k1, blk.vtx[i].txid)] = blk.vtx[i]
+        got, missing = hs.reconstruct(pool.get)
+        assert missing == [] and got.serialize() == blk.serialize()
+
+    def test_wrong_tx_rejected_by_shortid(self):
+        blk = _block(3)
+        hs = HeaderAndShortIDs.from_block(blk, nonce=9)
+        rogue = _tx(99)
+        got, missing = hs.reconstruct(lambda sid: rogue)
+        assert got is None and missing == [1, 2]
+
+
+class TestRequestAndAnswer:
+    def test_request_differential_roundtrip(self):
+        req = BlockTransactionsRequest(b"\xab" * 32, [0, 2, 3, 10])
+        back = BlockTransactionsRequest.deserialize(ByteReader(req.serialize()))
+        assert back.block_hash == b"\xab" * 32
+        assert back.indexes == [0, 2, 3, 10]
+
+    def test_blocktxn_roundtrip(self):
+        txs = [_tx(1), _tx(2)]
+        bt = BlockTransactions(b"\xcd" * 32, txs)
+        back = BlockTransactions.deserialize(ByteReader(bt.serialize()))
+        assert back.block_hash == b"\xcd" * 32
+        assert [t.txid for t in back.txs] == [t.txid for t in txs]
+
+    @settings(max_examples=25, deadline=None)
+    @given(st.lists(st.integers(0, 5000), min_size=1, max_size=50,
+                    unique=True))
+    def test_request_property(self, indexes):
+        indexes = sorted(indexes)
+        req = BlockTransactionsRequest(b"\x01" * 32, indexes)
+        back = BlockTransactionsRequest.deserialize(ByteReader(req.serialize()))
+        assert back.indexes == indexes
