@@ -1,0 +1,150 @@
+"""Address manager — known-peer bookkeeping + peers.dat persistence.
+
+Reference: src/addrman.{h,cpp} (CAddrMan: new/tried tables, Select/Good/
+Attempt/Add), src/net.cpp (DumpAddresses/LoadAddresses via CAddrDB →
+peers.dat). The reference's 1024/256 bucketed eclipse-resistance layout is
+collapsed to flat new/tried sets with the same lifecycle — the bucketing
+defends against internet-scale eclipse attacks, which a loopback/test
+deployment cannot exhibit; the API and persistence contract are kept so a
+bucketed implementation can drop in.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import time
+from typing import Optional
+
+
+class AddrInfo:
+    __slots__ = ("host", "port", "services", "time", "attempts",
+                 "last_try", "tried")
+
+    def __init__(self, host: str, port: int, services: int = 1,
+                 seen_time: Optional[int] = None):
+        self.host = host
+        self.port = port
+        self.services = services
+        self.time = seen_time if seen_time is not None else int(time.time())
+        self.attempts = 0
+        self.last_try = 0.0
+        self.tried = False
+
+    @property
+    def key(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def to_dict(self) -> dict:
+        return {"host": self.host, "port": self.port,
+                "services": self.services, "time": self.time,
+                "attempts": self.attempts, "tried": self.tried}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AddrInfo":
+        a = cls(d["host"], int(d["port"]), int(d.get("services", 1)),
+                int(d.get("time", 0)))
+        a.attempts = int(d.get("attempts", 0))
+        a.tried = bool(d.get("tried", False))
+        return a
+
+
+# horizon/retry limits (addrman.h ADDRMAN_* constants)
+HORIZON_DAYS = 30
+MAX_RETRIES = 3
+MAX_ADDRESSES = 1000  # per getaddr reply (MAX_ADDR_TO_SEND, net.h)
+# total table bound (Core bounds via 1024 new + 256 tried buckets × 64);
+# overflow evicts random untried entries so a hostile peer can't grow the
+# table or peers.json without limit
+MAX_TABLE_SIZE = 4096
+
+
+class AddrMan:
+    def __init__(self):
+        self.addrs: dict[str, AddrInfo] = {}
+        self._rng = random.Random()
+
+    def __len__(self) -> int:
+        return len(self.addrs)
+
+    def add(self, host: str, port: int, services: int = 1,
+            seen_time: Optional[int] = None) -> bool:
+        """CAddrMan::Add — new address into the 'new' side; refreshes the
+        timestamp of a known one."""
+        info = AddrInfo(host, port, services, seen_time)
+        cur = self.addrs.get(info.key)
+        if cur is None:
+            if len(self.addrs) >= MAX_TABLE_SIZE:
+                untried = [k for k, a in self.addrs.items() if not a.tried]
+                if not untried:
+                    return False  # table full of good peers: drop the new one
+                self.addrs.pop(self._rng.choice(untried))
+            self.addrs[info.key] = info
+            return True
+        cur.time = max(cur.time, info.time)
+        cur.services |= services
+        return False
+
+    def attempt(self, host: str, port: int) -> None:
+        cur = self.addrs.get(f"{host}:{port}")
+        if cur is not None:
+            cur.attempts += 1
+            cur.last_try = time.time()
+
+    def good(self, host: str, port: int) -> None:
+        """CAddrMan::Good — successful handshake moves it to 'tried'."""
+        cur = self.addrs.get(f"{host}:{port}")
+        if cur is None:
+            cur = AddrInfo(host, port)
+            self.addrs[cur.key] = cur
+        cur.tried = True
+        cur.attempts = 0
+        cur.time = int(time.time())
+
+    def select(self, exclude: Optional[set[str]] = None) -> Optional[AddrInfo]:
+        """CAddrMan::Select — pick a dial candidate, preferring tried,
+        skipping recently failed and excluded (connected) addresses."""
+        exclude = exclude or set()
+        now = time.time()
+        candidates = [
+            a for a in self.addrs.values()
+            if a.key not in exclude
+            and a.attempts <= MAX_RETRIES
+            and now - a.last_try > 10 * min(a.attempts + 1, 6)
+        ]
+        if not candidates:
+            return None
+        tried = [a for a in candidates if a.tried]
+        pool = tried if tried and self._rng.random() < 0.5 else candidates
+        return self._rng.choice(pool)
+
+    def addresses(self, max_count: int = MAX_ADDRESSES) -> list[AddrInfo]:
+        """GetAddr: a random sample for getaddr replies, fresh ones only."""
+        horizon = time.time() - HORIZON_DAYS * 86400
+        fresh = [a for a in self.addrs.values() if a.time > horizon]
+        self._rng.shuffle(fresh)
+        return fresh[:max_count]
+
+    # -- persistence (peers.dat role; json like the wallet/mempool) ------
+
+    def save(self, path: str) -> None:
+        tmp = path + ".new"
+        with open(tmp, "w") as f:
+            json.dump({"version": 1,
+                       "addrs": [a.to_dict() for a in self.addrs.values()]},
+                      f)
+        os.replace(tmp, path)
+
+    def load(self, path: str) -> int:
+        if not os.path.exists(path):
+            return 0
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+            for d in payload.get("addrs", []):
+                a = AddrInfo.from_dict(d)
+                self.addrs[a.key] = a
+        except (OSError, ValueError, KeyError):
+            return 0  # corrupt peers file must never stop the node
+        return len(self.addrs)

@@ -14,6 +14,7 @@ Misbehaving → disconnect, like the reference's ban-score discharge.
 from __future__ import annotations
 
 import asyncio
+import os
 import secrets
 import struct
 import threading
@@ -163,6 +164,16 @@ class CConnman:
         # RELAY_TX_CACHE_TIME so getdata can be served after the tx leaves
         # the mempool (e.g. it was just mined)
         self._relay_memory: dict[bytes, tuple[CTransaction, float]] = {}
+        # CAddrMan + peers.dat (src/addrman.cpp, net.cpp DumpAddresses)
+        from .addrman import AddrMan
+
+        self.addrman = AddrMan()
+        self._peers_path = os.path.join(node.datadir, "peers.json")
+        n_loaded = self.addrman.load(self._peers_path)
+        if n_loaded:
+            log_print("net", "loaded %d addresses from peers.json", n_loaded)
+        # ThreadOpenConnections: target outbound count when auto-dialing
+        self.max_outbound = 8
 
     # -- lifecycle ------------------------------------------------------
 
@@ -179,6 +190,7 @@ class CConnman:
         if self.listen_port:  # 0 = -listen=0 (outbound only)
             self.loop.run_until_complete(self._start_server())
         self.loop.create_task(self._keepalive_loop())
+        self.loop.create_task(self._open_connections_loop())
         self._started.set()
         self.loop.run_forever()
         # drain: close transports
@@ -193,10 +205,11 @@ class CConnman:
         while True:
             await asyncio.sleep(PING_INTERVAL)
             now = time.time()
-            # expire mapRelay entries past their retention
-            self._relay_memory = {
-                h: v for h, v in self._relay_memory.items() if v[1] > now
-            }
+            # expire mapRelay entries in place — RPC threads insert into
+            # this dict concurrently, so never rebind it
+            for h, v in list(self._relay_memory.items()):
+                if v[1] <= now:
+                    self._relay_memory.pop(h, None)
             for peer in list(self.peers.values()):
                 quiet = now - max(peer.last_recv, peer.connected_at)
                 if quiet > TIMEOUT_INTERVAL:
@@ -229,6 +242,10 @@ class CConnman:
 
         self.loop.call_soon_threadsafe(_shutdown)
         self._thread.join(10)
+        try:
+            self.addrman.save(self._peers_path)  # DumpAddresses
+        except OSError as e:
+            log_printf("peers.json save failed: %r", e)
 
     # -- dialing --------------------------------------------------------
 
@@ -384,6 +401,11 @@ class CConnman:
         # BIP133: tell the peer our relay floor so it doesn't waste invs
         peer.send("feefilter",
                   struct.pack("<Q", self.node.min_relay_fee_rate))
+        if peer.outbound:
+            # handshake success: promote in addrman, harvest its peers
+            host, _, port = peer.addr.rpartition(":")
+            self.addrman.good(host, int(port))
+            peer.send("getaddr")
         # start headers sync (the reference sends getheaders on verack)
         with self.node.cs_main:
             locator = self.node.chainstate.chain.get_locator()
@@ -630,6 +652,46 @@ class CConnman:
 
     # -- BIP152 compact blocks (net_processing.cpp SENDCMPCT/CMPCTBLOCK/
     # GETBLOCKTXN/BLOCKTXN) ----------------------------------------------
+
+    # -- addr gossip (net_processing.cpp ADDR/GETADDR, CAddrMan) ---------
+
+    def _msg_addr(self, peer: Peer, payload: bytes) -> None:
+        from .protocol import deser_addr_entries
+
+        entries = deser_addr_entries(payload)
+        now = int(time.time())
+        for t, services, host, port in entries:
+            if host == "::" or port == 0:
+                continue
+            # clamp absurd timestamps like CAddrMan (10-min penalty skipped)
+            self.addrman.add(host, port, services, min(t, now))
+        log_print("net", "peer=%d addr: %d entries (%d known)",
+                  peer.id, len(entries), len(self.addrman))
+
+    def _msg_getaddr(self, peer: Peer, payload: bytes) -> None:
+        from .protocol import ser_addr_entries
+
+        entries = [
+            (a.time, a.services, a.host, a.port)
+            for a in self.addrman.addresses()
+        ]
+        if entries:
+            peer.send("addr", ser_addr_entries(entries))
+
+    async def _open_connections_loop(self) -> None:
+        """ThreadOpenConnections (net.cpp): keep dialing addrman candidates
+        until the outbound target is met."""
+        while True:
+            await asyncio.sleep(5)
+            outbound = [p for p in self.peers.values() if p.outbound]
+            if len(outbound) >= self.max_outbound:
+                continue
+            connected = {p.addr for p in self.peers.values()}
+            candidate = self.addrman.select(exclude=connected)
+            if candidate is None or self.is_banned(candidate.host):
+                continue
+            self.addrman.attempt(candidate.host, candidate.port)
+            await self._dial(candidate.host, candidate.port)
 
     def _msg_feefilter(self, peer: Peer, payload: bytes) -> None:
         """BIP133: peer's minimum announce feerate (sat/kB)."""

@@ -207,3 +207,44 @@ def deser_ping(payload: bytes) -> int:
     if len(payload) != 8:
         raise NetMessageError("bad ping")
     return struct.unpack("<Q", payload)[0]
+
+
+# ---- addr message (CAddress with time, src/protocol.h) ---------------
+
+
+def ser_addr_entries(entries: list[tuple[int, int, str, int]]) -> bytes:
+    """addr payload: [(time, services, ipv4_host, port), ...]."""
+    out = [ser_compact_size(len(entries))]
+    for t, services, host, port in entries:
+        try:
+            ip4 = bytes(int(x) for x in host.split("."))
+            assert len(ip4) == 4
+        except Exception:
+            ip4 = bytes([127, 0, 0, 1])
+        out.append(struct.pack("<IQ", t & 0xFFFFFFFF, services)
+                   + b"\x00" * 10 + b"\xff\xff" + ip4
+                   + struct.pack(">H", port))
+    return b"".join(out)
+
+
+def deser_addr_entries(payload: bytes) -> list[tuple[int, int, str, int]]:
+    try:
+        r = ByteReader(payload)
+        n = deser_compact_size(r)
+        if n > 1000:  # MAX_ADDR_TO_SEND
+            raise NetMessageError("oversized addr")
+        out = []
+        for _ in range(n):
+            t, services = struct.unpack("<IQ", r.read_bytes(12))
+            ip = r.read_bytes(16)
+            (port,) = struct.unpack(">H", r.read_bytes(2))
+            if ip[:12] == b"\x00" * 10 + b"\xff\xff":  # v4-mapped
+                host = ".".join(str(b) for b in ip[12:])
+            else:
+                host = "::"  # v6 unsupported in this deployment
+            out.append((t, services, host, port))
+        return out
+    except NetMessageError:
+        raise
+    except Exception as e:
+        raise NetMessageError(f"bad addr: {e}") from None

@@ -76,17 +76,20 @@ def addnode(node, params):
 @rpc_method("getaddednodeinfo")
 def getaddednodeinfo(node, params):
     """getaddednodeinfo — the addnode-list with live-connection status
-    (src/rpc/net.cpp getaddednodeinfo)."""
+    (src/rpc/net.cpp getaddednodeinfo). Runs without cs_main: DNS
+    resolution of hostname-form targets can block for seconds and must
+    not stall validation."""
     if node.connman is None:
         return []
-    targets = node.connman.added_nodes
+    with node.cs_main:
+        targets = list(node.connman.added_nodes)
+        peers = {p.addr: p for p in list(node.connman.peers.values())}
     if params and params[-1] and isinstance(params[-1], str):
         if params[-1] not in targets:
             raise RPCError(-24, "Error: Node has not been added.")
         targets = [params[-1]]
     import socket as _socket
 
-    peers = {p.addr: p for p in node.connman.peers.values()}
     out = []
     for t in targets:
         # resolve a hostname-form target so it matches peer.addr, which
@@ -106,6 +109,9 @@ def getaddednodeinfo(node, params):
             }]
         out.append(entry)
     return out
+
+
+getaddednodeinfo.no_cs_main = True
 
 
 @rpc_method("disconnectnode")

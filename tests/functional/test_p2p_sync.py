@@ -489,3 +489,62 @@ def test_feefilter_reject_and_relay_memory():
         code = r.read_bytes(1)[0]
         assert code in (0x10, 0x42)
         s.close()
+
+
+def test_addrman_gossip_and_autodial():
+    """addr gossip: a fake peer advertises node B's address to node A;
+    A's ThreadOpenConnections-analogue auto-dials B. getaddr returns the
+    learned address; peers.json persists it across restart."""
+    import json
+    import os
+
+    from bitcoincashplus_tpu.p2p.protocol import (
+        deser_addr_entries,
+        ser_addr_entries,
+    )
+
+    with FunctionalFramework(num_nodes=2) as f:
+        a, b = f.nodes
+        magic = regtest_params().netmagic
+        assert a.rpc.getconnectioncount() == 0
+        assert b.rpc.getconnectioncount() == 0
+
+        # fake peer tells A about B
+        s = socket.create_connection(("127.0.0.1", a.p2p_port), timeout=10)
+        s.sendall(pack_message(magic, "version", VersionPayload().serialize()))
+        _read_msg(s)
+        _read_msg(s)
+        s.sendall(pack_message(magic, "verack"))
+        now = int(time.time())
+        s.sendall(pack_message(magic, "addr", ser_addr_entries(
+            [(now, 1, "127.0.0.1", b.p2p_port)]
+        )))
+
+        # A auto-dials B within the open-connections interval
+        wait_until(lambda: b.rpc.getconnectioncount() >= 1, timeout=30)
+        wait_until(lambda: a.rpc.getconnectioncount() >= 2, timeout=30)
+
+        # getaddr harvest: ask A for its addresses — B's must be there
+        s.sendall(pack_message(magic, "getaddr"))
+        got = None
+        deadline = time.time() + 15
+        while time.time() < deadline and got is None:
+            header, payload = _read_msg(s)
+            if header[4:16].rstrip(b"\x00") == b"addr":
+                got = deser_addr_entries(payload)
+        assert got is not None
+        assert any(h == "127.0.0.1" and p == b.p2p_port
+                   for _t, _s, h, p in got)
+        s.close()
+
+        # peers.json persists the learned address across restart
+        a.stop()
+        peers_path = os.path.join(a.datadir, "peers.json")
+        assert os.path.exists(peers_path)
+        with open(peers_path) as fh:
+            saved = json.load(fh)
+        assert any(d["host"] == "127.0.0.1" and d["port"] == b.p2p_port
+                   for d in saved["addrs"])
+        a.start()
+        # the reloaded addrman re-dials B without any hint
+        wait_until(lambda: b.rpc.getconnectioncount() >= 1, timeout=30)

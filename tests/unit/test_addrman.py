@@ -1,0 +1,89 @@
+"""AddrMan — lifecycle, selection, persistence + addr wire codec
+(src/test/addrman_tests.cpp analogues at the collapsed-table level)."""
+
+import time
+
+from bitcoincashplus_tpu.p2p.addrman import AddrMan
+from bitcoincashplus_tpu.p2p.protocol import (
+    deser_addr_entries,
+    ser_addr_entries,
+)
+
+
+class TestAddrMan:
+    def test_add_and_dedup(self):
+        am = AddrMan()
+        assert am.add("10.0.0.1", 8333) is True
+        assert am.add("10.0.0.1", 8333) is False  # refresh, not new
+        assert am.add("10.0.0.1", 8334) is True  # different port = new
+        assert len(am) == 2
+
+    def test_good_promotes_to_tried(self):
+        am = AddrMan()
+        am.add("10.0.0.1", 8333)
+        am.addrs["10.0.0.1:8333"].attempts = 2
+        am.good("10.0.0.1", 8333)
+        a = am.addrs["10.0.0.1:8333"]
+        assert a.tried and a.attempts == 0
+
+    def test_select_excludes_connected_and_failed(self):
+        am = AddrMan()
+        am.add("10.0.0.1", 1)
+        am.add("10.0.0.2", 2)
+        # exhausted retries never selected
+        am.addrs["10.0.0.1:1"].attempts = 10
+        for _ in range(20):
+            got = am.select()
+            assert got is not None and got.key == "10.0.0.2:2"
+        assert am.select(exclude={"10.0.0.2:2"}) is None
+
+    def test_recent_failure_backoff(self):
+        am = AddrMan()
+        am.add("10.0.0.1", 1)
+        am.attempt("10.0.0.1", 1)
+        assert am.select() is None  # just failed: in backoff
+        am.addrs["10.0.0.1:1"].last_try = time.time() - 3600
+        assert am.select() is not None
+
+    def test_persistence_roundtrip(self, tmp_path):
+        am = AddrMan()
+        am.add("10.0.0.1", 8333, services=5)
+        am.good("10.0.0.1", 8333)
+        am.add("192.168.1.9", 18444)
+        path = str(tmp_path / "peers.json")
+        am.save(path)
+        am2 = AddrMan()
+        assert am2.load(path) == 2
+        a = am2.addrs["10.0.0.1:8333"]
+        assert a.tried and a.services == 5
+        assert not am2.addrs["192.168.1.9:18444"].tried
+
+    def test_corrupt_file_tolerated(self, tmp_path):
+        path = str(tmp_path / "peers.json")
+        with open(path, "w") as f:
+            f.write("{ not json")
+        assert AddrMan().load(path) == 0
+
+    def test_addresses_sample_is_fresh(self):
+        am = AddrMan()
+        am.add("10.0.0.1", 1, seen_time=int(time.time()))
+        am.add("10.0.0.2", 2, seen_time=100)  # decades stale
+        got = am.addresses()
+        assert [a.key for a in got] == ["10.0.0.1:1"]
+
+
+class TestAddrCodec:
+    def test_roundtrip(self):
+        entries = [(1_700_000_000, 1, "127.0.0.1", 18444),
+                   (1_700_000_100, 9, "10.1.2.3", 8333)]
+        back = deser_addr_entries(ser_addr_entries(entries))
+        assert back == entries
+
+    def test_oversized_rejected(self):
+        import pytest
+
+        from bitcoincashplus_tpu.consensus.serialize import ser_compact_size
+        from bitcoincashplus_tpu.p2p.protocol import NetMessageError
+
+        with pytest.raises(NetMessageError):
+            deser_addr_entries(ser_compact_size(50_000))
