@@ -45,7 +45,8 @@ class AddrInfo:
     def from_dict(cls, d: dict) -> "AddrInfo":
         a = cls(d["host"], int(d["port"]), int(d.get("services", 1)),
                 int(d.get("time", 0)))
-        a.attempts = int(d.get("attempts", 0))
+        # attempts deliberately reset: a restart gives every stored
+        # address a fresh chance (the failure history was this-session)
         a.tried = bool(d.get("tried", False))
         return a
 
@@ -107,10 +108,13 @@ class AddrMan:
         skipping recently failed and excluded (connected) addresses."""
         exclude = exclude or set()
         now = time.time()
+        # IsTerrible is time-windowed in the reference, not permanent:
+        # past MAX_RETRIES an address still gets another chance once an
+        # hour, so a transiently-down peer is eventually redialed
         candidates = [
             a for a in self.addrs.values()
             if a.key not in exclude
-            and a.attempts <= MAX_RETRIES
+            and (a.attempts <= MAX_RETRIES or now - a.last_try > 3600)
             and now - a.last_try > 10 * min(a.attempts + 1, 6)
         ]
         if not candidates:

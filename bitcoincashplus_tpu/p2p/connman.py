@@ -691,7 +691,14 @@ class CConnman:
             if candidate is None or self.is_banned(candidate.host):
                 continue
             self.addrman.attempt(candidate.host, candidate.port)
-            await self._dial(candidate.host, candidate.port)
+            try:
+                # bound the TCP connect so one black-holed advertised
+                # address can't stall the dial loop for minutes
+                await asyncio.wait_for(
+                    self._dial(candidate.host, candidate.port), timeout=10)
+            except asyncio.TimeoutError:
+                log_print("net", "dial %s:%d timed out",
+                          candidate.host, candidate.port)
 
     def _msg_feefilter(self, peer: Peer, payload: bytes) -> None:
         """BIP133: peer's minimum announce feerate (sat/kB)."""

@@ -218,7 +218,8 @@ def ser_addr_entries(entries: list[tuple[int, int, str, int]]) -> bytes:
     for t, services, host, port in entries:
         try:
             ip4 = bytes(int(x) for x in host.split("."))
-            assert len(ip4) == 4
+            if len(ip4) != 4:
+                raise ValueError(host)
         except Exception:
             ip4 = bytes([127, 0, 0, 1])
         out.append(struct.pack("<IQ", t & 0xFFFFFFFF, services)

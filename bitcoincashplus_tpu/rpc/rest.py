@@ -76,6 +76,8 @@ def handle_rest(node, path: str) -> tuple[int, str, bytes]:
     if parts[0] == "chaininfo.json":
         with node.cs_main:
             return _json(getblockchaininfo(node, []))
+    if parts[0].startswith("getutxos"):
+        return _rest_getutxos(node, parts)
     if parts[0] == "mempool" and len(parts) == 2:
         if parts[1] == "info.json":
             with node.cs_main:
@@ -178,3 +180,59 @@ def _rest_blockhash_by_height(node, parts):
     if fmt == "hex":
         return 200, "text/plain", (hash_to_hex(idx.hash) + "\n").encode()
     return _json({"blockhash": hash_to_hex(idx.hash)})
+
+
+def _rest_getutxos(node, parts):
+    """GET /rest/getutxos[/checkmempool]/<txid>-<n>/....json — UTXO query
+    (src/rest.cpp rest_getutxos). JSON output form only."""
+    from ..consensus.tx import COutPoint
+    from .rawtransaction import script_pubkey_json
+
+    args = list(parts)
+    args[-1], fmt = _split_format(args[-1])
+    if fmt != "json":
+        raise RestError(400, "getutxos supports .json only")
+    check_mempool = len(args) > 1 and args[1] == "checkmempool"
+    outpoint_parts = args[(2 if check_mempool else 1):]
+    if not outpoint_parts or len(outpoint_parts) > 15:  # MAX_GETUTXOS_OUTPOINTS
+        raise RestError(400, "expected 1-15 <txid>-<n> outpoints")
+    outpoints = []
+    for op in outpoint_parts:
+        try:
+            txid_hex, n = op.rsplit("-", 1)
+            outpoints.append(COutPoint(_parse_hash(txid_hex), int(n)))
+        except (ValueError, RestError):
+            raise RestError(400, f"bad outpoint {op!r}") from None
+    with node.cs_main:
+        tip = node.chainstate.tip()
+        bitmap = []
+        utxos = []
+        for op in outpoints:
+            coin = node.chainstate.coins.get_coin(op)
+            spent_in_pool = (check_mempool
+                            and node.mempool.get_spender(op) is not None)
+            if coin is None and check_mempool:
+                out = node.mempool.get_output(op)
+                if out is not None and not spent_in_pool:
+                    bitmap.append(1)
+                    utxos.append({
+                        "height": 0x7FFFFFFF,
+                        "value": out.value / 1e8,
+                        "scriptPubKey": script_pubkey_json(node, out.script_pubkey),
+                    })
+                    continue
+            if coin is None or spent_in_pool:
+                bitmap.append(0)
+                continue
+            bitmap.append(1)
+            utxos.append({
+                "height": coin.height,
+                "value": coin.out.value / 1e8,
+                "scriptPubKey": script_pubkey_json(node, coin.out.script_pubkey),
+            })
+        return _json({
+            "chainHeight": tip.height,
+            "chaintipHash": hash_to_hex(tip.hash),
+            "bitmap": "".join(str(b) for b in bitmap),
+            "utxos": utxos,
+        })

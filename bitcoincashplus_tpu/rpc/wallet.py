@@ -38,7 +38,8 @@ def _wallet(node):
 def getnewaddress(node, params):
     require_params(params, 0, 1, "getnewaddress ( \"account\" )")
     try:
-        return _wallet(node).get_new_address()
+        return _wallet(node).get_new_address(
+            str(params[0]) if params and params[0] else "")
     except WalletError as e:
         raise RPCError(RPC_WALLET_UNLOCK_NEEDED, str(e)) from None
 
@@ -657,3 +658,203 @@ def _parse_multisig_params(node, wallet, params):
                            f"Invalid public key: {item}")
         pubkeys.append(pk)
     return m, multisig_script(m, pubkeys)
+
+
+# ---- legacy accounts API (rpcwallet.cpp, deprecated in later lineages
+# but part of this one's surface). Account balance here = unspent coins
+# held by the account's labelled addresses + `move` deltas — the
+# reference's full debit/credit history bookkeeping collapsed to its
+# steady-state observable. ----
+
+
+def _address_of_coin(node, coin):
+    from ..wallet.keys import script_to_address
+
+    return script_to_address(coin.txout.script_pubkey, node.params)
+
+
+def _account_balances(node, w, include_watch_only: bool = False) -> dict:
+    tip = node.chainstate.tip().height
+    out = {"": 0}
+    for acct in set(w.labels.values()) | set(w.account_moves):
+        out.setdefault(acct, 0)
+    for coin in w.available_coins(tip, include_watch_only=include_watch_only):
+        addr = _address_of_coin(node, coin)
+        acct = w.labels.get(addr, "") if addr else ""
+        out[acct] = out.get(acct, 0) + coin.txout.value
+    for acct, delta in w.account_moves.items():
+        out[acct] = out.get(acct, 0) + delta
+        out[""] = out.get("", 0) - delta
+    return out
+
+
+@rpc_method("getaccount")
+def getaccount(node, params):
+    require_params(params, 1, 1, "getaccount \"address\"")
+    return _wallet(node).labels.get(str(params[0]), "")
+
+
+@rpc_method("setaccount")
+def setaccount(node, params):
+    require_params(params, 2, 2, "setaccount \"address\" \"account\"")
+    from ..wallet.keys import address_to_script
+
+    if address_to_script(str(params[0]), node.params) is None:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY, "Invalid address")
+    w = _wallet(node)
+    w.labels[str(params[0])] = str(params[1])
+    w.save()
+    return None
+
+
+@rpc_method("getaccountaddress")
+def getaccountaddress(node, params):
+    """getaccountaddress "account" — a stable receiving address per
+    account (fresh one on first use)."""
+    require_params(params, 1, 1, "getaccountaddress \"account\"")
+    account = str(params[0])
+    w = _wallet(node)
+    addr = w.account_addresses.get(account)
+    if addr is not None:
+        return addr
+    try:
+        addr = w.get_new_address(account)
+    except WalletError as e:
+        raise RPCError(RPC_WALLET_UNLOCK_NEEDED, str(e)) from None
+    w.account_addresses[account] = addr
+    w.save()
+    return addr
+
+
+@rpc_method("getaddressesbyaccount")
+def getaddressesbyaccount(node, params):
+    require_params(params, 1, 1, "getaddressesbyaccount \"account\"")
+    w = _wallet(node)
+    account = str(params[0])
+    return sorted(a for a, acct in w.labels.items() if acct == account)
+
+
+@rpc_method("listaccounts")
+def listaccounts(node, params):
+    """listaccounts ( minconf includeWatchonly ) — watch-only coins count
+    only with the explicit flag, like the reference."""
+    include_watch = bool(params[1]) if len(params) > 1 else False
+    w = _wallet(node)
+    return {acct: bal / COIN
+            for acct, bal in _account_balances(node, w, include_watch).items()}
+
+
+@rpc_method("getreceivedbyaccount")
+def getreceivedbyaccount(node, params):
+    require_params(params, 1, 2, "getreceivedbyaccount \"account\" ( minconf )")
+    from ..wallet.keys import address_to_script
+
+    account = str(params[0])
+    minconf = int(params[1]) if len(params) > 1 else 1
+    w = _wallet(node)
+    tip = node.chainstate.tip().height
+    received = _received_by_spk(w, minconf, tip)
+    total = 0
+    for addr, acct in w.labels.items():
+        if acct == account:
+            spk = address_to_script(addr, node.params)
+            if spk is not None:
+                total += received.get(spk, 0)
+    return total / COIN
+
+
+@rpc_method("move")
+def move(node, params):
+    """move "fromaccount" "toaccount" amount — internal bookkeeping only."""
+    require_params(params, 3, 5, "move \"fromaccount\" \"toaccount\" amount")
+    w = _wallet(node)
+    amount = int(round(float(params[2]) * COIN))
+    src, dst = str(params[0]), str(params[1])
+    w.account_moves[src] = w.account_moves.get(src, 0) - amount
+    w.account_moves[dst] = w.account_moves.get(dst, 0) + amount
+    # "" is the implicit default account; drop zero entries
+    for acct in (src, dst):
+        if w.account_moves.get(acct) == 0:
+            w.account_moves.pop(acct, None)
+    w.save()
+    return True
+
+
+@rpc_method("sendfrom")
+def sendfrom(node, params):
+    """sendfrom "account" "address" amount — spends from the shared pool
+    like the reference (accounts never restricted coin selection) and
+    debits the account."""
+    require_params(params, 3, 6, "sendfrom \"account\" \"toaddress\" amount")
+    RPC_WALLET_INSUFFICIENT_FUNDS = -6
+    account = str(params[0])
+    amount = int(round(float(params[2]) * COIN))
+    fee = _wallet_fee(node)
+    w = _wallet(node)
+    if _account_balances(node, w).get(account, 0) < amount + fee:
+        raise RPCError(RPC_WALLET_INSUFFICIENT_FUNDS,
+                       "Account has insufficient funds")
+    txid = sendtoaddress(node, [params[1], params[2]])
+    w.account_moves[account] = (
+        w.account_moves.get(account, 0) - amount - fee)
+    w.save()
+    return txid
+
+
+# ---- watch-only imports (rpcdump.cpp importaddress/importpubkey) ----
+
+
+@rpc_method("importaddress")
+def importaddress(node, params):
+    """importaddress "address-or-script" ( "label" rescan )"""
+    require_params(params, 1, 3, "importaddress \"address\" ( \"label\" rescan )")
+    from ..wallet.keys import address_to_script
+
+    w = _wallet(node)
+    target = str(params[0])
+    spk = address_to_script(target, node.params)
+    if spk is None:
+        try:
+            spk = bytes.fromhex(target)  # raw script form
+        except ValueError:
+            raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                           "Invalid address or script") from None
+    w.watched_scripts.add(spk)
+    if len(params) > 1 and params[1]:
+        w.labels[target] = str(params[1])
+    w.save()
+    rescan = bool(params[2]) if len(params) > 2 else True
+    if rescan:
+        node._rescan_wallet()
+    return None
+
+
+@rpc_method("importpubkey")
+def importpubkey(node, params):
+    """importpubkey "pubkey" ( "label" rescan ) — watch P2PK + P2PKH."""
+    require_params(params, 1, 3, "importpubkey \"pubkey\" ( \"label\" rescan )")
+    from ..crypto.secp256k1 import pubkey_parse
+    from ..script.script import p2pk_script, p2pkh_script_for_pubkey
+
+    try:
+        pk = bytes.fromhex(str(params[0]))
+    except ValueError:
+        pk = b""
+    if not pk or pubkey_parse(pk) is None:
+        raise RPCError(RPC_INVALID_ADDRESS_OR_KEY,
+                       "Pubkey must be a valid hex public key")
+    w = _wallet(node)
+    w.watched_scripts.add(p2pk_script(pk))
+    w.watched_scripts.add(p2pkh_script_for_pubkey(pk))
+    if len(params) > 1 and params[1]:
+        from ..crypto.hashes import hash160
+        from ..crypto.base58 import b58check_encode
+
+        addr = b58check_encode(
+            bytes([node.params.pubkey_addr_prefix]) + hash160(pk))
+        w.labels[addr] = str(params[1])
+    w.save()
+    rescan = bool(params[2]) if len(params) > 2 else True
+    if rescan:
+        node._rescan_wallet()
+    return None

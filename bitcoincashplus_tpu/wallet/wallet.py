@@ -60,6 +60,11 @@ class Wallet:
         self.locked_coins: set[COutPoint] = set()
         # addmultisigaddress/importaddress watch-only scripts (CScript set)
         self.watched_scripts: set[bytes] = set()
+        # legacy accounts API (mapAddressBook labels + `move` deltas)
+        self.labels: dict[str, str] = {}  # address -> account name
+        self.account_moves: dict[str, int] = {}  # account -> moved satoshis
+        # getaccountaddress's stable per-account receiving address
+        self.account_addresses: dict[str, str] = {}
         # CCryptoKeyStore state: pubkey -> (ciphertext, compressed). The
         # pkh index survives Lock so IsMine keeps answering while locked.
         self.master_key_record: Optional[MasterKey] = None
@@ -226,10 +231,14 @@ class Wallet:
             self.key_paths[key.pubkey] = f"m/0'/0'/{i}'"
             return key
 
-    def get_new_address(self) -> str:
+    def get_new_address(self, account: str = "") -> str:
         key = self.derive_new_key()
         self.add_key(key)
-        return key.p2pkh_address(self.params)
+        addr = key.p2pkh_address(self.params)
+        if account:
+            self.labels[addr] = account
+            self.save()
+        return addr
 
     # -- persistence (wallet.dat role) --
 
@@ -265,6 +274,12 @@ class Wallet:
             payload["watched_scripts"] = [
                 s.hex() for s in self.watched_scripts
             ]
+        if self.labels:
+            payload["labels"] = dict(self.labels)
+        if self.account_moves:
+            payload["account_moves"] = dict(self.account_moves)
+        if self.account_addresses:
+            payload["account_addresses"] = dict(self.account_addresses)
         tmp = self.path + ".tmp"
         # 0600: the plaintext form carries WIF keys (same treatment as the
         # RPC .cookie); encrypted form too — no reason to leak either
@@ -305,6 +320,9 @@ class Wallet:
         self.watched_scripts = {
             bytes.fromhex(s) for s in payload.get("watched_scripts", [])
         }
+        self.labels = dict(payload.get("labels", {}))
+        self.account_moves = dict(payload.get("account_moves", {}))
+        self.account_addresses = dict(payload.get("account_addresses", {}))
 
     def key_for_id(self, ident: bytes) -> Optional[CKey]:
         """Solver callback: 20-byte pubkey hash or raw pubkey."""

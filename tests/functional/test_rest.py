@@ -93,3 +93,101 @@ def test_rest_disabled_is_403():
                              extra_args=[["-listen=0"]]) as f:
         node = f.nodes[0]
         assert _get_status(node, "/rest/chaininfo.json") == 403
+
+
+def test_rest_getutxos():
+    """/rest/getutxos (+checkmempool): bitmap + utxo rows, mempool-spent
+    awareness (src/rest.cpp rest_getutxos)."""
+    import json
+
+    with FunctionalFramework(
+        num_nodes=1, extra_args=[["-rest", "-txindex", "-listen=0"]],
+    ) as f:
+        node = f.nodes[0]
+        addr = node.rpc.getnewaddress()
+        node.rpc.generatetoaddress(101, addr)
+        cb1 = node.rpc.getblock(node.rpc.getblockhash(1), 2)["tx"][0]
+
+        # unspent coinbase output
+        status, body = _get(node, f"/rest/getutxos/{cb1['txid']}-0.json")
+        out = json.loads(body)
+        assert out["bitmap"] == "1"
+        assert out["utxos"][0]["value"] == 50.0
+        assert out["chainHeight"] == 101
+
+        # missing outpoint → 0 bitmap
+        status, body = _get(node, f"/rest/getutxos/{cb1['txid']}-7.json")
+        assert json.loads(body)["bitmap"] == "0"
+
+        # a mempool spend flips it only under checkmempool
+        txid = node.rpc.sendtoaddress(addr, 1.0)
+        tx = node.rpc.getrawtransaction(txid, True)
+        spent_in = tx["vin"][0]
+        op = f"{spent_in['txid']}-{spent_in['vout']}"
+        status, body = _get(node, f"/rest/getutxos/{op}.json")
+        assert json.loads(body)["bitmap"] == "1"  # still unspent on-chain
+        status, body = _get(node, f"/rest/getutxos/checkmempool/{op}.json")
+        assert json.loads(body)["bitmap"] == "0"  # spent by the pool tx
+        # the pool tx's own outputs are visible under checkmempool
+        status, body = _get(node, f"/rest/getutxos/checkmempool/{txid}-0.json")
+        out = json.loads(body)
+        assert out["bitmap"] == "1" and out["utxos"][0]["height"] == 0x7FFFFFFF
+
+        # malformed outpoint
+        assert _get_status(node, "/rest/getutxos/zzzz-0.json") == 400
+
+
+def test_accounts_api_and_watchonly_imports():
+    """Legacy accounts surface + importaddress/importpubkey watch-only."""
+    from bitcoincashplus_tpu.wallet.keys import CKey
+
+    with FunctionalFramework(num_nodes=1,
+                             extra_args=[["-listen=0"]]) as f:
+        node = f.nodes[0]
+        default_addr = node.rpc.getnewaddress()
+        node.rpc.generatetoaddress(101, default_addr)
+
+        # account-labelled address receives; listaccounts splits balances
+        acct_addr = node.rpc.getnewaddress("savings")
+        assert node.rpc.getaccount(acct_addr) == "savings"
+        assert acct_addr in node.rpc.getaddressesbyaccount("savings")
+        # getaccountaddress is stable across calls
+        stable = node.rpc.getaccountaddress("savings")
+        assert node.rpc.getaccountaddress("savings") == stable
+        assert node.rpc.getaccount(stable) == "savings"
+        node.rpc.sendtoaddress(acct_addr, 2.0)
+        node.rpc.generatetoaddress(1, default_addr)
+        accounts = node.rpc.listaccounts()
+        assert accounts["savings"] == 2.0
+        assert node.rpc.getreceivedbyaccount("savings") == 2.0
+
+        # move shifts bookkeeping between accounts
+        node.rpc.move("savings", "spending", 0.5)
+        accounts = node.rpc.listaccounts()
+        assert accounts["savings"] == 1.5
+        assert accounts["spending"] == 0.5
+
+        # setaccount relabels
+        node.rpc.setaccount(acct_addr, "renamed")
+        assert node.rpc.getaccount(acct_addr) == "renamed"
+
+        # importaddress: foreign address becomes watch-only
+        foreign = CKey(0xFEED).p2pkh_address(
+            __import__("bitcoincashplus_tpu.consensus.params",
+                       fromlist=["regtest_params"]).regtest_params())
+        node.rpc.importaddress(foreign, "watched")
+        node.rpc.sendtoaddress(foreign, 3.0)
+        node.rpc.generatetoaddress(1, default_addr)
+        rows = [u for u in node.rpc.listunspent() if not u["spendable"]]
+        assert any(abs(u["amount"] - 3.0) < 1e-9 for u in rows)
+
+        # importpubkey: watch both P2PK and P2PKH forms
+        k = CKey(0xBEAD)
+        node.rpc.importpubkey(k.pubkey.hex())
+        node.rpc.sendtoaddress(
+            k.p2pkh_address(__import__(
+                "bitcoincashplus_tpu.consensus.params",
+                fromlist=["regtest_params"]).regtest_params()), 1.5)
+        node.rpc.generatetoaddress(1, default_addr)
+        rows = [u for u in node.rpc.listunspent() if not u["spendable"]]
+        assert any(abs(u["amount"] - 1.5) < 1e-9 for u in rows)

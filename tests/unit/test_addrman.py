@@ -30,12 +30,16 @@ class TestAddrMan:
         am = AddrMan()
         am.add("10.0.0.1", 1)
         am.add("10.0.0.2", 2)
-        # exhausted retries never selected
+        # exhausted retries with a recent failure: not selected...
         am.addrs["10.0.0.1:1"].attempts = 10
+        am.addrs["10.0.0.1:1"].last_try = time.time() - 60
         for _ in range(20):
             got = am.select()
             assert got is not None and got.key == "10.0.0.2:2"
         assert am.select(exclude={"10.0.0.2:2"}) is None
+        # ...but the cutoff is time-windowed, not permanent (IsTerrible)
+        am.addrs["10.0.0.1:1"].last_try = time.time() - 7200
+        assert am.select(exclude={"10.0.0.2:2"}).key == "10.0.0.1:1"
 
     def test_recent_failure_backoff(self):
         am = AddrMan()
