@@ -108,7 +108,14 @@ class Node:
         os.makedirs(blocks_dir, exist_ok=True)
         self._index_kv = KVStore(index_path)
         self._coins_kv = KVStore(coins_path)
-        self.block_store = BlockStore(self.datadir, self.params.netmagic)
+        # -maxblockfilesize: test/debug knob for block-file rotation (the
+        # reference's MAX_BLOCKFILE_SIZE constant) — lets functional tests
+        # exercise pruning without writing 128 MiB of chain
+        self.block_store = BlockStore(
+            self.datadir, self.params.netmagic,
+            max_file_size=config.get_int("maxblockfilesize",
+                                         128 * 1024 * 1024),
+        )
         self.index_db = BlockIndexDB(self._index_kv)
         self.coins_db = CoinsDB(self._coins_kv)
 
@@ -156,7 +163,15 @@ class Node:
 
         self.flush_interval = config.get_int("flushinterval", DEFAULT_FLUSH_INTERVAL)
         self._blocks_since_flush = 0
+        # -prune: 0 = off, 1 = manual (pruneblockchain RPC), >1 = target MB
+        prune_arg = config.get_int("prune", 0)
+        self.prune_mode = prune_arg > 0
+        self.prune_target_bytes = prune_arg * 1_000_000 if prune_arg > 1 else 0
+        stored_ph = self._index_kv.get(b"Fpruneheight")
+        self.prune_height = int(stored_ph) if stored_ph else 0
         self.txindex = config.get_bool("txindex")
+        if self.txindex and self.prune_mode:
+            raise InitError("Prune mode is incompatible with -txindex.")
         if self.txindex:
             self._build_txindex()
         self.chainstate.flush()  # persist the (possibly fresh) index/genesis
@@ -218,6 +233,8 @@ class Node:
         if self._blocks_since_flush >= self.flush_interval:
             self.chainstate.flush()
             self._blocks_since_flush = 0
+            if self.prune_mode:
+                self.auto_prune()
         # -blocknotify=<cmd>: run the shell hook with %s = new block hash
         # (init.cpp BlockNotifyCallback); fire-and-forget, never blocks
         # validation, only on the active tip like the reference
@@ -421,6 +438,70 @@ class Node:
             n_file += 1
         self.chainstate.flush()
         return n_imported
+
+    # -- pruning (-prune / pruneblockchain) -----------------------------
+
+    MIN_BLOCKS_TO_KEEP = 288  # validation.h MIN_BLOCKS_TO_KEEP
+
+    def prune_block_files(self, prune_height: int, stop_when=None) -> int:
+        """FindFilesToPrune + UnlinkPrunedFiles (src/validation.cpp):
+        delete whole block files whose every block sits below
+        prune_height, clearing HAVE_DATA/HAVE_UNDO on their index rows.
+        ``stop_when()`` (checked after each pruned file) lets the -prune
+        target mode stop as soon as usage is back under budget instead of
+        shedding everything prunable. Returns the number of files pruned.
+        Caller holds cs_main."""
+        store = self.block_store
+        if not hasattr(store, "prune_file"):
+            return 0  # memory-backed store (tests)
+        cs = self.chainstate
+        prune_height = min(prune_height,
+                           cs.tip().height - self.MIN_BLOCKS_TO_KEEP)
+        pruned = 0
+        for n in range(store._cur_file):
+            hashes = store.blocks_in_file(n)
+            if not hashes:
+                continue
+            heights = [cs.block_index[h].height
+                       for h in hashes if h in cs.block_index]
+            if not heights or max(heights) >= prune_height:
+                continue
+            for h in store.prune_file(n):
+                idx = cs.block_index.get(h)
+                if idx is not None:
+                    idx.status &= ~(BlockStatus.HAVE_DATA
+                                    | BlockStatus.HAVE_UNDO)
+                    cs._dirty_index.add(idx)
+            pruned += 1
+            if stop_when is not None and stop_when():
+                break
+        if pruned:
+            self._set_prune_height(max(self.prune_height, prune_height))
+            cs.flush()
+            log_printf("pruned %d block file(s) below height %d",
+                       pruned, prune_height)
+        return pruned
+
+    def _set_prune_height(self, height: int) -> None:
+        self.prune_height = height
+        # survive restarts so pruneblockchain/getblockchaininfo stay right
+        self._index_kv.write_batch({b"Fpruneheight": str(height).encode()})
+
+    def auto_prune(self) -> None:
+        """-prune=<MB> target mode: shed the OLDEST files until usage is
+        back under the target (FindFilesToPrune stops at the budget — it
+        never strips the chain down to the 288-block floor)."""
+        if self.prune_target_bytes <= 0:
+            return
+        store = self.block_store
+        if not hasattr(store, "file_usage"):
+            return
+        if store.file_usage() > self.prune_target_bytes:
+            self.prune_block_files(
+                self.chainstate.tip().height,
+                stop_when=lambda: store.file_usage()
+                <= self.prune_target_bytes,
+            )
 
     # -- txindex (-txindex) --------------------------------------------
 

@@ -33,14 +33,6 @@ from .sha256 import sha256d_64
 PAD_LANES = 128  # one VPU lane row; keeps distinct compiled shapes ~O(log n)
 
 
-@jax.jit
-def _level_jit(words):
-    """(n_pairs, 16) uint32 pair words -> (n_pairs, 8) parent digest words.
-    jit specializes on the (lane-padded) shape; recompiles are bounded by
-    the number of distinct padded sizes."""
-    return jnp.stack(sha256d_64([words[:, i] for i in range(16)]), axis=-1)
-
-
 def _digests_to_words(digests: np.ndarray) -> np.ndarray:
     """(N, 32) uint8 digests -> (N, 8) uint32 BE words."""
     return digests.reshape(-1, 8, 4).view(">u4").squeeze(-1).astype(np.uint32)
@@ -50,38 +42,67 @@ def _words_to_digests(words: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(words).astype(">u4").view(np.uint8).reshape(-1, 32)
 
 
+@partial(jax.jit, static_argnums=(1,))
+def _tree_reduce_jit(words, n_levels: int, m):
+    """Whole-tree reduction in ONE dispatch.
+
+    words: (2**n_levels, 8) u32 leaf digests, zero-padded past the true
+    count ``m`` (dynamic scalar). Per level the garbage lanes compute
+    harmlessly at full width; the consensus odd-duplication is a masked
+    select (pair i takes left for right when 2i+1 >= m), so the compiled
+    shape depends only on the pow2 bucket — never on the tx count. The
+    mutation flag considers only whole pairs inside the live prefix,
+    matching consensus/merkle.py's check-before-duplicate ordering.
+    """
+    level = [words[:, i] for i in range(8)]  # column-major: 8 arrays (B,)
+    mutated = jnp.zeros((), dtype=bool)
+    for k in range(n_levels):
+        half = 1 << (n_levels - k - 1)
+        pair_idx = jnp.arange(half, dtype=jnp.uint32)
+        left = [c[0::2] for c in level]
+        right = [c[1::2] for c in level]
+        equal = jnp.ones((half,), dtype=bool)
+        for l_col, r_col in zip(left, right):
+            equal &= l_col == r_col
+        live_pair = 2 * pair_idx + 1 < m  # both nodes inside the prefix
+        mutated |= jnp.any(equal & live_pair)
+        dup = 2 * pair_idx + 1 >= m  # odd tail (and dead lanes): self-pair
+        right = [jnp.where(dup, l_col, r_col)
+                 for l_col, r_col in zip(left, right)]
+        hashed = sha256d_64(left + right)
+        # the bucket can be taller than the real tree: once the live count
+        # reaches 1 the root rides through untouched instead of being
+        # self-hashed up the remaining levels
+        done = m <= 1
+        level = [jnp.where(done, l_col, h_col)
+                 for l_col, h_col in zip(left, hashed)]
+        m = jnp.where(done, m, (m + 1) // 2)
+    return jnp.stack(level, axis=-1)[0], mutated
+
+
 def compute_merkle_root_tpu(hashes: list[bytes]) -> tuple[bytes, bool]:
     """Drop-in for consensus.merkle.compute_merkle_root on large inputs.
 
-    Returns (root, mutated). Device round-trips once per level; each level is
-    one fused XLA computation over all pairs.
+    Returns (root, mutated). The whole log2(n)-level tree runs as a single
+    device dispatch (dispatch latency dominated the old per-level loop —
+    12 round-trips for 4k txids); compilation is bounded by the number of
+    distinct pow2 buckets, not tx counts.
     """
     if not hashes:
         return b"\x00" * 32, False
-    mutated = False
-    level = _digests_to_words(
+    if len(hashes) == 1:
+        return hashes[0], False
+    n = len(hashes)
+    bucket = max(PAD_LANES, 1 << (n - 1).bit_length())
+    words = _digests_to_words(
         np.frombuffer(b"".join(hashes), dtype=np.uint8).reshape(-1, 32)
     )
-    while len(level) > 1:
-        n = len(level)
-        # Mutation check runs BEFORE odd-duplication (identical adjacent
-        # nodes at even positions; the legitimate self-pair added below must
-        # not flag) — same order as consensus/merkle.py and the reference.
-        whole = n - (n & 1)
-        mutated |= bool(
-            np.any(np.all(level[0:whole:2] == level[1:whole:2], axis=1))
+    if bucket != n:
+        words = np.concatenate(
+            [words, np.zeros((bucket - n, 8), dtype=np.uint32)], axis=0
         )
-        if n & 1:
-            level = np.concatenate([level, level[-1:]], axis=0)
-            n += 1
-        left, right = level[0::2], level[1::2]
-        pairs = np.concatenate([left, right], axis=1)  # (n/2, 16)
-        n_pairs = len(pairs)
-        padded = -(-n_pairs // PAD_LANES) * PAD_LANES
-        if padded != n_pairs:
-            pairs = np.concatenate(
-                [pairs, np.zeros((padded - n_pairs, 16), dtype=np.uint32)], axis=0
-            )
-        out = np.asarray(_level_jit(jnp.asarray(pairs)))[:n_pairs]
-        level = out
-    return _words_to_digests(level)[0].tobytes(), mutated
+    root_words, mutated = _tree_reduce_jit(
+        jnp.asarray(words), bucket.bit_length() - 1, jnp.uint32(n)
+    )
+    root = np.asarray(root_words, dtype=np.uint32)
+    return _words_to_digests(root[None, :])[0].tobytes(), bool(mutated)

@@ -71,7 +71,7 @@ def getblockchaininfo(node, params):
     cs = node.chainstate
     tip = cs.tip()
     best_header = max(cs.block_index.values(), key=lambda i: i.chain_work)
-    return {
+    out = {
         "chain": node.params.network,
         "blocks": tip.height,
         "headers": best_header.height,
@@ -80,9 +80,14 @@ def getblockchaininfo(node, params):
         "mediantime": tip.get_median_time_past(),
         "verificationprogress": 1.0,
         "chainwork": f"{tip.chain_work:064x}",
-        "pruned": False,
+        "pruned": node.prune_mode,
         "softforks": _softforks(node, tip),
     }
+    if node.prune_mode:
+        # prune_height is tracked incrementally (and persisted) by
+        # prune_block_files — no chain scan under cs_main here
+        out["pruneheight"] = node.prune_height
+    return out
 
 
 def _softforks(node, tip):
@@ -497,3 +502,20 @@ def getchaintxstats(node, params):
         if interval > 0:
             out["txrate"] = (final.chain_tx - first.chain_tx) / interval
     return out
+
+
+@rpc_method("pruneblockchain")
+def pruneblockchain(node, params):
+    """pruneblockchain height — manual prune (requires -prune=1)."""
+    require_params(params, 1, 1, "pruneblockchain height")
+    if not node.prune_mode:
+        raise RPCError(RPC_MISC_ERROR,
+                       "Cannot prune blocks because node is not in prune "
+                       "mode.")
+    height = int(params[0])
+    tip = node.chainstate.tip().height
+    if height < 0 or height > tip:
+        raise RPCError(RPC_INVALID_PARAMETER,
+                       "Blockchain block height out of range")
+    node.prune_block_files(height)
+    return node.prune_height

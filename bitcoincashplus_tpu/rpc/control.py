@@ -134,3 +134,36 @@ def createmultisig(node, params):
                                      node.params),
         "redeemScript": redeem.hex(),
     }
+
+
+@rpc_method("getinfo")
+def getinfo(node, params):
+    """getinfo — the classic aggregated snapshot (src/rpc/misc.cpp; still
+    present in this lineage, deprecated later)."""
+    from ..consensus.tx import COIN
+    from .blockchain import difficulty_from_bits
+
+    tip = node.chainstate.tip()
+    out = {
+        "version": 140000,
+        "protocolversion": 70015,
+        "blocks": tip.height,
+        "timeoffset": 0,
+        "connections": (len(node.connman.peers)
+                        if node.connman is not None else 0),
+        "proxy": "",
+        "difficulty": difficulty_from_bits(tip.header.bits),
+        "testnet": node.params.network == "test",
+        "chain": node.params.network,
+        "relayfee": node.min_relay_fee_rate / COIN,
+        "errors": "",
+    }
+    if node.wallet is not None:
+        out["walletversion"] = 2
+        out["balance"] = node.wallet.balance(tip.height) / COIN
+        out["keypoololdest"] = 0
+        out["keypoolsize"] = len(node.wallet.keys_by_pubkey)
+        if node.wallet.is_crypted:
+            out["unlocked_until"] = (0 if node.wallet.is_locked
+                                     else int(node.wallet.unlocked_until))
+    return out

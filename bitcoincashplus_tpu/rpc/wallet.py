@@ -673,12 +673,16 @@ def _address_of_coin(node, coin):
     return script_to_address(coin.txout.script_pubkey, node.params)
 
 
-def _account_balances(node, w, include_watch_only: bool = False) -> dict:
+def _account_balances(node, w, include_watch_only: bool = False,
+                      minconf: int = 1) -> dict:
     tip = node.chainstate.tip().height
     out = {"": 0}
     for acct in set(w.labels.values()) | set(w.account_moves):
         out.setdefault(acct, 0)
     for coin in w.available_coins(tip, include_watch_only=include_watch_only):
+        conf = 0 if coin.height < 0 else tip - coin.height + 1
+        if conf < minconf:
+            continue
         addr = _address_of_coin(node, coin)
         acct = w.labels.get(addr, "") if addr else ""
         out[acct] = out.get(acct, 0) + coin.txout.value
@@ -738,10 +742,12 @@ def getaddressesbyaccount(node, params):
 def listaccounts(node, params):
     """listaccounts ( minconf includeWatchonly ) — watch-only coins count
     only with the explicit flag, like the reference."""
+    minconf = int(params[0]) if params and params[0] is not None else 1
     include_watch = bool(params[1]) if len(params) > 1 else False
     w = _wallet(node)
     return {acct: bal / COIN
-            for acct, bal in _account_balances(node, w, include_watch).items()}
+            for acct, bal in _account_balances(
+                node, w, include_watch, minconf).items()}
 
 
 @rpc_method("getreceivedbyaccount")
@@ -783,8 +789,11 @@ def move(node, params):
 @rpc_method("sendfrom")
 def sendfrom(node, params):
     """sendfrom "account" "address" amount — spends from the shared pool
-    like the reference (accounts never restricted coin selection) and
-    debits the account."""
+    like the reference (accounts never restricted coin selection), gated
+    on the account's balance. Under this wallet's steady-state account
+    model (balances derive from labelled-coin ownership + move deltas) a
+    spend of the account's own coins debits it naturally, so no extra
+    delta is recorded — recording one on top double-counts."""
     require_params(params, 3, 6, "sendfrom \"account\" \"toaddress\" amount")
     RPC_WALLET_INSUFFICIENT_FUNDS = -6
     account = str(params[0])
@@ -794,11 +803,7 @@ def sendfrom(node, params):
     if _account_balances(node, w).get(account, 0) < amount + fee:
         raise RPCError(RPC_WALLET_INSUFFICIENT_FUNDS,
                        "Account has insufficient funds")
-    txid = sendtoaddress(node, [params[1], params[2]])
-    w.account_moves[account] = (
-        w.account_moves.get(account, 0) - amount - fee)
-    w.save()
-    return txid
+    return sendtoaddress(node, [params[1], params[2]])
 
 
 # ---- watch-only imports (rpcdump.cpp importaddress/importpubkey) ----

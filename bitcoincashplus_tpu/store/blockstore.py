@@ -52,15 +52,16 @@ class BlockStore:
     layout. Positions are kept in memory and re-persisted by the caller
     (BlockIndexDB) — a restart reloads them from the index DB."""
 
-    def __init__(self, datadir: str, netmagic: bytes):
+    def __init__(self, datadir: str, netmagic: bytes,
+                 max_file_size: int = MAX_BLOCKFILE_SIZE):
         self.dir = os.path.join(datadir, "blocks")
         os.makedirs(self.dir, exist_ok=True)
         self.netmagic = netmagic
+        self.max_file_size = max_file_size
         self.positions: dict[bytes, tuple[int, int, int]] = {}  # h -> (file, offset, size)
         self.undo_positions: dict[bytes, tuple[int, int, int]] = {}
         self._files: dict[tuple[str, int], object] = {}
         self._cur_file = self._scan_last_file("blk")
-        self._cur_undo_file = self._scan_last_file("rev")
 
     def _scan_last_file(self, prefix: str) -> int:
         n = 0
@@ -79,19 +80,23 @@ class BlockStore:
             self._files[key] = f
         return f
 
-    def _append(self, prefix: str, cur_attr: str, raw: bytes) -> tuple[int, int, int]:
-        n = getattr(self, cur_attr)
+    def _append_to(self, prefix: str, n: int, raw: bytes) -> tuple[int, int, int]:
+        """Append one (netmagic, size, raw) record to {prefix}{n}.dat."""
         f = self._open(prefix, n)
         f.seek(0, os.SEEK_END)
-        if f.tell() + len(raw) + 8 > MAX_BLOCKFILE_SIZE and f.tell() > 0:
-            n += 1
-            setattr(self, cur_attr, n)
-            f = self._open(prefix, n)
-            f.seek(0, os.SEEK_END)
         record = self.netmagic + struct.pack("<I", len(raw)) + raw
         offset = f.tell() + 8  # data starts after magic+size
         f.write(record)
         return n, offset, len(raw)
+
+    def _append(self, prefix: str, cur_attr: str, raw: bytes) -> tuple[int, int, int]:
+        n = getattr(self, cur_attr)
+        f = self._open(prefix, n)
+        f.seek(0, os.SEEK_END)
+        if f.tell() + len(raw) + 8 > self.max_file_size and f.tell() > 0:
+            n += 1
+            setattr(self, cur_attr, n)
+        return self._append_to(prefix, n, raw)
 
     def _read(self, prefix: str, pos: tuple[int, int, int]) -> bytes:
         n, offset, size = pos
@@ -116,7 +121,12 @@ class BlockStore:
     def put_undo(self, h: bytes, raw: bytes) -> None:
         if h in self.undo_positions:
             return
-        self.undo_positions[h] = self._append("rev", "_cur_undo_file", raw)
+        # undo lives in the rev file PAIRED with the block's blk file
+        # (UndoWriteToDisk uses the block's nFile) — pruning blk{n}+rev{n}
+        # as a unit then can't orphan undo data of unpruned blocks
+        blockpos = self.positions.get(h)
+        n = blockpos[0] if blockpos is not None else self._cur_file
+        self.undo_positions[h] = self._append_to("rev", n, raw)
 
     def get_undo(self, h: bytes) -> Optional[bytes]:
         pos = self.undo_positions.get(h)
@@ -128,6 +138,57 @@ class BlockStore:
         for f in self._files.values():
             f.flush()
             os.fsync(f.fileno())
+
+    # -- pruning (UnlinkPrunedFiles, src/validation.cpp) -----------------
+
+    def blocks_in_file(self, n: int) -> list[bytes]:
+        return [h for h, pos in self.positions.items() if pos[0] == n]
+
+    def file_usage(self) -> int:
+        """Total bytes across all blk/rev files (CalculateCurrentUsage)."""
+        total = 0
+        for prefix in ("blk", "rev"):
+            i = 0
+            while True:
+                path = self._path(prefix, i)
+                if not os.path.exists(path):
+                    break
+                total += os.path.getsize(path)
+                i += 1
+        return total
+
+    def prune_file(self, n: int) -> list[bytes]:
+        """Delete blk{n} (and rev{n} when safe) and forget the pruned
+        blocks' positions. Returns the block hashes whose data was removed
+        (caller clears index status). The current append file is never
+        pruned."""
+        if n >= self._cur_file:
+            return []
+        removed = set(self.blocks_in_file(n))
+        truncate = ["blk"]
+        # rev{n} normally holds exactly file-n blocks' undo (put_undo pairs
+        # them), but a pre-pairing datadir can have foreign undo records in
+        # it — truncating then would orphan undo of unpruned blocks, so
+        # only the positions of pruned blocks are dropped in that case
+        undo_in_rev_n = {h for h, p in self.undo_positions.items()
+                         if p[0] == n}
+        if undo_in_rev_n <= removed:
+            truncate.append("rev")
+        for prefix in truncate:
+            f = self._files.pop((prefix, n), None)
+            if f is not None:
+                f.close()
+            path = self._path(prefix, n)
+            if os.path.exists(path):
+                # truncate-in-place rather than unlink: _scan_last_file
+                # relies on contiguous file numbering at startup
+                with open(path, "wb"):
+                    pass
+        self.positions = {h: p for h, p in self.positions.items()
+                          if h not in removed}
+        self.undo_positions = {h: p for h, p in self.undo_positions.items()
+                               if h not in removed}
+        return list(removed)
 
     def close(self) -> None:
         for f in self._files.values():

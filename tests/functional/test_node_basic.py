@@ -203,3 +203,73 @@ def test_single_node_end_to_end():
         assert node.rpc.verifychain(3, 6)
         node.rpc.generatetoaddress(1, params_addr)
         assert node.rpc.getblockcount() == resumed + 1
+
+
+def test_prune_mode():
+    """-prune=1 + pruneblockchain: old block files are shed, index rows
+    lose HAVE_DATA, the node keeps validating and extending; -txindex
+    with -prune refuses to start (feature_pruning.py essentials)."""
+    with FunctionalFramework(
+        num_nodes=1,
+        extra_args=[["-prune=1", "-maxblockfilesize=20000", "-listen=0"]],
+    ) as f:
+        node = f.nodes[0]
+        addr = node.rpc.getnewaddress()
+        # ~400 tiny blocks across many 20kB files (tip-288 must clear
+        # the first file's top height for anything to be prunable)
+        for _ in range(8):
+            node.rpc.generatetoaddress(50, addr)
+        assert node.rpc.getblockcount() == 400
+        info = node.rpc.getblockchaininfo()
+        assert info["pruned"] is True
+
+        import glob
+        import os
+        sizes_before = {
+            p: os.path.getsize(p)
+            for p in glob.glob(os.path.join(node.datadir, "blocks", "blk*.dat"))
+        }
+        assert sum(1 for s in sizes_before.values() if s > 0) > 3
+
+        kept_from = node.rpc.pruneblockchain(400)
+        assert 0 < kept_from <= 400 - 288 + 1
+        sizes_after = {
+            p: os.path.getsize(p)
+            for p in glob.glob(os.path.join(node.datadir, "blocks", "blk*.dat"))
+        }
+        n_emptied = sum(1 for p, s in sizes_after.items()
+                        if s == 0 and sizes_before.get(p, 0) > 0)
+        assert n_emptied >= 1, "no block file was pruned"
+        info = node.rpc.getblockchaininfo()
+        assert info["pruneheight"] > 0
+
+        # pruned block data is gone; headers remain
+        early = node.rpc.getblockhash(1)
+        from bitcoincashplus_tpu.rpc.client import JSONRPCException
+        with pytest.raises(JSONRPCException):
+            node.rpc.getblock(early)
+        assert node.rpc.getblockheader(early)["height"] == 1
+
+        # node keeps mining + restarts cleanly with the pruned state
+        node.rpc.generatetoaddress(2, addr)
+        node.stop()
+        node.start(extra=["-prune=1", "-maxblockfilesize=20000", "-listen=0"])
+        assert node.rpc.getblockcount() == 402
+        assert node.rpc.getblockchaininfo()["pruned"] is True
+        node.rpc.generatetoaddress(1, addr)
+
+    # -txindex + -prune must refuse to start
+    import subprocess
+    f2 = FunctionalFramework(num_nodes=1,
+                             extra_args=[["-prune=1", "-txindex", "-listen=0"]])
+    try:
+        f2.__enter__()
+        started = True
+    except Exception:
+        started = False
+    finally:
+        try:
+            f2.__exit__(None, None, None)
+        except Exception:
+            pass
+    assert not started, "-prune with -txindex must be rejected"
