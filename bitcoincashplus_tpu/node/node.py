@@ -180,6 +180,31 @@ class Node:
         self.connman = None  # set by start_p2p
         self.wallet = None  # set by load_wallet
 
+        # -zmqpub<topic>=<endpoint> (src/zmq/): like the reference, each
+        # distinct endpoint gets its own PUB socket; topics sharing an
+        # endpoint share a socket. Accepted forms: tcp://host:port,
+        # host:port, or a bare port (host defaults to loopback).
+        self.zmq_publishers = []
+        by_endpoint: dict[tuple[str, int], set] = {}
+        for topic in ("hashblock", "hashtx", "rawblock", "rawtx"):
+            val = config.get(f"zmqpub{topic}")
+            if not val:
+                continue
+            spec = str(val)
+            if spec.startswith("tcp://"):
+                spec = spec[len("tcp://"):]
+            host, _, port = spec.rpartition(":")
+            by_endpoint.setdefault(
+                (host or "127.0.0.1", int(port)), set()).add(topic)
+        if by_endpoint:
+            from ..rpc.zmq import ZMQPublisher
+
+            for (host, port), topics in by_endpoint.items():
+                pub = ZMQPublisher(self, port, topics, host=host)
+                pub.start()
+                self.zmq_publishers.append(pub)
+            self.chainstate.on_block_connected.append(self._zmq_block)
+
         # LoadMempool (src/validation.cpp): replay mempool.dat unless
         # -persistmempool=0 or we just rebuilt the chainstate
         self.persist_mempool = config.get_bool("persistmempool", True)
@@ -259,6 +284,24 @@ class Node:
             except MempoolError:
                 pass  # no-longer-valid txs just drop
 
+    def _zmq_publish(self, topic: str, body: bytes) -> None:
+        for pub in self.zmq_publishers:  # each filters by its own topics
+            pub.publish(topic, body)
+
+    def _zmq_block(self, block: CBlock, idx) -> None:
+        """CZMQNotificationInterface::BlockConnected +
+        TransactionAddedToMempool-for-confirmed-txs: hashblock/rawblock for
+        the block, hashtx/rawtx per transaction."""
+        if not self.zmq_publishers:  # torn down mid-shutdown
+            return
+        if self.chainstate.tip() is not idx:
+            return  # only active-tip connects notify, like the reference
+        self._zmq_publish("hashblock", idx.hash[::-1])  # RPC byte order
+        self._zmq_publish("rawblock", block.serialize())
+        for tx in block.vtx:
+            self._zmq_publish("hashtx", tx.txid[::-1])
+            self._zmq_publish("rawtx", tx.serialize())
+
     # -- mempool entry point -------------------------------------------
 
     def accept_to_mempool(self, tx, now: Optional[int] = None):
@@ -276,6 +319,10 @@ class Node:
         # already committed by in-pool txs (e.g. after a mempool.dat reload)
         if self.wallet is not None:
             self.wallet.add_tx_if_mine(tx, -1, False)
+        if self.zmq_publishers:
+            # TransactionAddedToMempool → hashtx/rawtx
+            self._zmq_publish("hashtx", tx.txid[::-1])
+            self._zmq_publish("rawtx", tx.serialize())
         self.notify_waiters()
         return entry
 
@@ -632,6 +679,16 @@ class Node:
 
     def close(self) -> None:
         """Shutdown (src/init.cpp): stop servers, flush, close stores."""
+        if self.zmq_publishers:
+            for pub in self.zmq_publishers:
+                pub.close()
+            self.zmq_publishers = []
+            # unregister so a block connecting mid-shutdown can't reach a
+            # closed publisher (the guard in _zmq_block is the backstop)
+            try:
+                self.chainstate.on_block_connected.remove(self._zmq_block)
+            except ValueError:
+                pass
         if self.rpc_server is not None:
             self.rpc_server.close()
             self.rpc_server = None

@@ -191,3 +191,70 @@ def test_accounts_api_and_watchonly_imports():
         node.rpc.generatetoaddress(1, default_addr)
         rows = [u for u in node.rpc.listunspent() if not u["spendable"]]
         assert any(abs(u["amount"] - 1.5) < 1e-9 for u in rows)
+
+
+def test_zmq_notifications():
+    """ZMTP 3.0 PUB notifications: hashblock/hashtx/rawblock/rawtx with
+    [topic, body, seq] framing (zmq_tests.cpp / interface_zmq.py)."""
+    import socket as _socket
+    import struct
+
+    from bitcoincashplus_tpu.rpc.zmq import ZMQSubscriber
+
+    # two distinct endpoints: the reference binds one socket per notifier
+    ports = []
+    for _ in range(2):
+        probe = _socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        ports.append(probe.getsockname()[1])
+        probe.close()
+    zport, zport2 = ports
+
+    with FunctionalFramework(
+        num_nodes=1,
+        extra_args=[[f"-zmqpubhashblock=tcp://127.0.0.1:{zport}",
+                     f"-zmqpubhashtx={zport}",
+                     f"-zmqpubrawblock={zport}",
+                     f"-zmqpubrawtx={zport2}",  # its own endpoint
+                     "-listen=0"]],
+    ) as f:
+        node = f.nodes[0]
+        sub = ZMQSubscriber(zport, [b"hashblock", b"hashtx", b"rawblock"])
+        sub2 = ZMQSubscriber(zport2, [b"rawtx"])
+        time.sleep(0.5)  # subscription propagation
+        addr = node.rpc.getnewaddress()
+        mined = node.rpc.generatetoaddress(1, addr)[0]
+
+        got = {}
+        for _ in range(3):
+            topic, body, seq = sub.recv_multipart()
+            got[topic] = (body, struct.unpack("<I", seq)[0])
+        topic, body, seq = sub2.recv_multipart()
+        got[topic] = (body, struct.unpack("<I", seq)[0])
+        sub2.close()
+        assert set(got) == {b"hashblock", b"hashtx", b"rawblock", b"rawtx"}
+        assert got[b"hashblock"][0].hex() == mined
+        raw = node.rpc.getblock(mined, 0)
+        assert got[b"rawblock"][0].hex() == raw
+        # the coinbase tx rides hashtx/rawtx
+        cb_txid = node.rpc.getblock(mined, 1)["tx"][0]
+        assert got[b"hashtx"][0].hex() == cb_txid
+        assert all(s == 0 for _b, s in got.values())  # first per topic
+
+        # mempool entry notifies hashtx/rawtx with bumped sequence
+        node.rpc.generatetoaddress(100, addr)
+        # drain the 100 blocks' messages
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            topic, body, seq = sub.recv_multipart()
+            if topic == b"hashblock" and struct.unpack("<I", seq)[0] == 100:
+                break
+        txid = node.rpc.sendtoaddress(addr, 1.0)
+        deadline = time.time() + 15
+        seen_mempool_tx = False
+        while time.time() < deadline and not seen_mempool_tx:
+            topic, body, seq = sub.recv_multipart()
+            if topic == b"hashtx" and body.hex() == txid:
+                seen_mempool_tx = True
+        assert seen_mempool_tx
+        sub.close()
