@@ -118,16 +118,9 @@ class BlockAssembler:
 
     def _test_block_validity(self, tmpl: BlockTemplate) -> None:
         """TestBlockValidity (src/validation.cpp:~3500): dry-run the
-        non-PoW checks so a bad template never reaches the miner."""
-        cs = self.chainstate
-        tip = cs.tip()
-        cs.check_block(tmpl.block, check_pow=False)
-        cs.contextual_check_block(tmpl.block, tip)
-        # connect dry-run on a scratch cache layer (discarded afterwards)
-        from ..validation.coins import CoinsCache
-
-        idx = CBlockIndex(tmpl.block.header, tmpl.block.get_hash(), tip)
-        cs.connect_block(tmpl.block, idx, check_scripts=True, view=CoinsCache(cs.coins))
+        non-PoW checks so a bad template never reaches the miner (shared
+        with getblocktemplate's BIP22 proposal mode)."""
+        self.chainstate.test_block_validity(tmpl.block)
 
 
 class _BlockView:

@@ -43,6 +43,25 @@ def getblocktemplate(node, params):
     the mempool changes, like the reference's checktxtime/hashWatchedChain
     wait loop."""
     request = params[0] if params and isinstance(params[0], dict) else {}
+    if request.get("mode") == "proposal":
+        # BIP22 proposal mode: validate a block against the current tip
+        # without submitting it (TestBlockValidity; rpc/mining.cpp)
+        try:
+            block = CBlock.from_bytes(bytes.fromhex(request.get("data", "")))
+        except Exception:
+            raise RPCError(RPC_DESERIALIZATION_ERROR,
+                           "Block decode failed") from None
+        with node.cs_main:
+            cs = node.chainstate
+            if block.header.hash_prev_block != cs.tip().hash:
+                return "inconclusive-not-best-prevblk"
+            from ..validation.chainstate import BlockValidationError
+
+            try:
+                cs.test_block_validity(block)
+            except BlockValidationError as e:
+                return e.reason
+        return None
     longpollid = request.get("longpollid")
     if longpollid:
         def changed():
@@ -246,3 +265,17 @@ def waitforblockheight(node, params):
 
 
 waitforblockheight.no_cs_main = True
+
+
+@rpc_method("estimatepriority")
+def estimatepriority(node, params):
+    """Deprecated priority estimator — always -1, like the reference's
+    data-less answer (priority was removed from fee logic)."""
+    return -1
+
+
+@rpc_method("estimatesmartpriority")
+def estimatesmartpriority(node, params):
+    nblocks = int(params[0]) if params else 6
+    return {"priority": -1, "blocks": nblocks,
+            "errors": ["Insufficient data or no priority found"]}

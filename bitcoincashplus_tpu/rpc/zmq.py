@@ -131,6 +131,7 @@ class ZMQPublisher:
         self.loop.call_soon_threadsafe(_shutdown)
         if self._thread is not None:
             self._thread.join(10)
+        self.loop = None  # publish() after close becomes a no-op
 
     # -- subscriber handling -------------------------------------------
 
@@ -177,7 +178,8 @@ class ZMQPublisher:
         """Send [topic, body, seq] to interested subscribers (thread-safe;
         callable from validation/RPC threads)."""
         t = topic.encode()
-        if t not in self.topics or self.loop is None:
+        loop = self.loop  # snapshot: close() clears it concurrently
+        if t not in self.topics or loop is None:
             return
         seq = self.sequences[t]
         self.sequences[t] = (seq + 1) & 0xFFFFFFFF
@@ -199,7 +201,10 @@ class ZMQPublisher:
                     sub.writer.write(wire)
                 except Exception:
                     pass  # PUB drops to dead subscribers silently
-        self.loop.call_soon_threadsafe(_do)
+        try:
+            loop.call_soon_threadsafe(_do)
+        except RuntimeError:
+            pass  # loop closed by a concurrent shutdown
 
 
 # -- test/client helper: a minimal ZMTP SUB client ----------------------

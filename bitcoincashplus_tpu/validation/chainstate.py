@@ -248,6 +248,21 @@ class ChainstateManager:
                 return h
         return 0
 
+    def test_block_validity(self, block: CBlock) -> None:
+        """TestBlockValidity (src/validation.cpp:~3500): full non-PoW
+        validation of a tip candidate on a throwaway view — header context
+        (nBits/time), block rules, and a scripts-on connect dry-run.
+        Raises BlockValidationError; mutates nothing."""
+        from .coins import CoinsCache
+
+        tip = self.tip()
+        self.check_block(block, check_pow=False)
+        self.contextual_check_block_header(block.header, tip)
+        self.contextual_check_block(block, tip)
+        idx = CBlockIndex(block.header, block.get_hash(), tip)
+        self.connect_block(block, idx, check_scripts=True,
+                           view=CoinsCache(self.coins))
+
     def contextual_check_block(self, block: CBlock, prev: CBlockIndex) -> None:
         """ContextualCheckBlock: BIP34 height-in-coinbase, tx finality."""
         height = prev.height + 1

@@ -273,3 +273,43 @@ def test_prune_mode():
         except Exception:
             pass
     assert not started, "-prune with -txindex must be rejected"
+
+
+def test_getblocktemplate_proposal_mode():
+    """BIP22 proposal mode: a valid candidate returns null; a corrupted
+    one returns the reject reason; wrong prevblock is inconclusive."""
+    with FunctionalFramework(num_nodes=1,
+                             extra_args=[["-listen=0"]]) as f:
+        node = f.nodes[0]
+        addr = _regtest_address(KEY)
+        node.rpc.generatetoaddress(101, addr)
+
+        tmpl = node.rpc.getblocktemplate()
+        block = _mine_template(tmpl, addr)
+        raw = block.serialize().hex()
+        assert node.rpc.getblocktemplate(
+            {"mode": "proposal", "data": raw}) is None
+
+        # corrupt the merkle root -> bad-txnmrklroot
+        from bitcoincashplus_tpu.consensus.block import CBlock
+        bad = CBlock.from_bytes(bytes.fromhex(raw))
+        hdr = bad.header
+        import dataclasses
+        bad_hdr = dataclasses.replace(
+            hdr, hash_merkle_root=b"\x55" * 32)
+        bad_raw = CBlock(bad_hdr, bad.vtx).serialize().hex()
+        reason = node.rpc.getblocktemplate(
+            {"mode": "proposal", "data": bad_raw})
+        assert reason is not None and ("mrkl" in reason or "merkle" in reason)
+
+        # stale prevblock -> inconclusive
+        node.rpc.generatetoaddress(1, addr)
+        assert node.rpc.getblocktemplate(
+            {"mode": "proposal", "data": raw}
+        ) == "inconclusive-not-best-prevblk"
+
+        # the proposal dry-run must not have mutated state
+        assert node.rpc.getblockcount() == 102
+        # estimators answer (deprecated surface)
+        assert node.rpc.estimatepriority(6) == -1
+        assert node.rpc.estimatesmartpriority(6)["priority"] == -1
