@@ -94,7 +94,7 @@ class VersionPayload:
     services: int = NODE_NETWORK
     timestamp: int = field(default_factory=lambda: int(time.time()))
     nonce: int = 0
-    user_agent: str = "/bcpd-tpu:0.3.0/"
+    user_agent: str = "/bcpd-tpu:0.4.0/"
     start_height: int = 0
     relay: bool = True
 
