@@ -1,0 +1,70 @@
+"""ZMTP 3.0 codec round-trips — publisher frames parsed by the SUB-side
+reader, including the long-frame (>255 byte) form the functional test's
+small regtest blocks never exercise."""
+
+import struct
+
+from bitcoincashplus_tpu.rpc.zmq import _command, _frame, _greeting
+
+
+def _parse_frames(buf: bytes) -> list[tuple[int, bytes]]:
+    out = []
+    pos = 0
+    while pos < len(buf):
+        flags = buf[pos]
+        pos += 1
+        if flags & 0x02:
+            (size,) = struct.unpack_from(">Q", buf, pos)
+            pos += 8
+        else:
+            size = buf[pos]
+            pos += 1
+        out.append((flags, buf[pos:pos + size]))
+        pos += size
+    return out
+
+
+def test_greeting_shape():
+    g = _greeting(as_server=True)
+    assert len(g) == 64
+    assert g[0] == 0xFF and g[9] == 0x7F
+    assert g[10:12] == bytes([3, 0])
+    assert g[12:16] == b"NULL"
+    assert g[32] == 1  # as-server
+    assert _greeting(as_server=False)[32] == 0
+
+
+def test_short_frame_roundtrip():
+    frames = _parse_frames(_frame(b"topic", more=True) + _frame(b"x", more=False))
+    assert frames == [(0x01, b"topic"), (0x00, b"x")]
+
+
+def test_long_frame_roundtrip():
+    body = bytes(range(256)) * 5  # 1280 bytes: forces the 8-byte length form
+    wire = _frame(body, more=False)
+    assert wire[0] & 0x02  # long flag
+    frames = _parse_frames(wire)
+    assert frames == [(0x02, body)]
+    # boundary: exactly 255 stays short, 256 goes long
+    assert not _frame(b"a" * 255, more=False)[0] & 0x02
+    assert _frame(b"a" * 256, more=False)[0] & 0x02
+
+
+def test_command_framing():
+    wire = _command(b"READY", b"\x0bSocket-Type\x00\x00\x00\x03PUB")
+    assert wire[0] == 0x04  # short command
+    assert wire[2] == 5 and wire[3:8] == b"READY"
+    big = _command(b"READY", b"z" * 300)
+    assert big[0] == 0x06  # long command
+    (size,) = struct.unpack_from(">Q", big, 1)
+    assert size == 1 + 5 + 300
+
+
+def test_multipart_message_wire():
+    """[topic, body, LE32 seq] exactly as publish() writes it."""
+    topic, body, seq = b"hashblock", b"\xab" * 32, struct.pack("<I", 7)
+    wire = (_frame(topic, more=True) + _frame(body, more=True)
+            + _frame(seq, more=False))
+    frames = _parse_frames(wire)
+    assert [f[1] for f in frames] == [topic, body, seq]
+    assert [bool(f[0] & 0x01) for f in frames] == [True, True, False]
