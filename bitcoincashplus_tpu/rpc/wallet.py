@@ -863,3 +863,91 @@ def importpubkey(node, params):
     if rescan:
         node._rescan_wallet()
     return None
+
+
+@rpc_method("importmulti")
+def importmulti(node, params):
+    """importmulti [{"scriptPubKey":{"address":...}|"<hex>", "timestamp":...,
+    "keys":[wif], "pubkeys":[hex], "redeemscript":hex, "watchonly":bool},...]
+    ( {"rescan":bool} ) — bulk import (rpcdump.cpp importmulti). One rescan
+    at the end regardless of request count."""
+    require_params(params, 1, 2, "importmulti requests ( options )")
+    if not isinstance(params[0], list):
+        raise RPCError(RPC_INVALID_PARAMETER, "requests must be an array")
+    options = params[1] if len(params) > 1 and isinstance(params[1], dict) else {}
+    do_rescan = bool(options.get("rescan", True))
+    from ..crypto.hashes import hash160
+    from ..crypto.secp256k1 import pubkey_parse
+    from ..script.script import p2pk_script, p2pkh_script_for_pubkey, p2sh_script
+    from ..wallet.keys import address_to_script
+
+    w = _wallet(node)
+    results = []
+    imported_any = False
+    for req in params[0]:
+        # PHASE 1 — validate and stage everything; no wallet mutation yet,
+        # so a mid-request failure can't leave a partial import behind
+        try:
+            if not isinstance(req, dict):
+                raise ValueError("request must be an object")
+            if "timestamp" not in req:
+                raise ValueError(
+                    "Missing required timestamp field for key scan")
+            watchonly = req.get("watchonly")
+            if watchonly is True and req.get("keys"):
+                raise ValueError(
+                    "Incompatibility found between watchonly and keys")
+            spk_field = req.get("scriptPubKey")
+            spk = None
+            if isinstance(spk_field, dict) and "address" in spk_field:
+                spk = address_to_script(str(spk_field["address"]), node.params)
+                if spk is None:
+                    raise ValueError("Invalid address")
+            elif isinstance(spk_field, str):
+                spk = bytes.fromhex(spk_field)
+            elif spk_field is not None:
+                raise ValueError("Invalid scriptPubKey")
+
+            staged_keys = []
+            for wif in req.get("keys", []) or []:
+                key = CKey.from_wif(str(wif), node.params)
+                if key is None:
+                    raise ValueError("Invalid private key encoding")
+                staged_keys.append(key)
+            staged_scripts = []
+            for pk_hex in req.get("pubkeys", []) or []:
+                pk = bytes.fromhex(str(pk_hex))
+                if pubkey_parse(pk) is None:
+                    raise ValueError("Pubkey is not a valid public key")
+                staged_scripts.append(p2pk_script(pk))
+                staged_scripts.append(p2pkh_script_for_pubkey(pk))
+            redeem = req.get("redeemscript")
+            if redeem:
+                staged_scripts.append(
+                    p2sh_script(hash160(bytes.fromhex(str(redeem)))))
+            if spk is not None and not staged_keys:
+                staged_scripts.append(spk)
+            if not staged_keys and not staged_scripts:
+                raise ValueError("Request contains nothing to import")
+        except (ValueError, WalletError) as e:
+            results.append({"success": False,
+                            "error": {"code": RPC_INVALID_ADDRESS_OR_KEY,
+                                      "message": str(e)}})
+            continue
+        # PHASE 2 — apply the fully-validated request
+        try:
+            for key in staged_keys:
+                w.add_key(key, persist=False)
+        except WalletError as e:  # locked wallet
+            results.append({"success": False,
+                            "error": {"code": RPC_WALLET_UNLOCK_NEEDED,
+                                      "message": str(e)}})
+            continue
+        w.watched_scripts.update(staged_scripts)
+        imported_any = True
+        results.append({"success": True})
+    if imported_any:
+        w.save()
+        if do_rescan:
+            node._rescan_wallet()
+    return results

@@ -258,3 +258,4 @@ def test_zmq_notifications():
                 seen_mempool_tx = True
         assert seen_mempool_tx
         sub.close()
+

@@ -188,3 +188,51 @@ def test_wallet_rpc_breadth():
         assert signed["complete"] is True
         txid4 = node.rpc.sendrawtransaction(signed["hex"])
         assert txid4 in node.rpc.getrawmempool()
+
+
+def test_importmulti():
+    from bitcoincashplus_tpu.consensus.params import regtest_params
+    from bitcoincashplus_tpu.wallet.keys import CKey
+
+    with FunctionalFramework(num_nodes=1,
+                             extra_args=[["-listen=0"]]) as f:
+        node = f.nodes[0]
+        addr = node.rpc.getnewaddress()
+        node.rpc.generatetoaddress(101, addr)
+        params = regtest_params()
+        k1, k2 = CKey(0xA1), CKey(0xA2)
+        watch_addr = CKey(0xA3).p2pkh_address(params)
+        # pay all three BEFORE importing; importmulti's rescan must find them
+        node.rpc.sendtoaddress(k1.p2pkh_address(params), 1.0)
+        node.rpc.sendtoaddress(k2.p2pkh_address(params), 2.0)
+        node.rpc.sendtoaddress(watch_addr, 3.0)
+        node.rpc.generatetoaddress(1, addr)
+
+        res = node.rpc.importmulti([
+            {"keys": [k1.to_wif(params)], "timestamp": 0},
+            {"pubkeys": [k2.pubkey.hex()], "timestamp": 0},
+            {"scriptPubKey": {"address": watch_addr}, "timestamp": 0},
+            {"scriptPubKey": {"address": "notanaddress"}, "timestamp": 0},
+            # valid WIF + bad pubkey in ONE request: must fail atomically
+            {"keys": [CKey(0xA4).to_wif(params)], "pubkeys": ["zz"],
+             "timestamp": 0},
+            {"keys": [CKey(0xA5).to_wif(params)]},  # missing timestamp
+        ])
+        assert [r["success"] for r in res] == [True, True, True,
+                                               False, False, False]
+        assert res[3]["error"]["code"] == -5
+        assert "timestamp" in res[5]["error"]["message"]
+        # the atomically-failed request imported NOTHING
+        assert node.rpc.dumpprivkey(
+            k1.p2pkh_address(params)) == k1.to_wif(params)
+        try:
+            node.rpc.dumpprivkey(CKey(0xA4).p2pkh_address(params))
+            raise AssertionError("partial import leaked a key")
+        except Exception:
+            pass
+        unspent = node.rpc.listunspent()
+        # k1's coin is spendable (private key imported); k2 + watch are not
+        spendable = {round(u["amount"], 8) for u in unspent if u["spendable"]}
+        watchonly = {round(u["amount"], 8) for u in unspent if not u["spendable"]}
+        assert 1.0 in spendable
+        assert {2.0, 3.0} <= watchonly
