@@ -133,6 +133,7 @@ def accept_to_memory_pool(
     min_fee_rate: int = 1000,
     backend: str = "cpu",
     now: Optional[int] = None,
+    ancestor_limits: Optional[dict] = None,
 ) -> MempoolEntry:
     """AcceptToMemoryPool (src/validation.cpp:~400). Returns the entry on
     success; raises MempoolError with the reference's reject reason."""
@@ -210,7 +211,8 @@ def accept_to_memory_pool(
         raise MempoolError("mempool-min-fee-not-met",
                            f"{modified_fee} < {min_fee}")
 
-    ancestors = pool.check_ancestor_limits(tx, fee)
+    ancestors = pool.check_ancestor_limits(tx, fee,
+                                           **(ancestor_limits or {}))
 
     flags = standard_script_flags(params, height)
     verify_tx_scripts(tx, spent_coins, flags, sigcache, backend=backend)

@@ -153,6 +153,15 @@ class Node:
             expiry_seconds=config.get_int("mempoolexpiry", 336) * 3600,
         )
         self.min_relay_fee_rate = config.get_int("minrelaytxfee", 1000)
+        # -limitancestorcount/-limitancestorsize (kB)/-limitdescendantcount/
+        # -limitdescendantsize (kB): ATMP chain limits (validation.h defaults)
+        self.ancestor_limits = {
+            "limit_count": config.get_int("limitancestorcount", 25),
+            "limit_size": config.get_int("limitancestorsize", 101) * 1000,
+            "limit_desc": config.get_int("limitdescendantcount", 25),
+            "limit_desc_size":
+                config.get_int("limitdescendantsize", 101) * 1000,
+        }
         # CBlockPolicyEstimator-lite (src/policy/fees.cpp): per-block median
         # feerate (sat/kB) of confirmed txs this node saw in its mempool
         from collections import deque
@@ -313,6 +322,7 @@ class Node:
             min_fee_rate=self.min_relay_fee_rate,
             backend="cpu" if self.backend == "cpu" else "auto",
             now=now,
+            ancestor_limits=self.ancestor_limits,
         )
         # TransactionAddedToMempool (validationinterface): a loaded wallet
         # tracks unconfirmed receives/spends so it won't double-spend coins

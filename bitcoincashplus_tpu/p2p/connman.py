@@ -172,8 +172,12 @@ class CConnman:
         n_loaded = self.addrman.load(self._peers_path)
         if n_loaded:
             log_print("net", "loaded %d addresses from peers.json", n_loaded)
-        # ThreadOpenConnections: target outbound count when auto-dialing
-        self.max_outbound = 8
+        # -maxconnections (net.cpp nMaxConnections, default 125): inbound
+        # accepts are refused at the cap
+        self.max_connections = node.config.get_int("maxconnections", 125)
+        # ThreadOpenConnections target, clamped by the total cap exactly
+        # like the reference's min(MAX_OUTBOUND_CONNECTIONS, nMaxConnections)
+        self.max_outbound = min(8, self.max_connections)
 
     # -- lifecycle ------------------------------------------------------
 
@@ -265,7 +269,8 @@ class CConnman:
 
     async def _on_inbound(self, reader, writer) -> None:
         peername = writer.get_extra_info("peername") or ("?", 0)
-        if self.is_banned(peername[0]):
+        if self.is_banned(peername[0]) or \
+                len(self.peers) >= self.max_connections:
             writer.close()
             return
         peer = Peer(self, reader, writer, outbound=False)
@@ -684,7 +689,8 @@ class CConnman:
         while True:
             await asyncio.sleep(5)
             outbound = [p for p in self.peers.values() if p.outbound]
-            if len(outbound) >= self.max_outbound:
+            if (len(outbound) >= self.max_outbound
+                    or len(self.peers) >= self.max_connections):
                 continue
             connected = {p.addr for p in self.peers.values()}
             candidate = self.addrman.select(exclude=connected)

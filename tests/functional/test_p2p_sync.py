@@ -548,3 +548,43 @@ def test_addrman_gossip_and_autodial():
         a.start()
         # the reloaded addrman re-dials B without any hint
         wait_until(lambda: b.rpc.getconnectioncount() >= 1, timeout=30)
+
+
+def test_maxconnections_and_ancestor_limit_flags():
+    """-maxconnections caps inbound accepts; -limitancestorcount bounds
+    mempool chains (mempool_limit.py essentials)."""
+    with FunctionalFramework(
+        num_nodes=1,
+        extra_args=[["-maxconnections=2", "-limitancestorcount=3"]],
+    ) as f:
+        node = f.nodes[0]
+        magic = regtest_params().netmagic
+
+        # two peers connect; the third is refused at the cap
+        socks = []
+        for _ in range(2):
+            s = socket.create_connection(("127.0.0.1", node.p2p_port),
+                                         timeout=10)
+            s.sendall(pack_message(magic, "version",
+                                   VersionPayload().serialize()))
+            _read_msg(s)
+            _read_msg(s)
+            s.sendall(pack_message(magic, "verack"))
+            socks.append(s)
+        wait_until(lambda: node.rpc.getconnectioncount() == 2, timeout=15)
+        s3 = socket.create_connection(("127.0.0.1", node.p2p_port), timeout=10)
+        s3.sendall(pack_message(magic, "version", VersionPayload().serialize()))
+        assert _expect_disconnect(s3, timeout=10)
+        assert node.rpc.getconnectioncount() == 2
+        for s in socks:
+            s.close()
+
+        # ancestor chain: 3 allowed, the 4th rejected by the lowered limit
+        addr = node.rpc.getnewaddress()
+        node.rpc.generatetoaddress(101, addr)
+        from bitcoincashplus_tpu.rpc.client import JSONRPCException
+        for i in range(3):
+            txid = node.rpc.sendtoaddress(addr, 40.0)  # chains off change
+        with pytest.raises(JSONRPCException) as e:
+            node.rpc.sendtoaddress(addr, 40.0)
+        assert "too-long" in str(e.value) or "chain" in str(e.value)
