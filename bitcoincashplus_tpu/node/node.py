@@ -142,6 +142,13 @@ class Node:
             # pick up blocks whose index rows were flushed but that were not
             # yet connected at crash time
             self.chainstate.activate_best_chain()
+        # -loadblock=<file>: bootstrap.dat-style external imports
+        # (init.cpp ThreadImport's vImportFiles leg)
+        load_files = config.get_multi("loadblock")
+        if load_files:
+            n = self.import_block_files(list(load_files))
+            log_printf("-loadblock: imported %d blocks, tip height %d",
+                       n, self.chainstate.tip().height)
 
         self.verify_db(
             n_blocks=config.get_int("checkblocks", 6),
@@ -433,11 +440,13 @@ class Node:
         log_print("db", "VerifyDB: %d blocks verified at level %d", checked, level)
         return True
 
-    def import_block_files(self) -> int:
+    def import_block_files(self, paths: Optional[list[str]] = None) -> int:
         """LoadExternalBlockFile (src/validation.cpp:~4000) over every
-        blk?????.dat: scan (netmagic, size, block) records, re-register data
-        positions, and ProcessNewBlock each one. Out-of-order blocks park via
-        accept-header failure and are retried once their parent lands."""
+        blk?????.dat (or the explicit ``paths`` — the -loadblock /
+        bootstrap.dat form): scan (netmagic, size, block) records,
+        re-register data positions, and ProcessNewBlock each one.
+        Out-of-order blocks park via accept-header failure and are retried
+        once their parent lands."""
         import struct
 
         magic = self.params.netmagic
@@ -469,11 +478,20 @@ class Node:
                     queue.append(child.get_hash())
             return True
 
-        n_file = 0
-        while True:
-            path = os.path.join(self.datadir, "blocks", f"blk{n_file:05d}.dat")
+        if paths is None:
+            paths = []
+            n_file = 0
+            while True:
+                p = os.path.join(self.datadir, "blocks",
+                                 f"blk{n_file:05d}.dat")
+                if not os.path.exists(p):
+                    break
+                paths.append(p)
+                n_file += 1
+        for path in paths:
             if not os.path.exists(path):
-                break
+                log_printf("loadblock: %s not found, skipping", path)
+                continue
             with open(path, "rb") as f:
                 data = f.read()
             pos = 0
@@ -492,7 +510,6 @@ class Node:
                     continue
                 try_process(block)
                 pos = start + size
-            n_file += 1
         self.chainstate.flush()
         return n_imported
 

@@ -313,3 +313,36 @@ def test_getblocktemplate_proposal_mode():
         # estimators answer (deprecated surface)
         assert node.rpc.estimatepriority(6) == -1
         assert node.rpc.estimatesmartpriority(6)["priority"] == -1
+
+
+def test_linearize_and_loadblock(tmp_path):
+    """tools/linearize.py exports the chain; -loadblock imports it into a
+    fresh node (contrib/linearize + init.cpp vImportFiles parity)."""
+    import subprocess
+    import sys
+
+    with FunctionalFramework(num_nodes=2,
+                             extra_args=[["-listen=0"], ["-listen=0"]]) as f:
+        a, b = f.nodes
+        addr = a.rpc.getnewaddress()
+        a.rpc.generatetoaddress(20, addr)
+        best = a.rpc.getbestblockhash()
+
+        bootstrap = str(tmp_path / "bootstrap.dat")
+        out = subprocess.run(
+            [sys.executable, "tools/linearize.py",
+             "--datadir", a.datadir, "--rpcport", str(a.rpc_port),
+             "--out", bootstrap],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "wrote 21 blocks" in out.stdout
+        import os
+        assert os.path.getsize(bootstrap) > 21 * 80
+
+        # fresh node ingests it at startup via -loadblock
+        assert b.rpc.getblockcount() == 0
+        b.stop()
+        b.start(extra=["-listen=0", f"-loadblock={bootstrap}"])
+        assert b.rpc.getblockcount() == 20
+        assert b.rpc.getbestblockhash() == best

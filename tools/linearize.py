@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""linearize — export the active chain as a bootstrap.dat.
+
+Reference: contrib/linearize/{linearize-hashes.py, linearize-data.py}
+collapsed into one RPC-driven tool: walk getblockhash 0..tip (or --end),
+fetch each raw block, and append height-ordered (netmagic, size, block)
+records — the exact LoadExternalBlockFile framing, so the output feeds a
+fresh node's -loadblock=<file> (or can be dropped into blocks/ and
+-reindex'ed).
+
+Usage:
+  python tools/linearize.py --datadir /path/to/regtest-datadir \
+      [--network regtest] [--rpcport N] [--start H] [--end H] \
+      [--out bootstrap.dat]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import struct
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bitcoincashplus_tpu.consensus.params import select_params  # noqa: E402
+from bitcoincashplus_tpu.rpc.client import RPCClient  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--datadir", required=True,
+                    help="node datadir holding the RPC .cookie")
+    ap.add_argument("--network", default="regtest",
+                    choices=["main", "test", "regtest"])
+    ap.add_argument("--rpcport", type=int, default=None)
+    ap.add_argument("--start", type=int, default=0)
+    ap.add_argument("--end", type=int, default=None,
+                    help="last height to export (default: current tip)")
+    ap.add_argument("--out", default="bootstrap.dat")
+    args = ap.parse_args()
+
+    params = select_params(args.network)
+    port = args.rpcport or {"main": 8332, "test": 18332,
+                            "regtest": 18443}[args.network]
+    rpc = RPCClient(port=port, datadir=args.datadir)
+    end = args.end if args.end is not None else rpc.getblockcount()
+
+    n = 0
+    with open(args.out, "wb") as f:
+        for height in range(args.start, end + 1):
+            raw = bytes.fromhex(rpc.getblock(rpc.getblockhash(height), 0))
+            f.write(params.netmagic + struct.pack("<I", len(raw)) + raw)
+            n += 1
+    print(f"wrote {n} blocks (heights {args.start}..{end}) to {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
