@@ -56,9 +56,17 @@ def load() -> Optional[ctypes.CDLL]:
                 return None  # no toolchain and no prebuilt library
     try:
         lib = ctypes.CDLL(_LIB_PATH)
-    except OSError:
+        _bind(lib)
+    except (OSError, AttributeError):
+        # missing library, or a stale prebuilt .so lacking newer symbols
+        # (build skipped/failed): honor the "None when unavailable"
+        # contract — callers keep the Python path
         return None
+    _lib = lib
+    return _lib
 
+
+def _bind(lib: ctypes.CDLL) -> None:
     lib.bcp_sha256d.argtypes = [ctypes.c_char_p, ctypes.c_size_t,
                                 ctypes.c_char_p]
     lib.bcp_sha256d.restype = None
@@ -73,8 +81,18 @@ def load() -> Optional[ctypes.CDLL]:
     lib.bcp_merkle_root.argtypes = [ctypes.c_char_p, ctypes.c_long,
                                     ctypes.c_char_p]
     lib.bcp_merkle_root.restype = ctypes.c_long
-    _lib = lib
-    return _lib
+    lib.bcp_ecdsa_verify.argtypes = [ctypes.c_char_p] * 3
+    lib.bcp_ecdsa_verify.restype = ctypes.c_int
+    lib.bcp_ecdsa_verify_batch.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long,
+        ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.bcp_ecdsa_verify_batch.restype = None
+    lib.bcp_ecdsa_precompute.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_long,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.bcp_ecdsa_precompute.restype = None
 
 
 def available() -> bool:
@@ -126,6 +144,77 @@ def scan_block(raw: bytes, max_tx: int = 100_000) -> Optional[BlockScan]:
         [txids.raw[32 * i:32 * i + 32] for i in range(n)],
         [(int(offsets[2 * i]), int(offsets[2 * i + 1])) for i in range(n)],
     )
+
+
+# Thread budget for batch entry points. 0 = one thread per core (the C++
+# side resolves it); node init assigns this from -par (node/node.py).
+PAR_THREADS = 0
+
+
+def _pack_rs_msg(records) -> tuple[bytes, bytes]:
+    """(r||s, msg_hash) blobs for the batch entry points (32-byte
+    big-endian fields, mod 2^256 — the C side range-rejects r/s >= n)."""
+    rs = b"".join(
+        (rec.r % (1 << 256)).to_bytes(32, "big")
+        + (rec.s % (1 << 256)).to_bytes(32, "big")
+        for rec in records
+    )
+    msg = b"".join(
+        (rec.msg_hash % (1 << 256)).to_bytes(32, "big") for rec in records
+    )
+    return rs, msg
+
+
+def ecdsa_verify(pubkey: tuple, r: int, s: int, e: int) -> bool:
+    """Scalar ECDSA verify on the native module (same acceptance set as
+    crypto/secp256k1.ecdsa_verify — differentially tested). The pubkey is
+    an affine (x, y) pair as produced by pubkey_parse."""
+    lib = load()
+    assert lib is not None, "native library unavailable"
+    pub = pubkey[0].to_bytes(32, "big") + pubkey[1].to_bytes(32, "big")
+    rs = (r % (1 << 256)).to_bytes(32, "big") + \
+        (s % (1 << 256)).to_bytes(32, "big")
+    msg = (e % (1 << 256)).to_bytes(32, "big")
+    return bool(lib.bcp_ecdsa_verify(pub, rs, msg))
+
+
+def ecdsa_verify_batch(records, nthreads: int | None = None) -> list[bool]:
+    """Batch verify SigCheckRecord-shaped objects (.pubkey/.r/.s/.msg_hash)
+    across host threads — the CPU fallback lane of ops/ecdsa_batch."""
+    lib = load()
+    assert lib is not None, "native library unavailable"
+    n = len(records)
+    if n == 0:
+        return []
+    pub = b"".join(
+        rec.pubkey[0].to_bytes(32, "big") + rec.pubkey[1].to_bytes(32, "big")
+        for rec in records
+    )
+    rs, msg = _pack_rs_msg(records)
+    ok = ctypes.create_string_buffer(n)
+    lib.bcp_ecdsa_verify_batch(pub, rs, msg, n, ok,
+                               nthreads if nthreads is not None
+                               else PAR_THREADS)
+    return [b == 1 for b in ok.raw]
+
+
+def ecdsa_precompute(records, nthreads: int | None = None):
+    """Per-record u1 = e*s^-1 mod n, u2 = r*s^-1 mod n as two n*32-byte
+    big-endian blobs (+ per-record validity flags) — the host scalar leg of
+    the TPU batch packer, replacing the Python-int pow() loop."""
+    lib = load()
+    assert lib is not None, "native library unavailable"
+    n = len(records)
+    if n == 0:
+        return b"", b"", []
+    rs, msg = _pack_rs_msg(records)
+    u1 = ctypes.create_string_buffer(32 * n)
+    u2 = ctypes.create_string_buffer(32 * n)
+    ok = ctypes.create_string_buffer(n)
+    lib.bcp_ecdsa_precompute(rs, msg, n, u1, u2, ok,
+                             nthreads if nthreads is not None
+                             else PAR_THREADS)
+    return u1.raw, u2.raw, [b == 1 for b in ok.raw]
 
 
 def merkle_root(txids: list[bytes]) -> tuple[bytes, bool]:

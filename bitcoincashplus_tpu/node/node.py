@@ -82,6 +82,13 @@ class Node:
         )
         log_printf("bcpd init: network=%s datadir=%s", self.params.network, self.datadir)
 
+        # -par=<n>: thread budget for the native CPU verify fallback
+        # (src/init.cpp -par -> CCheckQueue worker count; here the TPU batch
+        # is the worker pool, so -par bounds the HOST-side native threads)
+        from .. import native as _native
+
+        _native.PAR_THREADS = max(0, config.get_int("par", 0))
+
         # cs_main — one lock serializing all chainstate/mempool access
         self.cs_main = threading.RLock()
         self.shutdown_event = threading.Event()

@@ -196,6 +196,18 @@ def cast_to_bool(v: bytes) -> bool:
     return False
 
 
+def _ecdsa_verify_scalar(pt, r: int, s: int, e: int) -> bool:
+    """Scalar (non-batched) verify: the native C++ module when present
+    (SURVEY §3.1 binding plan's CPU fallback — ~500x the Python oracle),
+    else the oracle. Same acceptance set either way (test_native.py runs
+    the differential)."""
+    from .. import native
+
+    if native.available():
+        return native.ecdsa_verify(pt, r, s, e)
+    return secp.ecdsa_verify(pt, r, s, e)
+
+
 # ---- signature checkers (interpreter.h BaseSignatureChecker) ----
 
 @dataclass
@@ -263,7 +275,7 @@ class TransactionSignatureChecker(BaseSignatureChecker):
         if parsed is None:
             return False
         pt, r, s, e = parsed
-        return secp.ecdsa_verify(pt, r, s, e)
+        return _ecdsa_verify_scalar(pt, r, s, e)
 
     def check_locktime(self, locktime: int) -> bool:
         """CheckLockTime (interpreter.cpp:~1230) — BIP65 semantics."""

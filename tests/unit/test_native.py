@@ -83,3 +83,129 @@ def test_merkle_root_matches_python():
     _, mut_py = compute_merkle_root(mutated)
     _, mut_c = native.merkle_root(mutated)
     assert mut_c == mut_py
+
+
+# ---- native scalar secp256k1 (native/secp256k1.cpp) ----
+
+def _sig_corpus(n=25, seed=7):
+    """Signed + mutated (pubkey, r, s, e) cases with oracle verdicts."""
+    import random
+
+    from bitcoincashplus_tpu.crypto import secp256k1 as o
+
+    rng = random.Random(seed)
+    cases = []
+    for _ in range(n):
+        sk = rng.randrange(1, o.N)
+        e = rng.getrandbits(256)
+        r, s = o.ecdsa_sign(sk, e)
+        pub = o.point_mul(sk, o.G)
+        cases += [
+            (pub, r, s, e),           # valid
+            (pub, r, o.N - s, e),     # high-s twin: still raw-ECDSA valid
+            (pub, r, s, e + 1),       # wrong message
+            (pub, (r + 1) % o.N or 1, s, e),
+            (pub, r, 0, e),           # out-of-range scalars
+            (pub, 0, s, e),
+            (pub, r, o.N, e),
+            (pub, r, s, 0),           # degenerate message hashes
+            (pub, r, s, o.N),
+            (pub, r, s, o.N - 1),
+        ]
+    return cases
+
+
+def test_ecdsa_verify_differential():
+    from bitcoincashplus_tpu.crypto import secp256k1 as o
+
+    for pub, r, s, e in _sig_corpus():
+        assert native.ecdsa_verify(pub, r, s, e) == o.ecdsa_verify(
+            pub, r, s, e
+        ), (hex(r), hex(s), hex(e))
+
+
+def test_ecdsa_verify_batch_matches_scalar():
+    from dataclasses import dataclass
+
+    from bitcoincashplus_tpu.crypto import secp256k1 as o
+
+    @dataclass
+    class Rec:
+        pubkey: tuple
+        r: int
+        s: int
+        msg_hash: int
+
+    cases = _sig_corpus(n=10, seed=11)
+    recs = [Rec(p, r, s, e) for p, r, s, e in cases]
+    got = native.ecdsa_verify_batch(recs)
+    want = [o.ecdsa_verify(p, r, s, e) for p, r, s, e in cases]
+    assert got == want
+    # threaded path agrees with single-thread
+    assert native.ecdsa_verify_batch(recs, nthreads=4) == want
+
+
+def test_ecdsa_precompute_matches_python():
+    import random
+    from dataclasses import dataclass
+
+    from bitcoincashplus_tpu.crypto import secp256k1 as o
+
+    @dataclass
+    class Rec:
+        pubkey: tuple
+        r: int
+        s: int
+        msg_hash: int
+
+    rng = random.Random(3)
+    recs = []
+    for _ in range(16):
+        sk = rng.randrange(1, o.N)
+        e = rng.getrandbits(256)
+        r, s = o.ecdsa_sign(sk, e)
+        recs.append(Rec(o.point_mul(sk, o.G), r, s, e))
+    u1_blob, u2_blob, ok = native.ecdsa_precompute(recs)
+    assert all(ok)
+    for i, rec in enumerate(recs):
+        w = pow(rec.s, o.N - 2, o.N)
+        u1 = rec.msg_hash % o.N * w % o.N
+        u2 = rec.r * w % o.N
+        assert int.from_bytes(u1_blob[32 * i:32 * i + 32], "big") == u1
+        assert int.from_bytes(u2_blob[32 * i:32 * i + 32], "big") == u2
+    # out-of-range records come back flagged, not garbage-accepted
+    bad = [Rec(recs[0].pubkey, 0, recs[0].s, recs[0].msg_hash),
+           Rec(recs[0].pubkey, recs[0].r, o.N, recs[0].msg_hash)]
+    _, _, ok = native.ecdsa_precompute(bad)
+    assert ok == [False, False]
+
+
+def test_ecdsa_wraparound_acceptance():
+    """The r vs r+n x-coordinate wraparound: craft a signature whose R.x
+    lands above n so the verify must try the +n candidate (the same gate
+    the TPU kernel enforces in-kernel)."""
+    import random
+
+    from bitcoincashplus_tpu.crypto import secp256k1 as o
+
+    import ctypes
+    import random
+
+    rng = random.Random(5)
+    sk = rng.randrange(1, o.N)
+    pub = o.point_mul(sk, o.G)
+    e = rng.getrandbits(256)
+    r, s = o.ecdsa_sign(sk, e)
+    assert native.ecdsa_verify(pub, r, s, e)
+    # r in [n, 2^256) must be rejected by the C range check — drive the raw
+    # entry point so the Python wrapper's mod-2^256 cannot alias it back
+    # into range (r + n stays < 2^256 iff r < 2^256 - n; pick r' = n, the
+    # smallest out-of-range value, and r' = n + r when it fits)
+    lib = native.load()
+    pub_b = pub[0].to_bytes(32, "big") + pub[1].to_bytes(32, "big")
+    e_b = (e % (1 << 256)).to_bytes(32, "big")
+    for r_bad in [o.N] + ([o.N + r] if o.N + r < (1 << 256) else []):
+        rs_b = r_bad.to_bytes(32, "big") + s.to_bytes(32, "big")
+        assert lib.bcp_ecdsa_verify(
+            ctypes.c_char_p(pub_b), ctypes.c_char_p(rs_b),
+            ctypes.c_char_p(e_b)) == 0
