@@ -84,10 +84,14 @@ class Node:
 
         # -par=<n>: thread budget for the native CPU verify fallback
         # (src/init.cpp -par -> CCheckQueue worker count; here the TPU batch
-        # is the worker pool, so -par bounds the HOST-side native threads)
+        # is the worker pool, so -par bounds the HOST-side native threads).
+        # Reference semantics kept: 0 = auto, -N = leave N cores free.
         from .. import native as _native
 
-        _native.PAR_THREADS = max(0, config.get_int("par", 0))
+        par = config.get_int("par", 0)
+        if par < 0:
+            par = max(1, (os.cpu_count() or 1) + par)
+        _native.PAR_THREADS = par
 
         # cs_main — one lock serializing all chainstate/mempool access
         self.cs_main = threading.RLock()

@@ -71,7 +71,14 @@ class CKey:
     def sign(self, msg_hash32: bytes) -> bytes:
         """DER-encoded signature WITHOUT hashtype byte (CKey::Sign)."""
         e = int.from_bytes(msg_hash32, "big")
-        r, s = secp.ecdsa_sign(self.secret, e)
+        from .. import native
+
+        if native.available():
+            # bit-identical to the oracle signer (same RFC6979 nonce),
+            # ~100x faster — differential-tested in test_native.py
+            r, s = native.ecdsa_sign(self.secret, e)
+        else:
+            r, s = secp.ecdsa_sign(self.secret, e)
         return secp.sig_der_encode(r, s)
 
 

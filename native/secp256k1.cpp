@@ -606,9 +606,80 @@ static void precompute_range(long lo, long hi, void* p) {
     }
 }
 
+// k*G as affine x (mod p), via the fixed wNAF G table. Returns false for
+// k = 0 / k >= n or if the ladder lands at infinity (unreachable for
+// valid k, kept for safety).
+static bool base_mult_affine_x(const N256& k, N256& x_out) {
+    std::call_once(g_tab_once, build_g_tab);
+    if (is_zero_n(k) || cmp_n(k, N_M) >= 0) return false;
+    int8_t w1[260];
+    int l1 = wnaf_recode(k, 7, w1);
+    Jac acc;
+    acc.inf = true;
+    for (int i = l1 - 1; i >= 0; i--) {
+        pt_double(acc, acc);
+        if (w1[i]) {
+            int dg = w1[i];
+            if (dg > 0) {
+                pt_add_mixed(acc, acc, g_tab[(dg - 1) >> 1]);
+            } else {
+                Aff neg = g_tab[(-dg - 1) >> 1];
+                fneg(neg.y, neg.y);
+                pt_add_mixed(acc, acc, neg);
+            }
+        }
+    }
+    if (acc.inf || is_zero_n(acc.Z)) return false;
+    N256 pm2 = P_M, zi, zi2;
+    pm2.d[0] -= 2;
+    modpow(acc.Z, pm2, P_K, P_M, zi);
+    fsqr(zi2, zi);
+    fmul(x_out, acc.X, zi2);
+    return true;
+}
+
 }  // namespace
 
 extern "C" {
+
+// ECDSA sign with a caller-supplied nonce (the RFC6979 derivation stays in
+// Python so signatures are bit-identical to the oracle signer — HMAC cost
+// is microseconds; the EC math here is what was slow). Writes r||s (32-byte
+// big-endian each) with low-s normalization. Returns 1, or 0 when the
+// caller must retry with the next nonce (r == 0 or s == 0) or inputs are
+// out of range.
+int bcp_ecdsa_sign(const uint8_t* sk32, const uint8_t* e32,
+                   const uint8_t* k32, uint8_t* rs64_out) {
+    N256 sk = load_be(sk32), e = load_be(e32), k = load_be(k32);
+    if (is_zero_n(sk) || cmp_n(sk, N_M) >= 0) return 0;
+    if (cmp_n(e, N_M) >= 0) sub_n(e, N_M);
+    N256 x;
+    if (!base_mult_affine_x(k, x)) return 0;
+    N256 r = x;
+    while (cmp_n(r, N_M) >= 0) sub_n(r, N_M);
+    if (is_zero_n(r)) return 0;
+    // s = k^-1 (e + r*sk) mod n
+    N256 nm2 = N_M, kinv, rd, sum, s;
+    nm2.d[0] -= 2;
+    modpow(k, nm2, N_K, N_M, kinv);
+    modmul(r, sk, N_K, N_M, rd);
+    sum = e;
+    if (add_n(sum, rd) || cmp_n(sum, N_M) >= 0) sub_n(sum, N_M);
+    modmul(kinv, sum, N_K, N_M, s);
+    if (is_zero_n(s)) return 0;
+    // low-s: if s > n/2, s = n - s  (n odd: n/2 rounds down, so the
+    // comparison s*2 > n is exact via add-with-carry)
+    N256 s2 = s;
+    u64 c = add_n(s2, s);
+    if (c || cmp_n(s2, N_M) > 0) {
+        N256 ns = N_M;
+        sub_n(ns, s);
+        s = ns;
+    }
+    store_be(r, rs64_out);
+    store_be(s, rs64_out + 32);
+    return 1;
+}
 
 // Single ECDSA verify: pub = 64-byte x||y (32-byte big-endian each),
 // rs = 64-byte r||s, msg = 32-byte message hash. Returns 1 valid / 0 not.
@@ -633,6 +704,55 @@ void bcp_ecdsa_precompute(const uint8_t* rs, const uint8_t* msg, long n,
                           int nthreads) {
     PrecompCtx c = {rs, msg, u1, u2, ok};
     run_chunked(n, nthreads, precompute_range, &c);
+}
+
+// Pubkey parse/decompress (CPubKey / secp256k1_ec_pubkey_parse semantics,
+// matching crypto/secp256k1.pubkey_parse): 33-byte 02/03 compressed,
+// 65-byte 04 uncompressed or 06/07 hybrid (hybrid requires matching y
+// parity). Writes affine x||y (32-byte big-endian each); returns 1 ok,
+// 0 malformed/off-curve.
+int bcp_pubkey_parse(const uint8_t* data, long len, uint8_t* out64) {
+    if (len == 33 && (data[0] == 2 || data[0] == 3)) {
+        N256 x = load_be(data + 1);
+        if (cmp_n(x, P_M) >= 0) return 0;
+        // y^2 = x^3 + 7; sqrt via pow((p+1)/4) — p = 3 mod 4
+        N256 y2, x3, seven = {{7, 0, 0, 0}};
+        fsqr(x3, x);
+        fmul(x3, x3, x);
+        fadd(y2, x3, seven);
+        // (p+1)/4
+        static const N256 P14 = {{0xFFFFFFFFBFFFFF0CULL, 0xFFFFFFFFFFFFFFFFULL,
+                                  0xFFFFFFFFFFFFFFFFULL, 0x3FFFFFFFFFFFFFFFULL}};
+        N256 y;
+        modpow(y2, P14, P_K, P_M, y);
+        N256 chk;
+        fsqr(chk, y);
+        if (cmp_n(chk, y2) != 0) return 0;  // non-residue: off-curve x
+        if ((y.d[0] & 1) != (data[0] & 1)) {
+            N256 ny;
+            fneg(ny, y);
+            y = ny;
+        }
+        store_be(x, out64);
+        store_be(y, out64 + 32);
+        return 1;
+    }
+    if (len == 65 && (data[0] == 4 || data[0] == 6 || data[0] == 7)) {
+        N256 x = load_be(data + 1), y = load_be(data + 33);
+        if (cmp_n(x, P_M) >= 0 || cmp_n(y, P_M) >= 0) return 0;
+        if ((data[0] == 6 || data[0] == 7) && (y.d[0] & 1) != (data[0] & 1))
+            return 0;
+        N256 y2, x3, seven = {{7, 0, 0, 0}};
+        fsqr(y2, y);
+        fsqr(x3, x);
+        fmul(x3, x3, x);
+        fadd(x3, x3, seven);
+        if (cmp_n(y2, x3) != 0) return 0;
+        store_be(x, out64);
+        store_be(y, out64 + 32);
+        return 1;
+    }
+    return 0;
 }
 
 }  // extern "C"

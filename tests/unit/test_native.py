@@ -181,31 +181,87 @@ def test_ecdsa_precompute_matches_python():
 
 
 def test_ecdsa_wraparound_acceptance():
-    """The r vs r+n x-coordinate wraparound: craft a signature whose R.x
-    lands above n so the verify must try the +n candidate (the same gate
-    the TPU kernel enforces in-kernel)."""
+    """The r vs r+n x-coordinate wraparound: chosen-key construction of a
+    signature whose R.x lies in [n, p) so verification MUST accept via the
+    (r+n)*Z^2 candidate (the same gate the TPU kernel enforces in-kernel),
+    plus raw rejection of r >= n."""
+    import ctypes
     import random
 
     from bitcoincashplus_tpu.crypto import secp256k1 as o
 
-    import ctypes
-    import random
+    # find an on-curve x in (n, p) — x = n itself is on-curve but gives
+    # r = 0, which the range check rejects; density ~50% per candidate
+    x = o.N + 1
+    while True:
+        y2 = (x * x * x + o.B) % o.P
+        y = pow(y2, (o.P + 1) // 4, o.P)
+        if y * y % o.P == y2:
+            break
+        x += 1
+    R = (x, y)
+    r = x - o.N           # in [1, p-n): the wraparound-aliased r
+    assert 1 <= r < o.N
+    s, e = 7, 1234567     # arbitrary; Q makes the equation hold
+    # verify computes R' = (e/s)G + (r/s)Q; force R' == R:
+    # Q = (s*R - e*G) * r^{-1}
+    r_inv = pow(r, o.N - 2, o.N)
+    Q = o.point_mul(
+        r_inv, o.point_add(o.point_mul(s, R), o.point_mul(-e % o.N, o.G))
+    )
+    assert o.ecdsa_verify(Q, r, s, e), "oracle must accept via x_R = r + n"
+    assert native.ecdsa_verify(Q, r, s, e), "native must accept via r + n"
 
+    # and r in [n, 2^256) must be rejected by the C range check — drive the
+    # raw entry point so the Python wrapper's mod-2^256 cannot alias it
     rng = random.Random(5)
     sk = rng.randrange(1, o.N)
     pub = o.point_mul(sk, o.G)
-    e = rng.getrandbits(256)
-    r, s = o.ecdsa_sign(sk, e)
-    assert native.ecdsa_verify(pub, r, s, e)
-    # r in [n, 2^256) must be rejected by the C range check — drive the raw
-    # entry point so the Python wrapper's mod-2^256 cannot alias it back
-    # into range (r + n stays < 2^256 iff r < 2^256 - n; pick r' = n, the
-    # smallest out-of-range value, and r' = n + r when it fits)
+    e2 = rng.getrandbits(256)
+    r2, s2 = o.ecdsa_sign(sk, e2)
     lib = native.load()
     pub_b = pub[0].to_bytes(32, "big") + pub[1].to_bytes(32, "big")
-    e_b = (e % (1 << 256)).to_bytes(32, "big")
-    for r_bad in [o.N] + ([o.N + r] if o.N + r < (1 << 256) else []):
-        rs_b = r_bad.to_bytes(32, "big") + s.to_bytes(32, "big")
+    e_b = (e2 % (1 << 256)).to_bytes(32, "big")
+    for r_bad in [o.N] + ([o.N + r2] if o.N + r2 < (1 << 256) else []):
+        rs_b = r_bad.to_bytes(32, "big") + s2.to_bytes(32, "big")
         assert lib.bcp_ecdsa_verify(
             ctypes.c_char_p(pub_b), ctypes.c_char_p(rs_b),
             ctypes.c_char_p(e_b)) == 0
+
+
+def test_ecdsa_sign_matches_oracle():
+    import random
+
+    from bitcoincashplus_tpu.crypto import secp256k1 as o
+
+    rng = random.Random(21)
+    for _ in range(12):
+        sk = rng.randrange(1, o.N)
+        e = rng.getrandbits(256)
+        assert native.ecdsa_sign(sk, e) == o.ecdsa_sign(sk, e)
+
+
+def test_pubkey_parse_matches_oracle():
+    import random
+
+    from bitcoincashplus_tpu.crypto import secp256k1 as o
+
+    rng = random.Random(22)
+    for _ in range(20):
+        pt = o.point_mul(rng.randrange(1, o.N), o.G)
+        for comp in (True, False):
+            data = o.pubkey_serialize(pt, comp)
+            assert native.pubkey_parse(data) == o.pubkey_parse(data)
+        x, y = pt
+        for pref in (6, 7):  # hybrid: parity must match
+            data = bytes([pref]) + x.to_bytes(32, "big") + y.to_bytes(32, "big")
+            assert native.pubkey_parse(data) == o.pubkey_parse(data)
+    for bad in (
+        b"\x02" + o.P.to_bytes(32, "big"),       # x >= p
+        b"\x02" + (5).to_bytes(32, "big"),       # x with no sqrt / on-curve?
+        b"\x05" + b"\x00" * 32,                  # bad prefix
+        b"\x02" + b"\x00" * 31,                  # bad length
+        b"\x04" + o.P.to_bytes(32, "big") + b"\x01" * 32,
+        b"",
+    ):
+        assert native.pubkey_parse(bad) == o.pubkey_parse(bad)
