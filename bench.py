@@ -88,12 +88,24 @@ def bench_header_hash():
         sha256d_headers_jit(dev_words).block_until_ready()
         dts.append(time.perf_counter() - t0)
     dev_mhs = B / sorted(dts)[1] / 1e6
+    # honest CPU comparison: the native C++ scalar path on the same batch
+    # (hashlib-equivalent; what one host core does) — VERDICT r3 #5
+    cpu_mhs = None
+    from bitcoincashplus_tpu import native as _nat
+
+    if _nat.available():
+        flat = batch.tobytes()
+        t0 = time.perf_counter()
+        _nat.hash_headers(flat)
+        cpu_mhs = B / (time.perf_counter() - t0) / 1e6
     emit("header_hash_batch_throughput", round(mhs, 2), "MH/s",
          round(mhs * 1e6 / (BASELINE_GHS * 1e9), 6),
          device_resident_mhs=round(dev_mhs, 2),
+         cpu_native_mhs=round(cpu_mhs, 2) if cpu_mhs else None,
          note="64Ki-header batch incl host pack/unpack + tunnel transfers "
               "(transfer-bound here); device_resident_mhs excludes "
-              "host<->device transfer; genesis+hashlib anchored")
+              "host<->device transfer; cpu_native_mhs = one host core via "
+              "native C++; genesis+hashlib anchored")
 
 
 def bench_merkle():
@@ -113,8 +125,25 @@ def bench_merkle():
         compute_merkle_root_tpu(txids)
         ts.append(time.perf_counter() - t0)
     dt = sorted(ts)[1]
+    # honest CPU comparison: native C++ (or hashlib) on the same snapshot —
+    # on a tunneled single chip the device number loses to the host; the
+    # point of the config is kernel validation, and the bench says so
+    from bitcoincashplus_tpu import native as _nat
+
+    t0 = time.perf_counter()
+    if _nat.available():
+        _nat.merkle_root(txids)
+    else:
+        compute_merkle_root(txids)
+    cpu_ms = (time.perf_counter() - t0) * 1e3
     emit("merkle_root_4096tx", round(dt * 1e3, 2), "ms",
-         0.0, note="single-dispatch on-device tree reduction (masked odd-duplication); was 12 per-level dispatches")
+         round(cpu_ms / (dt * 1e3), 4),
+         cpu_native_ms=round(cpu_ms, 2),
+         note="single-dispatch on-device tree reduction (masked "
+              "odd-duplication); vs_baseline = cpu_ms/device_ms — the "
+              "device pays one serving-tunnel round trip (~200 ms), so "
+              "host CPU wins this config outright on this deployment; "
+              "see ROOFLINE.md §6")
 
 
 def bench_ecdsa_batch():
@@ -137,28 +166,51 @@ def bench_ecdsa_batch():
     ]
     ok = ecdsa_batch.verify_batch(records, backend="device")  # warm/compile
     assert bool(ok.all())
-    t0 = time.perf_counter()
-    ok = ecdsa_batch.verify_batch(records, backend="device")
-    dt = time.perf_counter() - t0
-    assert bool(ok.all())
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ok = ecdsa_batch.verify_batch(records, backend="device")
+        ts.append(time.perf_counter() - t0)
+        assert bool(ok.all())
+    dt = sorted(ts)[1]
     sps = len(records) / dt
     from bitcoincashplus_tpu.ops.ecdsa_batch import STATS as _st
     from bitcoincashplus_tpu.ops.ecdsa_batch import pallas_enabled as _pe
 
     # label from the same predicate dispatch uses (a disabled/fallen-back
     # pallas path must not be reported as pallas)
-    kernel = "pallas-vmem" if _pe() and not _st.pallas_fallbacks else "xla"
-    emit("ecdsa_batch_verify_10k", round(sps), "sigs/s", 0.0,
+    kernel = "pallas-w4-3d" if _pe() and not _st.pallas_fallbacks else "xla"
+    # honest CPU comparison: the native C++ scalar verify on the same
+    # records (one thread per core; 1 core on this host)
+    from bitcoincashplus_tpu import native as _nat
+
+    cpu_sps = None
+    if _nat.available():
+        sample = records[:1000]
+        t0 = time.perf_counter()
+        _nat.ecdsa_verify_batch(sample)
+        cpu_sps = len(sample) / (time.perf_counter() - t0)
+    emit("ecdsa_batch_verify_10k", round(sps), "sigs/s",
+         round(sps / cpu_sps, 2) if cpu_sps else 0.0,
          kernel=kernel,
-         note=f"B=10000 through the full dispatch path ({dt:.2f}s); 64 "
-              "distinct sigs tiled (per-lane work identical); pallas "
-              "kernel keeps the 256-step ladder in VMEM (2.4x the XLA form)")
+         cpu_native_sigs_per_s=round(cpu_sps) if cpu_sps else None,
+         note=f"B=10000 through the full dispatch path ({dt:.2f}s, median "
+              "of 3); 64 distinct sigs tiled (per-lane work identical); "
+              "w=4 windowed ladder in (rows,8,128) exact-vreg tiles, "
+              "degenerate-collision lanes host-rechecked; vs_baseline = "
+              "device/cpu-core ratio")
 
 
 def bench_virtual_shard():
-    """Config 5: 8-chip nonce shard on the VIRTUAL CPU mesh — scaling
-    speedup only (one real chip on this host; the same shard_map program is
-    what rides ICI on real hardware). Subprocess keeps JAX_PLATFORMS clean."""
+    """Config 5: nonce-shard scaling CURVE (1/2/4/8) on the VIRTUAL CPU
+    mesh, with per-chip tiles-done (shard-imbalance observability) and an
+    8-way sig_shard leg (config 4 x config 5 composition). One real chip on
+    this host, so these numbers measure the shard_map program's scaling on
+    a CPU mesh — NOISY and not ICI: virtual devices share host cores, so
+    the curve is a lower bound sanity check, not a hardware claim (the r3
+    run printed 1.84x, an earlier r4 run 4.45x for the same code). The
+    program itself is identical to what rides ICI on real hardware.
+    Subprocess keeps JAX_PLATFORMS clean."""
     code = r"""
 import os, time, json
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -168,27 +220,68 @@ import jax
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
 from bitcoincashplus_tpu.parallel.nonce_shard import sweep_header_sharded
 header = bytes(range(80))
-def timed(n_chips, tiles):
+def timed(n_chips, tiles_per_chip):
     t0 = time.perf_counter()
-    nonce, hashes = sweep_header_sharded(header, 0, max_nonces=tiles * 4096,
-                                         tile=4096, n_chips=n_chips)
-    return time.perf_counter() - t0, hashes
-timed(8, 8)   # warm 8-way
-timed(1, 1)   # warm 1-way
-t8, h8 = timed(8, 64)
-t1, h1 = timed(1, 8)
-r8, r1 = h8 / t8, h1 / t1
-print(json.dumps({"speedup": r8 / r1, "r1_mhs": r1 / 1e6, "r8_mhs": r8 / 1e6}))
+    nonce, hashes, per_chip = sweep_header_sharded(
+        header, 0, max_nonces=tiles_per_chip * n_chips * 4096,
+        tile=4096, n_chips=n_chips, return_per_chip=True)
+    return time.perf_counter() - t0, hashes, per_chip
+curve = {}
+per_chip_8 = None
+for n in (1, 2, 4, 8):
+    timed(n, 1)  # warm/compile this mesh shape
+    best = 0.0
+    for _ in range(3):
+        t, h, pc = timed(n, 16)
+        best = max(best, h / t)
+        if n == 8:
+            per_chip_8 = pc
+    curve[n] = best / 1e6
+# sig_shard leg: the ECDSA batch sharded over the virtual mesh (XLA
+# bit-ladder kernel; small batch keeps CPU wall-time sane)
+from dataclasses import dataclass
+import random
+from bitcoincashplus_tpu.crypto import secp256k1 as o
+from bitcoincashplus_tpu.parallel.sig_shard import verify_batch_sharded
+@dataclass
+class Rec:
+    pubkey: tuple; r: int; s: int; msg_hash: int
+rng = random.Random(7)
+recs = []
+for _ in range(16):
+    sk = rng.randrange(1, o.N); e = rng.getrandbits(256)
+    r, s = o.ecdsa_sign(sk, e)
+    recs.append(Rec(o.point_mul(sk, o.G), r, s, e))
+recs = recs * 8  # 128 lanes
+sig = {}
+for n in (1, 8):
+    verify_batch_sharded(recs, n)  # warm with the SAME batch shape
+    t0 = time.perf_counter()
+    ok = verify_batch_sharded(recs, n)
+    sig[n] = len(recs) / (time.perf_counter() - t0)
+    assert ok.all()
+print(json.dumps({"curve_mhs": curve, "per_chip_tiles_8": per_chip_8,
+                  "sig_1": sig[1], "sig_8": sig[8]}))
 """ % os.path.dirname(os.path.abspath(__file__))
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     try:
         out = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                             text=True, env=env, timeout=900)
+                             text=True, env=env, timeout=1800)
         line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else "{}"
         r = json.loads(line)
-        emit("nonce_shard_virtual8_speedup", round(r["speedup"], 2), "x", 0.0,
-             note="8-device VIRTUAL CPU mesh (no multi-chip hardware here); "
-                  "shard_map program identical to the ICI path")
+        curve = r["curve_mhs"]
+        speedup = round(curve["8"] / curve["1"], 2) if "1" in curve else \
+            round(curve[8] / curve[1], 2)
+        emit("nonce_shard_virtual8_speedup", speedup, "x", 0.0,
+             scaling_curve_mhs={k: round(v, 2) for k, v in curve.items()},
+             per_chip_tiles_8=r["per_chip_tiles_8"],
+             sig_shard_sigs_per_s={"1": round(r["sig_1"]),
+                                   "8": round(r["sig_8"])},
+             note="VIRTUAL 8-device CPU mesh (no multi-chip hardware here): "
+                  "virtual chips share host cores, so the curve is a "
+                  "correctness/lower-bound check, NOT an ICI scaling claim; "
+                  "run-to-run variance on this host is large (1.8x-4.5x "
+                  "observed for identical code)")
     except Exception as e:  # pragma: no cover - diagnostics only
         emit("nonce_shard_virtual8_speedup", -1, "x", 0.0,
              note=f"subprocess failed: {e}")
@@ -284,6 +377,28 @@ def bench_reindex():
         from bitcoincashplus_tpu.node.config import Config
         from bitcoincashplus_tpu.node.node import Node
         from bitcoincashplus_tpu.ops import ecdsa_batch
+
+        # warm the verify kernel: the w4 Pallas compile is ~1-2 min on the
+        # tunneled chip and would otherwise land inside the first block's
+        # measured verify time (a mainnet-scale run amortizes it to zero)
+        if jax.default_backend() != "cpu":
+            import random as _random
+
+            from bitcoincashplus_tpu.crypto import secp256k1 as _o
+            from bitcoincashplus_tpu.script.interpreter import SigCheckRecord
+
+            from bitcoincashplus_tpu import native as _nat
+
+            _rng = _random.Random(1)
+            _sk = _rng.randrange(1, _o.N)
+            _pub = _o.point_mul(_sk, _o.G)
+            _sign = _nat.ecdsa_sign if _nat.available() else _o.ecdsa_sign
+            warm_recs = []
+            for i in range(130):  # > 128 lanes: exercises the 3D program
+                _e = _rng.getrandbits(256)
+                _r, _s = _sign(_sk, _e)
+                warm_recs.append(SigCheckRecord(_pub, _r, _s, _e))
+            ecdsa_batch.verify_batch(warm_recs, backend="device")
 
         stats0 = ecdsa_batch.STATS.snapshot()
         cfg = Config()

@@ -57,6 +57,9 @@ class BatchStats:
     in_flight: int = 0
     max_in_flight: int = 0
     pallas_fallbacks: int = 0  # Mosaic compile failures -> XLA kernel
+    # w4 kernel lanes flagged degenerate (adversarially-crafted H == 0
+    # collisions) and re-verified on the CPU path — see ops/secp256k1.py
+    degenerate_rechecks: int = 0
     buckets_used: dict = field(default_factory=dict)
 
     def snapshot(self) -> dict:
@@ -69,12 +72,19 @@ STATS = BatchStats()
 
 
 def _bucket_for(n: int, pallas: bool = False) -> int:
-    if pallas and n > 2048:
-        # the Pallas kernel runs 4096-lane programs + one 2048 tail, so
-        # 2048-granular padding wastes at most 20% of a big batch (vs 64%
-        # padding 10k to the XLA path's 16384 bucket); compiled-program
-        # shapes stay bounded ({4096, 2048} slices)
-        return ((n + 2047) // 2048) * 2048
+    if pallas and n > 128:
+        # w4-bytes program buckets: powers of two in [1024, 16384], then
+        # 16384-granular (the program splits at 16384 per call) — the jit
+        # bakes B into shapes and grid, so bucket sizes ARE compiled-
+        # program shapes and must stay a small bounded set (a fresh Mosaic
+        # compile is ~1-2 min on a tunneled chip). Batches <= 128 lanes
+        # use the 2D kernel's small buckets.
+        b = 1024
+        while b < n and b < 16384:
+            b *= 2
+        if n > b:  # > 16384: round to 16384-granular multi-call batches
+            return ((n + 16383) // 16384) * 16384
+        return b
     for b in BUCKETS:
         if n <= b:
             return b
@@ -113,40 +123,118 @@ def _scalar_bitplanes(records: Sequence, n: int) -> tuple:
     return u1, u2, None
 
 
-def pack_records(records: Sequence, bucket: int):
-    """Step 2+3: SoA arrays padded to ``bucket`` lanes.
+_LIMB_WEIGHTS = (1 << np.arange(13)).astype(np.uint32)
 
-    Padded lanes get q_inf=True (poisoned: kernel reports False) and are
-    masked out by the caller — they can never turn a bad batch good or a
-    good batch bad."""
+
+def _limb_cols(blob: bytes, n: int, bucket: int) -> np.ndarray:
+    """n concatenated 32-byte big-endian values -> (20, bucket) 13-bit limb
+    columns (padding lanes zero). Fully vectorized — the per-record
+    to_limbs_np loop was ~60% of host pack time at 10k sigs."""
     from . import secp256k1 as dev
 
+    mat = np.frombuffer(blob, np.uint8).reshape(n, 32)
+    bits = np.unpackbits(mat, axis=1)[:, ::-1]  # LSB-first bit order
+    bits = np.concatenate(
+        [bits, np.zeros((n, 13 * dev.N_LIMBS - 256), np.uint8)], axis=1
+    )
+    limbs = (
+        bits.reshape(n, dev.N_LIMBS, 13).astype(np.uint32) * _LIMB_WEIGHTS
+    ).sum(axis=2)
+    out = np.zeros((dev.N_LIMBS, bucket), np.uint32)
+    out[:, :n] = limbs.T
+    return out
+
+
+def _pack_limbs(records: Sequence, bucket: int):
+    """Shared SoA limb packing: pubkey/r limbs + poison masks, padded to
+    ``bucket`` lanes. Padded lanes get q_inf=True (poisoned: kernel reports
+    False) and are masked out by the caller — they can never turn a bad
+    batch good or a good batch bad. Returns the (n, 32) u1/u2 scalar byte
+    matrices alongside (the caller picks bit planes or window planes)."""
     n = len(records)
-    u1b = np.zeros((256, bucket), np.uint32)
-    u2b = np.zeros((256, bucket), np.uint32)
-    qx = np.zeros((dev.N_LIMBS, bucket), np.uint32)
-    qy = np.zeros((dev.N_LIMBS, bucket), np.uint32)
-    r0 = np.zeros((dev.N_LIMBS, bucket), np.uint32)
-    rn = np.zeros((dev.N_LIMBS, bucket), np.uint32)
+    u1_bytes, u2_bytes, range_ok = _scalar_bitplanes(records, n)
+    wraps = [rec.r + oracle.N < oracle.P for rec in records]
+    qx = _limb_cols(
+        b"".join(rec.pubkey[0].to_bytes(32, "big") for rec in records),
+        n, bucket)
+    qy = _limb_cols(
+        b"".join(rec.pubkey[1].to_bytes(32, "big") for rec in records),
+        n, bucket)
+    r0 = _limb_cols(
+        b"".join(rec.r.to_bytes(32, "big") for rec in records), n, bucket)
+    rn = _limb_cols(
+        b"".join(
+            (rec.r + oracle.N if w else rec.r).to_bytes(32, "big")
+            for rec, w in zip(records, wraps)
+        ), n, bucket)
     q_inf = np.ones(bucket, bool)  # default poisoned (padding)
     wrap_ok = np.zeros(bucket, bool)
-
-    # bit-planes, MSB first (the kernel's fori_loop order): unpackbits on
-    # the 32-byte big-endian scalars — vectorized, not a 256·B Python loop
-    # (host packing must stay negligible next to the device dispatch)
-    u1_bytes, u2_bytes, range_ok = _scalar_bitplanes(records, n)
-    u1b[:, :n] = np.unpackbits(u1_bytes, axis=1).T
-    u2b[:, :n] = np.unpackbits(u2_bytes, axis=1).T
-    for j, rec in enumerate(records):
-        qx[:, j] = dev.to_limbs_np(rec.pubkey[0])
-        qy[:, j] = dev.to_limbs_np(rec.pubkey[1])
-        r0[:, j] = dev.to_limbs_np(rec.r)
-        wrap = rec.r + oracle.N < oracle.P
-        rn[:, j] = dev.to_limbs_np(rec.r + oracle.N if wrap else rec.r)
-        wrap_ok[j] = wrap
+    wrap_ok[:n] = wraps
     # real lanes un-poisoned, except any the precompute range-flagged
     q_inf[:n] = False if range_ok is None else ~np.asarray(range_ok, bool)
+    return u1_bytes, u2_bytes, qx, qy, q_inf, r0, rn, wrap_ok
+
+
+def pack_records(records: Sequence, bucket: int):
+    """Step 2+3 for the bit-ladder kernels: SoA arrays padded to ``bucket``
+    lanes with (256, B) MSB-first bit planes. unpackbits on the 32-byte
+    big-endian scalars — vectorized, not a 256·B Python loop (host packing
+    must stay negligible next to the device dispatch)."""
+    n = len(records)
+    u1_bytes, u2_bytes, qx, qy, q_inf, r0, rn, wrap_ok = _pack_limbs(
+        records, bucket
+    )
+    u1b = np.zeros((256, bucket), np.uint32)
+    u2b = np.zeros((256, bucket), np.uint32)
+    u1b[:, :n] = np.unpackbits(u1_bytes, axis=1).T
+    u2b[:, :n] = np.unpackbits(u2_bytes, axis=1).T
     return u1b, u2b, qx, qy, q_inf, r0, rn, wrap_ok
+
+
+def pack_records_w4(records: Sequence, bucket: int):
+    """pack_records for the w=4 windowed Pallas kernel: (64, B) 4-bit
+    window planes instead of bit planes."""
+    from . import secp256k1 as dev
+
+    u1_bytes, u2_bytes, qx, qy, q_inf, r0, rn, wrap_ok = _pack_limbs(
+        records, bucket
+    )
+    u1w = dev.bits_to_windows_np(u1_bytes, bucket)
+    u2w = dev.bits_to_windows_np(u2_bytes, bucket)
+    return u1w, u2w, qx, qy, q_inf, r0, rn, wrap_ok
+
+
+def pack_records_w4_bytes(records: Sequence, bucket: int):
+    """Byte-matrix pack for the single-dispatch w4 pipeline: every 256-bit
+    field as a (bucket, 32) big-endian uint8 matrix (window/limb expansion
+    happens ON DEVICE — ops/secp256k1._w4_bytes_program), masks as uint8
+    vectors. ~5x less host->device traffic than the expanded planes."""
+    n = len(records)
+    u1_bytes, u2_bytes, range_ok = _scalar_bitplanes(records, n)
+    wraps = [rec.r + oracle.N < oracle.P for rec in records]
+
+    def mat(blob: bytes) -> np.ndarray:
+        out = np.zeros((bucket, 32), np.uint8)
+        out[:n] = np.frombuffer(blob, np.uint8).reshape(n, 32)
+        return out
+
+    u1m = np.zeros((bucket, 32), np.uint8)
+    u1m[:n] = u1_bytes
+    u2m = np.zeros((bucket, 32), np.uint8)
+    u2m[:n] = u2_bytes
+    qxb = mat(b"".join(rec.pubkey[0].to_bytes(32, "big") for rec in records))
+    qyb = mat(b"".join(rec.pubkey[1].to_bytes(32, "big") for rec in records))
+    r0b = mat(b"".join(rec.r.to_bytes(32, "big") for rec in records))
+    rnb = mat(b"".join(
+        (rec.r + oracle.N if w else rec.r).to_bytes(32, "big")
+        for rec, w in zip(records, wraps)
+    ))
+    q_inf = np.ones(bucket, np.uint8)
+    q_inf[:n] = 0 if range_ok is None else \
+        (~np.asarray(range_ok, bool)).astype(np.uint8)
+    wrap8 = np.zeros(bucket, np.uint8)
+    wrap8[:n] = np.asarray(wraps, np.uint8)
+    return u1m, u2m, qxb, qyb, q_inf, r0b, rnb, wrap8
 
 
 def _verify_cpu(records: Sequence) -> np.ndarray:
@@ -200,13 +288,17 @@ class BatchHandle:
     master/worker overlap, with XLA's async runtime as the worker pool.
     `.result()` materializes (blocks) and finalizes stats."""
 
-    __slots__ = ("_n", "_bucket", "_device_ok", "_cpu_ok")
+    __slots__ = ("_n", "_bucket", "_device_ok", "_cpu_ok", "_degen",
+                 "_records")
 
-    def __init__(self, n, bucket=0, device_ok=None, cpu_ok=None):
+    def __init__(self, n, bucket=0, device_ok=None, cpu_ok=None,
+                 degen=None, records=None):
         self._n = n
         self._bucket = bucket
         self._device_ok = device_ok
         self._cpu_ok = cpu_ok
+        self._degen = degen
+        self._records = records
 
     def result(self) -> np.ndarray:
         if self._device_ok is None:
@@ -220,7 +312,20 @@ class BatchHandle:
         STATS.device_seconds += time.monotonic() - t0
         STATS.in_flight = max(0, STATS.in_flight - 1)
         self._device_ok = None
-        self._cpu_ok = ok[: self._n]
+        out = ok[: self._n].copy()
+        if self._degen is not None:
+            # w4 kernel: degenerate lanes (adversarial H == 0 collisions)
+            # carry garbage — re-verify them on the scalar CPU path. The
+            # kernel's verdict for those lanes is NEVER trusted.
+            degen = np.asarray(self._degen)[: self._n]
+            idxs = np.nonzero(degen)[0]
+            if idxs.size:
+                STATS.degenerate_rechecks += int(idxs.size)
+                redo = _verify_cpu([self._records[i] for i in idxs])
+                out[idxs] = redo
+            self._degen = None
+            self._records = None
+        self._cpu_ok = out
         return self._cpu_ok
 
 
@@ -242,9 +347,30 @@ def dispatch_batch(records: Sequence, backend: str = "auto") -> BatchHandle:
 
     from . import secp256k1 as dev
 
-    bucket = _bucket_for(len(records), pallas=pallas_enabled())
-    arrays = pack_records(records, bucket)
-    device_ok = _dispatch_device(dev, list(map(np.asarray, arrays)))
+    device_ok = degen = None
+    if pallas_enabled():
+        bucket = _bucket_for(len(records), pallas=True)
+        try:
+            if bucket % 1024 == 0:
+                # single-dispatch byte pipeline: (rows, 8, 128) exact-vreg
+                # tiles over a grid, device-side expansion — the whole
+                # batch is one program/round trip (ops/secp256k1.py)
+                arrays = pack_records_w4_bytes(records, bucket)
+                device_ok, degen = dev.ecdsa_verify_batch_pallas_w4_bytes(
+                    *arrays
+                )
+            else:
+                arrays = pack_records_w4(records, bucket)
+                device_ok, degen = dev.ecdsa_verify_batch_pallas_w4(
+                    *map(np.asarray, arrays)
+                )
+        except Exception as e:
+            _note_pallas_failure(e)
+            device_ok = None
+    if device_ok is None:
+        bucket = _bucket_for(len(records), pallas=False)
+        arrays = pack_records(records, bucket)
+        device_ok = dev.ecdsa_verify_batch_jit(*map(np.asarray, arrays))
     STATS.dispatches += 1
     STATS.sigs_verified += len(records)
     STATS.sigs_padded += bucket - len(records)
@@ -252,7 +378,9 @@ def dispatch_batch(records: Sequence, backend: str = "auto") -> BatchHandle:
     STATS.buckets_used[bucket] = STATS.buckets_used.get(bucket, 0) + 1
     STATS.in_flight += 1
     STATS.max_in_flight = max(STATS.max_in_flight, STATS.in_flight)
-    return BatchHandle(len(records), bucket, device_ok)
+    return BatchHandle(len(records), bucket, device_ok,
+                       degen=degen, records=records if degen is not None
+                       else None)
 
 
 _PALLAS_BROKEN = False
@@ -269,28 +397,22 @@ def pallas_enabled() -> bool:
     )
 
 
-def _dispatch_device(dev, arrays):
-    """Prefer the Pallas verify kernel (~2.8x the XLA fori_loop form —
-    ops/secp256k1.py's Mosaic notes); fall back to the XLA path on compile
-    failure (jit compilation is synchronous, so failures surface here).
-    Deterministic Mosaic/lowering failures latch _PALLAS_BROKEN; transient
-    remote-compile-service errors do NOT — the next dispatch retries."""
+def _note_pallas_failure(e: Exception) -> None:
+    """Pallas compile failure bookkeeping (jit compilation is synchronous,
+    so failures surface at the dispatch call). Deterministic Mosaic/
+    lowering failures latch _PALLAS_BROKEN; transient remote-compile-
+    service errors do NOT — the next dispatch retries."""
     global _PALLAS_BROKEN
-    if pallas_enabled():
-        try:
-            return dev.ecdsa_verify_batch_pallas(*arrays)
-        except Exception as e:
-            STATS.pallas_fallbacks += 1
-            text = f"{type(e).__name__}: {e}"
-            if ("Mosaic" in text or "NotImplementedError" in text
-                    or "lowering" in text):
-                _PALLAS_BROKEN = True  # this toolchain can't compile it
-            from ..util.log import log_printf
+    STATS.pallas_fallbacks += 1
+    text = f"{type(e).__name__}: {e}"
+    if ("Mosaic" in text or "NotImplementedError" in text
+            or "lowering" in text):
+        _PALLAS_BROKEN = True  # this toolchain can't compile it
+    from ..util.log import log_printf
 
-            log_printf("pallas ECDSA kernel failed (%s) — XLA fallback%s",
-                       text[:200],
-                       " (latched)" if _PALLAS_BROKEN else "")
-    return dev.ecdsa_verify_batch_jit(*arrays)
+    log_printf("pallas ECDSA kernel failed (%s) — XLA fallback%s",
+               text[:200],
+               " (latched)" if _PALLAS_BROKEN else "")
 
 
 def verify_batch(records: Sequence, backend: str = "auto") -> np.ndarray:

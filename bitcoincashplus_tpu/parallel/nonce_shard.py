@@ -65,7 +65,10 @@ def _shard_body(midstate, tail, target_limbs, start_nonce, n_tiles, tile: int):
     best = jax.lax.pmin(key, CHIP_AXIS)
     any_found = jax.lax.pmax(found.astype(jnp.uint32), CHIP_AXIS) > 0
     total_tiles = jax.lax.psum(tiles, CHIP_AXIS)
-    return any_found, best, total_tiles
+    # per-chip tiles-done, gathered over the chip axis (shard imbalance
+    # observability — SURVEY §6.5; bench config 5 reports the vector)
+    per_chip = tiles.reshape(1)
+    return any_found, best, total_tiles, per_chip
 
 
 @partial(jax.jit, static_argnames=("tile", "n_chips"))
@@ -76,7 +79,7 @@ def _sharded_sweep_jit(midstate, tail, target_limbs, start_nonce, n_tiles,
         partial(_shard_body, tile=tile),
         mesh=mesh,
         in_specs=(P(), P(), P(), P(), P()),
-        out_specs=(P(), P(), P()),
+        out_specs=(P(), P(), P(), P(CHIP_AXIS)),
     )
     return fn(midstate, tail, target_limbs, start_nonce, n_tiles)
 
@@ -84,8 +87,10 @@ def _sharded_sweep_jit(midstate, tail, target_limbs, start_nonce, n_tiles,
 def sweep_header_sharded(header80: bytes, target: int, start_nonce: int = 0,
                          max_nonces: int = 1 << 32,
                          tile: int = DEFAULT_TILE,
-                         n_chips: int | None = None):
-    """Host API: multi-chip PoW search. Returns (nonce or None, total_hashes).
+                         n_chips: int | None = None,
+                         return_per_chip: bool = False):
+    """Host API: multi-chip PoW search. Returns (nonce or None, total_hashes)
+    — or (nonce, total_hashes, per_chip_tiles) with return_per_chip.
 
     Same signature contract as ops.miner.sweep_header so callers
     (mining/generate.mine_block's `sweep` hook) can inject either. max_nonces
@@ -101,11 +106,12 @@ def sweep_header_sharded(header80: bytes, target: int, start_nonce: int = 0,
     )
     tgt = jnp.asarray(target_to_limbs_np(target))
     n_tiles = max(1, max_nonces // n_chips // tile)
-    found, nonce, tiles = _sharded_sweep_jit(
+    found, nonce, tiles, per_chip = _sharded_sweep_jit(
         midstate, tail, tgt, jnp.uint32(start_nonce), jnp.uint32(n_tiles),
         tile=tile, n_chips=n_chips,
     )
     hashes = int(tiles) * tile
-    if bool(found):
-        return int(nonce), hashes
-    return None, hashes
+    result = int(nonce) if bool(found) else None
+    if return_per_chip:
+        return result, hashes, [int(v) for v in np.asarray(per_chip)]
+    return result, hashes

@@ -578,31 +578,55 @@ static void verify_range(long lo, long hi, void* p) {
 }
 
 static void precompute_range(long lo, long hi, void* p) {
+    // Montgomery batch inversion: ONE Fermat inversion for the whole
+    // chunk plus 3 multiplications per element (prefix products, invert
+    // the total, unwind) — vs a ~384-modmul modpow per signature. Range-
+    // invalid s values are substituted with 1 to keep the running product
+    // invertible; their lanes are flagged ok=0 and never trusted.
     PrecompCtx* c = (PrecompCtx*)p;
+    long n = hi - lo;
+    if (n <= 0) return;
+    std::vector<N256> s_eff((size_t)n), prefix((size_t)n);
     N256 nm2 = N_M;
     nm2.d[0] -= 2;
-    for (long i = lo; i < hi; i++) {
-        N256 r = load_be(c->rs + 64 * i);
-        N256 s = load_be(c->rs + 64 * i + 32);
-        N256 e = load_be(c->msg + 32 * i);
-        if (is_zero_n(s) || cmp_n(s, N_M) >= 0 || is_zero_n(r) ||
-            cmp_n(r, N_M) >= 0) {
-            // invalid scalar range: flag so the caller routes the record
-            // to the full scalar verify (which rejects it) instead of
-            // packing garbage into the batch
-            memset(c->u1 + 32 * i, 0, 32);
-            memset(c->u2 + 32 * i, 0, 32);
-            c->ok[i] = 0;
+    for (long i = 0; i < n; i++) {
+        N256 r = load_be(c->rs + 64 * (lo + i));
+        N256 s = load_be(c->rs + 64 * (lo + i) + 32);
+        bool bad = is_zero_n(s) || cmp_n(s, N_M) >= 0 || is_zero_n(r) ||
+                   cmp_n(r, N_M) >= 0;
+        c->ok[lo + i] = bad ? 0 : 1;
+        s_eff[(size_t)i] = bad ? ONE_C : s;
+        if (i == 0) {
+            prefix[0] = s_eff[0];
+        } else {
+            modmul(prefix[(size_t)i - 1], s_eff[(size_t)i], N_K, N_M,
+                   prefix[(size_t)i]);
+        }
+    }
+    N256 inv_run;
+    modpow(prefix[(size_t)n - 1], nm2, N_K, N_M, inv_run);
+    for (long i = n - 1; i >= 0; i--) {
+        N256 w;
+        if (i == 0) {
+            w = inv_run;
+        } else {
+            modmul(inv_run, prefix[(size_t)i - 1], N_K, N_M, w);
+            modmul(inv_run, s_eff[(size_t)i], N_K, N_M, inv_run);
+        }
+        long idx = lo + i;
+        if (!c->ok[idx]) {
+            memset(c->u1 + 32 * idx, 0, 32);
+            memset(c->u2 + 32 * idx, 0, 32);
             continue;
         }
+        N256 r = load_be(c->rs + 64 * idx);
+        N256 e = load_be(c->msg + 32 * idx);
         if (cmp_n(e, N_M) >= 0) sub_n(e, N_M);
-        N256 w, u1, u2;
-        modpow(s, nm2, N_K, N_M, w);
+        N256 u1, u2;
         modmul(e, w, N_K, N_M, u1);
         modmul(r, w, N_K, N_M, u2);
-        store_be(u1, c->u1 + 32 * i);
-        store_be(u2, c->u2 + 32 * i);
-        c->ok[i] = 1;
+        store_be(u1, c->u1 + 32 * idx);
+        store_be(u2, c->u2 + 32 * idx);
     }
 }
 
