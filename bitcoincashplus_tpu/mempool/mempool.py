@@ -93,6 +93,11 @@ class CTxMemPool:
                  expiry_seconds: int = DEFAULT_MEMPOOL_EXPIRY):
         self.entries: dict[bytes, MempoolEntry] = {}
         self.map_next_tx: dict[COutPoint, bytes] = {}  # outpoint -> spender
+        # removal hook (CTxMemPool::NotifyEntryRemoved analogue): fired for
+        # EVERY removal; consumers that care about the reason (the fee
+        # estimator must not count block-confirmed txs as failures) handle
+        # confirmed txids BEFORE remove_for_block runs
+        self.on_removed = None
         self.max_size_bytes = max_size_bytes
         self.expiry_seconds = expiry_seconds
         self.total_size = 0
@@ -220,6 +225,8 @@ class CTxMemPool:
 
     def _remove_one(self, txid: bytes) -> MempoolEntry:
         entry = self.entries.pop(txid)
+        if self.on_removed is not None:
+            self.on_removed(txid)
         for txin in entry.tx.vin:
             self.map_next_tx.pop(txin.prevout, None)
         # fix aggregates on remaining relatives

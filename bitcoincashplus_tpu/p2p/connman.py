@@ -668,8 +668,11 @@ class CConnman:
         for t, services, host, port in entries:
             if host == "::" or port == 0:
                 continue
-            # clamp absurd timestamps like CAddrMan (10-min penalty skipped)
-            self.addrman.add(host, port, services, min(t, now))
+            # clamp absurd timestamps like CAddrMan (10-min penalty
+            # skipped); the gossiping peer is the SOURCE — it determines
+            # which 64 new buckets the entry may land in (eclipse defense)
+            self.addrman.add(host, port, services, min(t, now),
+                             source=peer.addr.rsplit(":", 1)[0])
         log_print("net", "peer=%d addr: %d entries (%d known)",
                   peer.id, len(entries), len(self.addrman))
 

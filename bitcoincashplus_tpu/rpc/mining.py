@@ -178,28 +178,30 @@ def prioritisetransaction(node, params):
 
 @rpc_method("estimatefee")
 def estimatefee(node, params):
-    """estimatefee (nblocks) — src/policy/fees.cpp estimator, simplified to
-    the median of recent per-block confirmed-feerate medians; -1 with no
-    data, exactly like the reference's cold answer."""
+    """estimatefee (nblocks) — CBlockPolicyEstimator (src/policy/fees.cpp):
+    bucketed confirmation tracking with decay; -1 with no data, exactly
+    like the reference's cold answer."""
     from ..consensus.tx import COIN
 
-    samples = sorted(node._fee_estimates)
-    if not samples:
-        return -1
-    return samples[len(samples) // 2] / COIN
+    nblocks = int(params[0]) if params else 1
+    est = node.fee_estimator.estimate_fee(max(1, nblocks))
+    return -1 if est <= 0 else est / COIN
 
 
 @rpc_method("estimatesmartfee")
 def estimatesmartfee(node, params):
+    """estimatesmartfee (conf_target) — honors the target: tries it, then
+    widens the horizon, reporting the target that actually answered
+    (estimateSmartFee semantics)."""
     from ..consensus.tx import COIN
 
     nblocks = int(params[0]) if params else 6
-    samples = sorted(node._fee_estimates)
-    if not samples:
+    est, answered = node.fee_estimator.estimate_smart_fee(nblocks)
+    if est <= 0:
         # smart variant falls back to the relay floor instead of failing
         return {"feerate": node.min_relay_fee_rate / COIN, "blocks": nblocks,
                 "errors": ["Insufficient data or no feerate found"]}
-    return {"feerate": samples[len(samples) // 2] / COIN, "blocks": nblocks}
+    return {"feerate": est / COIN, "blocks": answered}
 
 
 def _tip_json(node):

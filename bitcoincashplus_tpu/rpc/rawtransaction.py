@@ -137,6 +137,14 @@ def getrawtransaction(node, params):
                         tx = cand
                         break
     if tx is None:
+        if node.txindex and not node._txindex_synced:
+            # the reference's txindex reports "is still syncing" rather
+            # than pretending the tx doesn't exist mid-backfill
+            raise RPCError(
+                RPC_INVALID_ADDRESS_OR_KEY,
+                "No such mempool transaction. Blockchain transactions are "
+                "still in the process of being indexed.",
+            )
         raise RPCError(
             RPC_INVALID_ADDRESS_OR_KEY,
             "No such mempool transaction. Use -txindex to enable "

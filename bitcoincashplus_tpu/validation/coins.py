@@ -168,6 +168,13 @@ class CoinsCache(CoinsView):
     def cache_size(self) -> int:
         return len(self.cache)
 
+    def estimated_bytes(self) -> int:
+        """DynamicMemoryUsage analogue (coins.cpp): rough per-entry cost of
+        the Python dict entry + COutPoint + Coin (~250 bytes measured with
+        sys.getsizeof over the populated structures). Drives the -dbcache
+        flush threshold, so it needs to be proportional, not exact."""
+        return len(self.cache) * 250
+
 
 def add_coins(view: CoinsCache, tx: CTransaction, height: int, overwrite: bool = False):
     """AddCoins (src/coins.cpp:~70): create outputs of tx at height."""

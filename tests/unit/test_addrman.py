@@ -91,3 +91,116 @@ class TestAddrCodec:
 
         with pytest.raises(NetMessageError):
             deser_addr_entries(ser_compact_size(50_000))
+
+
+class TestBucketing:
+    """Eclipse-resistance properties of the 1024/256 bucket layout
+    (src/addrman.h ADDRMAN_* constants; addrman_tests.cpp shapes)."""
+
+    def test_single_source_group_is_capacity_bounded(self):
+        """One /16 source announcing thousands of addresses can occupy at
+        most NEW_BUCKETS_PER_SOURCE_GROUP * BUCKET_SIZE new slots."""
+        from bitcoincashplus_tpu.p2p.addrman import (
+            BUCKET_SIZE,
+            NEW_BUCKETS_PER_SOURCE_GROUP,
+            AddrMan,
+        )
+
+        am = AddrMan(seed=7)
+        added = 0
+        # 10k distinct addresses, all announced by sources in ONE /16
+        for i in range(10_000):
+            host = f"{(i >> 16) & 255}.{(i >> 8) & 255}.{i & 255}.7"
+            if am.add(host, 8333, source=f"66.66.{i & 3}.{i & 7}"):
+                added += 1
+        cap = NEW_BUCKETS_PER_SOURCE_GROUP * BUCKET_SIZE
+        assert added <= cap, (added, cap)
+        assert len(am) == added
+        # distinct buckets reached must not exceed the per-source-group cap
+        buckets = {b for (b, _s) in am.new_tbl}
+        assert len(buckets) <= NEW_BUCKETS_PER_SOURCE_GROUP
+
+    def test_diverse_sources_reach_more_buckets(self):
+        from bitcoincashplus_tpu.p2p.addrman import (
+            NEW_BUCKETS_PER_SOURCE_GROUP,
+            AddrMan,
+        )
+
+        am = AddrMan(seed=8)
+        for i in range(4_000):
+            host = f"10.{(i >> 8) & 255}.{i & 255}.9"
+            src = f"{(i * 13) & 255}.{(i * 7) & 255}.1.1"  # many /16 groups
+            am.add(host, 8333, source=src)
+        buckets = {b for (b, _s) in am.new_tbl}
+        assert len(buckets) > NEW_BUCKETS_PER_SOURCE_GROUP
+
+    def test_healthy_incumbent_defends_slot(self):
+        from bitcoincashplus_tpu.p2p.addrman import AddrMan
+
+        am = AddrMan(seed=9)
+        # fill the attacker's reachable slots with fresh (healthy) entries,
+        # then flood again: the flood must not displace anything
+        for i in range(6_000):
+            am.add(f"10.0.{(i >> 8) & 255}.{i & 255}", 1, source="6.6.1.1")
+        before = set(am.addrs)
+        for i in range(6_000):
+            am.add(f"11.1.{(i >> 8) & 255}.{i & 255}", 1, source="6.6.1.1")
+        # every pre-existing fresh entry survived the second flood
+        assert before <= set(am.addrs)
+
+    def test_stale_incumbent_is_evicted(self):
+        import time as _t
+
+        from bitcoincashplus_tpu.p2p.addrman import AddrMan
+
+        am = AddrMan(seed=10)
+        stale_seen = int(_t.time()) - 90 * 86400  # far past the horizon
+        for i in range(3_000):
+            am.add(f"10.0.{(i >> 8) & 255}.{i & 255}", 1,
+                   seen_time=stale_seen, source="6.6.1.1")
+        n_stale = len(am)
+        for i in range(3_000):
+            am.add(f"11.1.{(i >> 8) & 255}.{i & 255}", 1, source="6.6.1.1")
+        # fresh flood displaced stale incumbents (same buckets reachable)
+        fresh = [k for k, a in am.addrs.items() if a.time > stale_seen]
+        assert len(fresh) >= n_stale // 2
+
+    def test_tried_collision_displaces_back_to_new(self):
+        from bitcoincashplus_tpu.p2p.addrman import AddrMan
+
+        am = AddrMan(seed=11)
+        # force a tried-slot collision by promoting many addresses in one
+        # network group (tried buckets per group = 8, slots = 64 => >512
+        # promotions MUST collide)
+        n = 700
+        for i in range(n):
+            host = f"10.9.{(i >> 8) & 255}.{i & 255}"
+            am.add(host, 1, source="1.2.3.4")
+            am.good(host, 1)
+        tried = [a for a in am.addrs.values() if a.tried]
+        displaced = [a for a in am.addrs.values() if not a.tried]
+        assert len(tried) <= 8 * 64
+        # displaced incumbents were returned to the new table, not lost
+        assert len(tried) + len(displaced) == len(am)
+        assert all(
+            am._pos[a.key][0] == ("tried" if a.tried else "new")
+            for a in am.addrs.values()
+        )
+
+    def test_persistence_keeps_bucket_key_and_tables(self, tmp_path):
+        from bitcoincashplus_tpu.p2p.addrman import AddrMan
+
+        am = AddrMan(seed=12)
+        for i in range(100):
+            am.add(f"10.3.{i}.1", 8333, source=f"{i & 7}.1.1.1")
+        am.good("10.3.5.1", 8333)
+        path = str(tmp_path / "peers.json")
+        am.save(path)
+        am2 = AddrMan(seed=99)
+        am2.load(path)
+        assert (am2._k0, am2._k1) == (am._k0, am._k1)
+        assert am2.addrs["10.3.5.1:8333"].tried
+        # every loaded entry has a consistent table position
+        for key, pos in am2._pos.items():
+            tbl = am2.new_tbl if pos[0] == "new" else am2.tried_tbl
+            assert tbl[(pos[1], pos[2])] == key

@@ -641,6 +641,19 @@ TX_INVALID: list[dict] = []
 
 def run_tx_vector(entry: dict) -> str:
     tx = CTransaction.deserialize(ByteReader(bytes.fromhex(entry["tx"])))
+    if entry.get("mode") == "check":
+        # CheckTransaction-level vector (src/test/data tx_invalid.json also
+        # carries these: duplicate inputs, value overflow, empty vin/vout)
+        from bitcoincashplus_tpu.consensus.tx_check import (
+            TxValidationError,
+            check_transaction,
+        )
+
+        try:
+            check_transaction(tx)
+            return "OK"
+        except TxValidationError as e:
+            return e.reason
     flags = parse_flags(entry["flags"])
     try:
         for i, (txin, (_h, _n, spk_hex, amount)) in enumerate(
@@ -655,7 +668,7 @@ def run_tx_vector(entry: dict) -> str:
 
 
 def tx_vec(valid: bool, inputs, tx: CTransaction, flags: str, expect: str,
-           desc: str):
+           desc: str, mode: str = "script"):
     entry = {
         "inputs": [[h.hex(), n, spk.hex(), amount]
                    for (h, n, spk, amount) in inputs],
@@ -664,6 +677,8 @@ def tx_vec(valid: bool, inputs, tx: CTransaction, flags: str, expect: str,
         "expect": expect,
         "desc": desc,
     }
+    if mode != "script":
+        entry["mode"] = mode
     got = run_tx_vector(entry)
     if got != expect:
         raise SystemExit(
@@ -797,6 +812,425 @@ def gen_tx_vectors():
            "legacy sig rejected post-fork")
 
 
+def gen_tx_matrix_vectors():
+    """Reference-scale tx corpus (src/test/data/tx_valid.json carries
+    hundreds of entries): programmatic matrices over sighash types,
+    locktime/sequence boundaries, FindAndDelete/CODESEPARATOR, hybrid
+    pubkeys, flag boundaries, legacy-vs-FORKID pairs, multisig shapes, and
+    CheckTransaction-level structural rules."""
+    prev = b"\x77" * 32
+    spk = KEY.p2pkh_script()
+    amount = 5_000_000_000
+    keymap = {KEY.pubkey: KEY, KEY2.pubkey: KEY2, KEY3.pubkey: KEY3,
+              KEY.pubkey_hash: KEY, KEY2.pubkey_hash: KEY2,
+              KEY3.pubkey_hash: KEY3}
+
+    def spend_tx(nin=1, locktime=0, sequence=0xFFFFFFFF, value=None,
+                 version=2):
+        vin = tuple(CTxIn(COutPoint(prev, i), b"", sequence)
+                    for i in range(nin))
+        vout = (CTxOut(value if value is not None else amount - 10_000,
+                       b"\x51"),)
+        return CTransaction(version=version, vin=vin, vout=vout,
+                            locktime=locktime)
+
+    def signed_p2pkh(tx, hashtype, forkid, n_inputs=1, amounts=None):
+        amounts = amounts or [amount] * n_inputs
+        return sign_transaction(
+            tx, [(spk, a) for a in amounts], lambda i: keymap.get(i),
+            hashtype=hashtype, enable_forkid=forkid,
+        )
+
+    # ---- 1. sighash-type matrix: every base type x ACP x forkid/legacy,
+    # one- and two-input forms (SIGHASH_SINGLE needs vout coverage) -------
+    for base_name, base_ht in (("ALL", SIGHASH_ALL), ("NONE", SIGHASH_NONE),
+                               ("SINGLE", SIGHASH_SINGLE)):
+        for acp in (0, SIGHASH_ANYONECANPAY):
+            for forkid in (True, False):
+                for nin in (1, 2):
+                    if base_ht == SIGHASH_SINGLE and nin == 2:
+                        # vout[1] must exist for input 1: give the tx 2 outs
+                        tx = CTransaction(
+                            version=2,
+                            vin=tuple(CTxIn(COutPoint(prev, i), b"",
+                                            0xFFFFFFFF) for i in range(2)),
+                            vout=(CTxOut(1000, b"\x51"),
+                                  CTxOut(2000, b"\x51")),
+                        )
+                    else:
+                        tx = spend_tx(nin=nin)
+                    ht = base_ht | acp
+                    signed = signed_p2pkh(tx, ht, forkid, nin)
+                    flags = ("P2SH,STRICTENC,NULLFAIL"
+                             + (",FORKID" if forkid else ""))
+                    tx_vec(True,
+                           [(prev, i, spk, amount) for i in range(nin)],
+                           signed, flags, "OK",
+                           f"sighash {base_name}"
+                           f"{'|ACP' if acp else ''} "
+                           f"{'forkid' if forkid else 'legacy'} {nin}-in")
+
+    # ---- 2. CLTV boundary matrix ---------------------------------------
+    thresh = 500_000_000  # LOCKTIME_THRESHOLD
+
+    def cltv_case(required, locktime, sequence, ok, why):
+        cspk = push(_num(required)) + op(S.OP_CHECKLOCKTIMEVERIFY,
+                                         S.OP_DROP) + \
+            push(KEY.pubkey) + op(S.OP_CHECKSIG)
+        tx = spend_tx(locktime=locktime, sequence=sequence)
+        sig = make_signature(KEY, cspk, tx, 0, amount,
+                             SIGHASH_ALL | SIGHASH_FORKID,
+                             enable_forkid=True)
+        tx = CTransaction(tx.version,
+                          (CTxIn(tx.vin[0].prevout, push(sig), sequence),),
+                          tx.vout, tx.locktime)
+        tx_vec(ok, [(prev, 0, cspk, amount)], tx,
+               "CHECKLOCKTIMEVERIFY,FORKID,NULLFAIL",
+               "OK" if ok else "unsatisfied-locktime", f"CLTV {why}")
+
+    cltv_case(400, 400, 0xFFFFFFFE, True, "exactly equal heights")
+    cltv_case(400, 401, 0xFFFFFFFE, True, "locktime above requirement")
+    cltv_case(401, 400, 0xFFFFFFFE, False, "one short")
+    cltv_case(0, 0, 0xFFFFFFFE, True, "zero requirement")
+    cltv_case(thresh, thresh, 0xFFFFFFFE, True, "time-type equal")
+    cltv_case(thresh - 1, thresh, 0xFFFFFFFE, False,
+              "height-type vs time-type mismatch")
+    cltv_case(thresh, thresh - 1, 0xFFFFFFFE, False,
+              "time-type vs height-type mismatch")
+    cltv_case(400, 500, 0xFFFFFFFF, False, "final sequence disables CLTV")
+
+    # ---- 3. CSV boundary matrix ----------------------------------------
+    type_flag = 0x00400000  # SEQUENCE_LOCKTIME_TYPE_FLAG (time-based)
+    disable = 0x80000000
+
+    def csv_case(required, sequence, ok, why, version=2, code=None):
+        cspk = push(_num(required)) + op(S.OP_CHECKSEQUENCEVERIFY,
+                                         S.OP_DROP) + \
+            push(KEY.pubkey) + op(S.OP_CHECKSIG)
+        tx = spend_tx(sequence=sequence, version=version)
+        sig = make_signature(KEY, cspk, tx, 0, amount,
+                             SIGHASH_ALL | SIGHASH_FORKID,
+                             enable_forkid=True)
+        tx = CTransaction(tx.version,
+                          (CTxIn(tx.vin[0].prevout, push(sig), sequence),),
+                          tx.vout, tx.locktime)
+        tx_vec(ok, [(prev, 0, cspk, amount)], tx,
+               "CHECKSEQUENCEVERIFY,FORKID,NULLFAIL",
+               "OK" if ok else (code or "unsatisfied-locktime"),
+               f"CSV {why}")
+
+    csv_case(10, 10, True, "blocks exactly equal")
+    csv_case(10, 11, True, "blocks above")
+    csv_case(11, 10, False, "blocks one short")
+    csv_case(10, 10, False, "version 1 rejects CSV", version=1)
+    csv_case(type_flag | 5, type_flag | 5, True, "time-type equal")
+    csv_case(type_flag | 5, 5, False, "type mismatch time-vs-blocks")
+    csv_case(5, type_flag | 5, False, "type mismatch blocks-vs-time")
+    csv_case(10, disable | 10, False, "disable flag voids the check")
+
+    # ---- 4. FindAndDelete / CODESEPARATOR ------------------------------
+    # scriptCode signing with a CODESEPARATOR: only the tail past the LAST
+    # executed separator is committed (legacy), and pushes equal to the
+    # signature are stripped (FindAndDelete) before hashing
+    cs_spk = push(KEY.pubkey) + op(S.OP_CODESEPARATOR, S.OP_CHECKSIG)
+    tx = spend_tx()
+    # sign against the post-separator tail (interpreter starts scriptCode
+    # at the last executed separator)
+    tail = push(KEY.pubkey)[0:0] + op(S.OP_CHECKSIG)
+    sig = make_signature(KEY, tail, tx, 0, amount,
+                         SIGHASH_ALL | SIGHASH_FORKID, enable_forkid=True)
+    tx_cs = CTransaction(tx.version,
+                         (CTxIn(tx.vin[0].prevout, push(sig), 0xFFFFFFFF),),
+                         tx.vout, tx.locktime)
+    tx_vec(True, [(prev, 0, cs_spk, amount)], tx_cs,
+           "FORKID,NULLFAIL", "OK",
+           "CODESEPARATOR: sig commits to post-separator tail")
+    # signing the WHOLE script instead must fail
+    sig_whole = make_signature(KEY, cs_spk, tx, 0, amount,
+                               SIGHASH_ALL | SIGHASH_FORKID,
+                               enable_forkid=True)
+    tx_cs2 = CTransaction(tx.version,
+                          (CTxIn(tx.vin[0].prevout, push(sig_whole),
+                                 0xFFFFFFFF),),
+                          tx.vout, tx.locktime)
+    tx_vec(False, [(prev, 0, cs_spk, amount)], tx_cs2,
+           "FORKID,NULLFAIL", "sig-nullfail",
+           "CODESEPARATOR: whole-script sig rejected")
+    # legacy FindAndDelete: a scriptPubKey embedding the signature push —
+    # the legacy sighash strips PUSH(sig) from scriptCode before hashing,
+    # so the sig is made against the STRIPPED form (breaking the circular
+    # dependency: the stripped scriptCode doesn't contain the sig)
+    tx_fd = spend_tx()
+    stripped = op(S.OP_DROP) + push(KEY.pubkey) + op(S.OP_CHECKSIG)
+    sig_fd = make_signature(KEY, stripped, tx_fd, 0, amount, SIGHASH_ALL,
+                            enable_forkid=False)
+    fd_spk = push(sig_fd) + stripped
+    tx_fd2 = CTransaction(tx_fd.version,
+                          (CTxIn(tx_fd.vin[0].prevout, push(sig_fd),
+                                 0xFFFFFFFF),),
+                          tx_fd.vout, tx_fd.locktime)
+    tx_vec(True, [(prev, 0, fd_spk, amount)], tx_fd2,
+           "NULLFAIL", "OK",
+           "FindAndDelete: sig push embedded in scriptPubKey is stripped")
+
+    # ---- 5. hybrid pubkeys under STRICTENC -----------------------------
+    pt = secp.pubkey_parse(KEY.pubkey)
+    hybrid = bytes([6 + (pt[1] & 1)]) + pt[0].to_bytes(32, "big") + \
+        pt[1].to_bytes(32, "big")
+    hspk = push(hybrid) + op(S.OP_CHECKSIG)
+    tx_h = spend_tx()
+    sig_h = make_signature(KEY, hspk, tx_h, 0, amount,
+                           SIGHASH_ALL | SIGHASH_FORKID, enable_forkid=True)
+    tx_h2 = CTransaction(tx_h.version,
+                         (CTxIn(tx_h.vin[0].prevout, push(sig_h),
+                                0xFFFFFFFF),),
+                         tx_h.vout, tx_h.locktime)
+    tx_vec(True, [(prev, 0, hspk, amount)], tx_h2,
+           "FORKID,NULLFAIL", "OK", "hybrid pubkey accepted pre-STRICTENC")
+    tx_vec(False, [(prev, 0, hspk, amount)], tx_h2,
+           "FORKID,NULLFAIL,STRICTENC", "pubkeytype",
+           "hybrid pubkey rejected under STRICTENC")
+
+    # ---- 6. flag boundaries: LOW_S / NULLDUMMY / NULLFAIL --------------
+    tx_s = spend_tx()
+    ehash = None
+    sig_lowS = make_signature(KEY, spk, tx_s, 0, amount,
+                              SIGHASH_ALL | SIGHASH_FORKID,
+                              enable_forkid=True)
+    # reconstruct a high-S twin of the same signature
+    r_v, s_v = secp.sig_der_decode(sig_lowS[:-1])
+    sig_highS = secp.sig_der_encode(r_v, secp.N - s_v) + sig_lowS[-1:]
+    for sig_v, flags, ok, code, why in (
+        (sig_highS, "FORKID,NULLFAIL", True, "OK",
+         "high-S accepted without LOW_S"),
+        (sig_highS, "FORKID,NULLFAIL,LOW_S", False, "sig-high-s",
+         "high-S rejected under LOW_S"),
+    ):
+        txv = CTransaction(tx_s.version,
+                           (CTxIn(tx_s.vin[0].prevout,
+                                  push(sig_v) + push(KEY.pubkey),
+                                  0xFFFFFFFF),),
+                           tx_s.vout, tx_s.locktime)
+        tx_vec(ok, [(prev, 0, spk, amount)], txv, flags, code, why)
+    del ehash
+    # NULLDUMMY: multisig dummy must be empty when flagged
+    ms_spk = S.multisig_script(1, [KEY.pubkey])
+    tx_m = spend_tx()
+    sig_m = make_signature(KEY, ms_spk, tx_m, 0, amount,
+                           SIGHASH_ALL | SIGHASH_FORKID, enable_forkid=True)
+    for dummy, flags, ok, code, why in (
+        (op(S.OP_1), "FORKID,NULLFAIL", True, "OK",
+         "non-null multisig dummy tolerated without NULLDUMMY"),
+        (op(S.OP_1), "FORKID,NULLFAIL,NULLDUMMY", False, "sig-nulldummy",
+         "non-null multisig dummy rejected under NULLDUMMY"),
+    ):
+        txv = CTransaction(tx_m.version,
+                           (CTxIn(tx_m.vin[0].prevout, dummy + push(sig_m),
+                                  0xFFFFFFFF),),
+                           tx_m.vout, tx_m.locktime)
+        tx_vec(ok, [(prev, 0, ms_spk, amount)], txv, flags, code, why)
+    # NULLFAIL: a failing CHECKSIG with a NON-empty sig
+    tx_f = spend_tx()
+    sig_f = make_signature(KEY2, spk, tx_f, 0, amount,
+                           SIGHASH_ALL | SIGHASH_FORKID, enable_forkid=True)
+    txv = CTransaction(tx_f.version,
+                       (CTxIn(tx_f.vin[0].prevout,
+                              push(sig_f) + push(KEY.pubkey), 0xFFFFFFFF),),
+                       tx_f.vout, tx_f.locktime)
+    tx_vec(False, [(prev, 0, spk, amount)], txv, "FORKID,NULLFAIL",
+           "sig-nullfail", "wrong-key sig under NULLFAIL")
+    tx_vec(False, [(prev, 0, spk, amount)], txv, "FORKID",
+           "eval-false", "wrong-key sig without NULLFAIL fails at the end")
+
+    # ---- 7. legacy-vs-FORKID pairs -------------------------------------
+    tx_p = spend_tx()
+    signed_forkid = signed_p2pkh(tx_p, SIGHASH_ALL, True)
+    signed_legacy = signed_p2pkh(tx_p, SIGHASH_ALL, False)
+    tx_vec(False, [(prev, 0, spk, amount)], signed_forkid,
+           "STRICTENC,NULLFAIL", "illegal-forkid",
+           "forkid-bit sig rejected under legacy STRICTENC")
+    tx_vec(True, [(prev, 0, spk, amount)], signed_forkid,
+           "STRICTENC,NULLFAIL,FORKID", "OK",
+           "forkid sig accepted post-fork")
+    tx_vec(False, [(prev, 0, spk, amount)], signed_legacy,
+           "STRICTENC,NULLFAIL,FORKID", "must-use-forkid",
+           "legacy sig rejected post-fork (replay protection)")
+    tx_vec(True, [(prev, 0, spk, amount)], signed_legacy,
+           "STRICTENC,NULLFAIL", "OK", "legacy sig accepted pre-fork")
+
+    # ---- 8. multisig shapes --------------------------------------------
+    for m, keys, why in (
+        (1, [KEY, KEY2], "1-of-2"),
+        (2, [KEY, KEY2], "2-of-2"),
+        (2, [KEY, KEY2, KEY3], "2-of-3"),
+        (3, [KEY, KEY2, KEY3], "3-of-3"),
+    ):
+        msk = S.multisig_script(m, [k.pubkey for k in keys])
+        tx_n = spend_tx()
+        signed = sign_transaction(tx_n, [(msk, amount)],
+                                  lambda i: keymap.get(i),
+                                  enable_forkid=True)
+        tx_vec(True, [(prev, 0, msk, amount)], signed,
+               "FORKID,NULLFAIL,NULLDUMMY", "OK", f"bare multisig {why}")
+    # out-of-order sigs fail (CHECKMULTISIG is order-sensitive)
+    msk = S.multisig_script(2, [KEY.pubkey, KEY2.pubkey])
+    tx_o = spend_tx()
+    s1 = make_signature(KEY, msk, tx_o, 0, amount,
+                        SIGHASH_ALL | SIGHASH_FORKID, enable_forkid=True)
+    s2 = make_signature(KEY2, msk, tx_o, 0, amount,
+                        SIGHASH_ALL | SIGHASH_FORKID, enable_forkid=True)
+    tx_o2 = CTransaction(tx_o.version,
+                         (CTxIn(tx_o.vin[0].prevout,
+                                b"\x00" + push(s2) + push(s1), 0xFFFFFFFF),),
+                         tx_o.vout, tx_o.locktime)
+    tx_vec(False, [(prev, 0, msk, amount)], tx_o2,
+           "FORKID,NULLFAIL,NULLDUMMY", "sig-nullfail",
+           "multisig out-of-order sigs rejected")
+
+    # ---- 9. CheckTransaction structural matrix (mode=check) ------------
+    def raw_tx(vin, vout, version=1, locktime=0):
+        return CTransaction(version=version, vin=tuple(vin),
+                            vout=tuple(vout), locktime=locktime)
+
+    inp = CTxIn(COutPoint(prev, 0), b"\x51", 0xFFFFFFFF)
+    out1 = CTxOut(1000, b"\x51")
+    MAXM = 21_000_000 * 100_000_000
+    tx_vec(True, [], raw_tx([inp], [out1]), "", "OK",
+           "minimal structurally-valid tx", mode="check")
+    tx_vec(True, [], raw_tx([inp], [CTxOut(MAXM, b"\x51")]), "", "OK",
+           "single output at exactly MAX_MONEY", mode="check")
+    tx_vec(False, [], raw_tx([], [out1]), "", "bad-txns-vin-empty",
+           "empty vin", mode="check")
+    tx_vec(False, [], raw_tx([inp], []), "", "bad-txns-vout-empty",
+           "empty vout", mode="check")
+    tx_vec(False, [], raw_tx([inp], [CTxOut(-1, b"\x51")]), "",
+           "bad-txns-vout-negative", "negative output value", mode="check")
+    tx_vec(False, [], raw_tx([inp], [CTxOut(MAXM + 1, b"\x51")]), "",
+           "bad-txns-vout-toolarge", "output above MAX_MONEY", mode="check")
+    tx_vec(False, [],
+           raw_tx([inp], [CTxOut(MAXM, b"\x51"), CTxOut(1, b"\x51")]), "",
+           "bad-txns-txouttotal-toolarge", "output SUM above MAX_MONEY",
+           mode="check")
+    tx_vec(False, [],
+           raw_tx([inp, CTxIn(COutPoint(prev, 0), b"\x52", 0)], [out1]),
+           "", "bad-txns-inputs-duplicate", "duplicate prevouts",
+           mode="check")
+    tx_vec(False, [],
+           raw_tx([CTxIn(COutPoint(), b"\x51" * 51, 0xFFFFFFFF), inp],
+                  [out1]),
+           "", "bad-txns-prevout-null",
+           "null prevout in non-coinbase (2 inputs)", mode="check")
+    tx_vec(True, [],
+           raw_tx([CTxIn(COutPoint(), b"\x51" * 51, 0xFFFFFFFF)], [out1]),
+           "", "OK", "coinbase with in-range scriptSig", mode="check")
+    tx_vec(False, [],
+           raw_tx([CTxIn(COutPoint(), b"\x51", 0xFFFFFFFF)], [out1]),
+           "", "bad-cb-length", "coinbase scriptSig too short",
+           mode="check")
+    tx_vec(False, [],
+           raw_tx([CTxIn(COutPoint(), b"\x51" * 101, 0xFFFFFFFF)], [out1]),
+           "", "bad-cb-length", "coinbase scriptSig too long", mode="check")
+
+    # ---- 10. randomized spend matrix: P2PKH/P2PK/P2SH-multisig spends,
+    # random input counts / sighash types, each emitted in a valid form AND
+    # a mutated-invalid twin (signature bit-flip, wrong amount, or wrong
+    # hashtype byte) — reference-scale bulk with asserted expectations ----
+    rng = random.Random(0xF00D)
+    keys = [KEY, KEY2, KEY3]
+    for case in range(72):
+        nin = rng.choice((1, 1, 2, 3))
+        kind = rng.choice(("p2pkh", "p2pk", "p2sh"))
+        key = keys[case % 3]
+        if kind == "p2pkh":
+            spk_c = key.p2pkh_script()
+            redeems = None
+        elif kind == "p2pk":
+            spk_c = push(key.pubkey) + op(S.OP_CHECKSIG)
+            redeems = None
+        else:
+            m = rng.choice((1, 2))
+            redeem = S.multisig_script(m, [k.pubkey for k in keys[:m + 1]])
+            spk_c = S.p2sh_script_for_redeem(redeem)
+            redeems = {hash160(redeem): redeem}
+        ht = rng.choice((SIGHASH_ALL, SIGHASH_NONE, SIGHASH_SINGLE))
+        if ht == SIGHASH_SINGLE:
+            nin = 1  # keep vout coverage trivial
+        ht |= rng.choice((0, SIGHASH_ANYONECANPAY))
+        amt = rng.randint(546, 21_000_000 * 100_000_000 // 2)
+        tx_r = CTransaction(
+            version=2,
+            vin=tuple(CTxIn(COutPoint(prev, i), b"", 0xFFFFFFFE)
+                      for i in range(nin)),
+            vout=(CTxOut(max(amt - 10_000, 546), b"\x51"),),
+        )
+        signed = sign_transaction(
+            tx_r, [(spk_c, amt)] * nin, lambda i: keymap.get(i),
+            hashtype=ht, enable_forkid=True, redeem_scripts=redeems,
+        )
+        flags = "P2SH,STRICTENC,NULLFAIL,NULLDUMMY,FORKID"
+        desc = f"matrix #{case}: {kind} {nin}-in ht={ht:#x} amt={amt}"
+        tx_vec(True, [(prev, i, spk_c, amt) for i in range(nin)], signed,
+               flags, "OK", desc)
+        # invalid twin
+        mutation = rng.choice(("flip", "amount", "hashtype"))
+        if kind == "p2sh" and mutation == "hashtype":
+            mutation = "flip"  # scriptSig starts with the OP_0 dummy, not a sig push
+        if mutation == "flip":
+            sig0 = bytearray(signed.vin[0].script_sig)
+            # flip a bit inside the DER body (skip the push opcode)
+            sig0[5] ^= 0x01
+            bad = CTransaction(
+                signed.version,
+                (CTxIn(signed.vin[0].prevout, bytes(sig0),
+                       signed.vin[0].sequence),) + signed.vin[1:],
+                signed.vout, signed.locktime,
+            )
+            codes = {"sig-nullfail", "sig-der", "bad-der-encoding",
+                     "pubkeytype"}
+        elif mutation == "amount":
+            bad = signed
+            codes = {"sig-nullfail", "equalverify", "eval-false"}
+            # evaluate against a different credited amount
+            got = run_tx_vector({
+                "inputs": [[prev.hex(), i, spk_c.hex(), amt + 1]
+                           for i in range(nin)],
+                "tx": bad.serialize().hex(), "flags": flags,
+                "expect": "?", "desc": desc, "mode": "script"})
+            assert got in codes, (desc, got)
+            entry = {
+                "inputs": [[prev.hex(), i, spk_c.hex(), amt + 1]
+                           for i in range(nin)],
+                "tx": bad.serialize().hex(), "flags": flags,
+                "expect": got, "desc": desc + " [wrong amount]",
+            }
+            TX_INVALID.append(entry)
+            continue
+        else:
+            sig0 = bytearray(signed.vin[0].script_sig)
+            sig_len = sig0[0]
+            sig0[sig_len] = 0x23  # hashtype byte -> undefined base type
+            # (p2pkh/p2pk only: byte 0 is the signature push length)
+            bad = CTransaction(
+                signed.version,
+                (CTxIn(signed.vin[0].prevout, bytes(sig0),
+                       signed.vin[0].sequence),) + signed.vin[1:],
+                signed.vout, signed.locktime,
+            )
+            codes = {"sig-hashtype", "sig-nullfail"}
+        got = run_tx_vector({
+            "inputs": [[prev.hex(), i, spk_c.hex(), amt]
+                       for i in range(nin)],
+            "tx": bad.serialize().hex(), "flags": flags,
+            "expect": "?", "desc": desc, "mode": "script"})
+        assert got in codes and got != "OK", (desc, mutation, got)
+        TX_INVALID.append({
+            "inputs": [[prev.hex(), i, spk_c.hex(), amt]
+                       for i in range(nin)],
+            "tx": bad.serialize().hex(), "flags": flags,
+            "expect": got, "desc": desc + f" [{mutation}]",
+        })
+
+
 def main():
     os.makedirs(DATA_DIR, exist_ok=True)
     rng = random.Random(0xBC9)
@@ -804,6 +1238,7 @@ def main():
     gen_script_vectors()
     sighash = gen_sighash_vectors(rng)
     gen_tx_vectors()
+    gen_tx_matrix_vectors()
 
     def dump(name, comment, payload):
         path = os.path.join(DATA_DIR, name)
