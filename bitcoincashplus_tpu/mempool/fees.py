@@ -184,9 +184,14 @@ class FeeEstimator:
         if data.get("version") != 1:
             return
         nb = len(self.buckets)
+        # validate EVERY array dimension before accepting: a truncated
+        # fee_sum or ragged conf_avg row would otherwise IndexError inside
+        # process_block and abort block connection ("never fatal" contract)
         if (len(data["tx_avg"]) != nb
-                or len(data["conf_avg"]) != MAX_TARGET):
-            return  # bucket layout changed: start fresh
+                or len(data["fee_sum"]) != nb
+                or len(data["conf_avg"]) != MAX_TARGET
+                or any(len(row) != nb for row in data["conf_avg"])):
+            return  # layout changed or corrupt: start fresh
         self.best_height = int(data["best_height"])
         self.tx_avg = [float(v) for v in data["tx_avg"]]
         self.fee_sum = [float(v) for v in data["fee_sum"]]

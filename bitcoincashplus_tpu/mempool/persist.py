@@ -84,7 +84,8 @@ def load_mempool(node, path: str,
                 expired += 1
                 continue
             try:
-                node.accept_to_mempool(tx, now=entry_time)
+                node.accept_to_mempool(tx, now=entry_time,
+                       fee_estimate=False)
                 accepted += 1
             except MempoolError:
                 failed += 1

@@ -220,6 +220,11 @@ class Node:
         self.rpc_server = None
         self.connman = None  # set by start_p2p
         self.wallet = None  # set by load_wallet
+        # wallet-load coordination: RPC threads arriving while another
+        # thread is mid-rescan must NOT see partial coin state (the rescan
+        # yields cs_main between chunks); they wait on this event instead
+        self._wallet_ready = threading.Event()
+        self._wallet_loader: Optional[int] = None
 
         # -zmqpub<topic>=<endpoint> (src/zmq/): like the reference, each
         # distinct endpoint gets its own PUB socket; topics sharing an
@@ -318,7 +323,8 @@ class Node:
         # (reference: DisconnectTip -> mempool resurrection)
         for tx in block.vtx[1:]:
             try:
-                self.accept_to_mempool(tx)
+                # resurrection: entry height unknowable -> no fee sample
+                self.accept_to_mempool(tx, fee_estimate=False)
             except MempoolError:
                 pass  # no-longer-valid txs just drop
 
@@ -342,9 +348,14 @@ class Node:
 
     # -- mempool entry point -------------------------------------------
 
-    def accept_to_mempool(self, tx, now: Optional[int] = None):
+    def accept_to_mempool(self, tx, now: Optional[int] = None,
+                          fee_estimate: bool = True):
         """AcceptToMemoryPool with this node's policy knobs; caller holds
-        cs_main (or is single-threaded)."""
+        cs_main (or is single-threaded). fee_estimate=False for replayed
+        txs (mempool.dat reload, reorg resurrection) — their true entry
+        height is unknown, and counting them from the current tip would
+        bias tight-target estimates low (the reference's
+        validFeeEstimate=false)."""
         entry = accept_to_memory_pool(
             self.mempool, self.chainstate, tx,
             sigcache=self.sigcache,
@@ -355,7 +366,7 @@ class Node:
         )
         # fee estimator: track entry height + what the tx actually pays
         # (base fee, not prioritisetransaction-modified fees)
-        if entry.size > 0:
+        if fee_estimate and entry.size > 0:
             self.fee_estimator.process_tx(
                 tx.txid, self.chainstate.tip().height,
                 entry.base_fee * 1000 / entry.size,
@@ -632,6 +643,15 @@ class Node:
         self._txindex_thread.start()
 
     def _txindex_backfill(self) -> None:
+        try:
+            self._txindex_backfill_inner()
+        except Exception as e:  # noqa: BLE001 - daemon thread boundary
+            # a silently-dead backfill thread would leave txindex
+            # 'syncing' forever with no cause on record; the next restart
+            # resumes from the persisted rows
+            log_printf("txindex backfill aborted: %r", e)
+
+    def _txindex_backfill_inner(self) -> None:
         """Uses the native wire scanner when available (txids without full
         Python deserialization — the reference keeps this path in C++ too);
         falls back to the Python deserializer per block."""
@@ -706,26 +726,60 @@ class Node:
     def load_wallet(self):
         from ..wallet.wallet import Wallet
 
+        if self.wallet is not None and self._wallet_ready.is_set():
+            return self.wallet
+        if self._wallet_loader == threading.get_ident():
+            return self.wallet  # re-entrant call from our own load path
         if self.wallet is None:
-            path = os.path.join(self.datadir, "wallet.json")
-            self.wallet = Wallet(params=self.params, path=path)
-            self.wallet.load()
-            if self.wallet._pkh_index or self.wallet.keys_by_pubkey:
-                self._rescan_wallet()  # ScanForWalletTransactions
-            # replay the (possibly mempool.dat-reloaded) pool so pending
-            # spends of wallet coins are marked before any CreateTransaction
-            for e in self.mempool.entries.values():
-                self.wallet.add_tx_if_mine(e.tx, -1, False)
-            self.chainstate.on_block_connected.append(self.wallet.block_connected)
-            self.chainstate.on_block_disconnected.append(self.wallet.block_disconnected)
-            # -walletnotify=<cmd>: shell hook per wallet-affecting tx as it
-            # confirms (init.cpp/wallet.cpp BlockConnected notify path);
-            # registered AFTER wallet.block_connected so tx_log is current
-            notify = self.config.get("walletnotify")
-            if notify:
+            # first loader: callers hold cs_main, so the None check and the
+            # assignment below are mutually exclusive — a second thread can
+            # only arrive once we yield mid-rescan, and then takes the
+            # wait branch
+            self._wallet_loader = threading.get_ident()
+            try:
+                path = os.path.join(self.datadir, "wallet.json")
+                self.wallet = Wallet(params=self.params, path=path)
+                self.wallet.load()
+                if self.wallet._pkh_index or self.wallet.keys_by_pubkey:
+                    self._rescan_wallet()  # ScanForWalletTransactions
+                # replay the (possibly mempool.dat-reloaded) pool so pending
+                # spends of wallet coins are marked before CreateTransaction
+                for e in self.mempool.entries.values():
+                    self.wallet.add_tx_if_mine(e.tx, -1, False)
                 self.chainstate.on_block_connected.append(
-                    lambda block, idx: self._walletnotify(notify, block)
-                )
+                    self.wallet.block_connected)
+                self.chainstate.on_block_disconnected.append(
+                    self.wallet.block_disconnected)
+                # -walletnotify=<cmd>: shell hook per wallet-affecting tx as
+                # it confirms (init.cpp/wallet.cpp BlockConnected notify);
+                # registered AFTER wallet.block_connected so tx_log is
+                # current
+                notify = self.config.get("walletnotify")
+                if notify:
+                    self.chainstate.on_block_connected.append(
+                        lambda block, idx: self._walletnotify(notify, block)
+                    )
+                self._wallet_ready.set()
+            finally:
+                self._wallet_loader = None
+            return self.wallet
+        # another thread is mid-load/rescan: wait for it WITH cs_main
+        # released (waiting while holding would deadlock the rescanner's
+        # chunk reacquire); non-wallet RPCs keep running in those windows
+        while not self._wallet_ready.is_set():
+            if self.shutdown_event.is_set():
+                break
+            released = False
+            try:
+                self.cs_main.release()
+                released = True
+            except RuntimeError:
+                pass
+            try:
+                self._wallet_ready.wait(0.05)
+            finally:
+                if released:
+                    self.cs_main.acquire()
         return self.wallet
 
     def _walletnotify(self, cmd: str, block: CBlock) -> None:

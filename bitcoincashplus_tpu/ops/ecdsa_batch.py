@@ -51,6 +51,7 @@ class BatchStats:
     eager_multisig_sigs: int = 0   # CHECKMULTISIG trials, verified inline
     inline_legacy_sigs: int = 0    # pre-NULLFAIL blocks, deferral unsound
     sigcache_hits: int = 0         # records dropped by the sigcache probe
+    p2pkh_fast_path: int = 0       # inputs that skipped the generic EvalScript
     device_seconds: float = 0.0
     last_batch: int = 0
     # P3 pipeline overlap: dispatches currently in flight / high-water mark

@@ -141,14 +141,19 @@ class AddrMan:
             tbl.pop((pos[1], pos[2]), None)
         self.addrs.pop(key, None)
 
-    def _place_new(self, info: AddrInfo) -> bool:
-        """Insert into the new table; False = dropped (healthy incumbent)."""
+    def _place_new(self, info: AddrInfo, force: bool = False) -> bool:
+        """Insert into the new table; False = dropped (healthy incumbent).
+        ``force`` evicts the incumbent regardless — used when re-homing a
+        PROVEN-good address displaced from the tried table, which must not
+        lose to an unvetted gossip entry (CAddrMan::MakeTried clears the
+        slot for the demotee)."""
         b = self._new_bucket(info.host, info.source)
         s = self._slot("new", b, info.key)
         incumbent_key = self.new_tbl.get((b, s))
         if incumbent_key is not None and incumbent_key != info.key:
             incumbent = self.addrs.get(incumbent_key)
-            if incumbent is not None and not self._is_terrible(incumbent):
+            if (not force and incumbent is not None
+                    and not self._is_terrible(incumbent)):
                 return False  # slot defended: the flood is absorbed here
             self._drop(incumbent_key)
         self.new_tbl[(b, s)] = info.key
@@ -204,9 +209,10 @@ class AddrMan:
             self.tried_tbl.pop((b, s), None)
             self._pos.pop(incumbent_key, None)
             if incumbent is not None:
+                # demoted-but-proven address: force-home it in the new
+                # table (it must beat any unvetted gossip incumbent)
                 incumbent.tried = False
-                if not self._place_new(incumbent):
-                    self.addrs.pop(incumbent_key, None)
+                self._place_new(incumbent, force=True)
         cur.tried = True
         self.tried_tbl[(b, s)] = key
         self._pos[key] = ("tried", b, s)

@@ -18,10 +18,14 @@ from typing import Optional
 
 from ..consensus.params import ChainParams
 from ..ops import ecdsa_batch
+from ..crypto.hashes import hash160
 from ..script.interpreter import (
     SCRIPT_ENABLE_SIGHASH_FORKID,
+    SCRIPT_VERIFY_CLEANSTACK,
+    SCRIPT_VERIFY_MINIMALDATA,
     SCRIPT_VERIFY_NONE,
     SCRIPT_VERIFY_P2SH,
+    SCRIPT_VERIFY_SIGPUSHONLY,
     SCRIPT_VERIFY_STRICTENC,
     SCRIPT_VERIFY_CHECKLOCKTIMEVERIFY,
     SCRIPT_VERIFY_CHECKSEQUENCEVERIFY,
@@ -34,9 +38,78 @@ from ..script.interpreter import (
     SigCheckRecord,
     TransactionSignatureChecker,
     VerifyScript,
+    check_pubkey_encoding,
+    check_signature_encoding,
 )
 from ..script.sighash import SighashCache
 from .sigcache import SignatureCache
+
+# flags whose semantics the P2PKH fast path does not model — any of them
+# present forces the generic interpreter (block consensus flags never set
+# these; they are policy/test-only)
+_FAST_PATH_EXCLUDES = (
+    SCRIPT_VERIFY_MINIMALDATA
+    | SCRIPT_VERIFY_CLEANSTACK
+    | SCRIPT_VERIFY_SIGPUSHONLY
+)
+
+
+def _p2pkh_template(script_sig: bytes, spk: bytes):
+    """Detect the standard P2PKH spend shape — the overwhelmingly dominant
+    input form during a reindex. Returns (sig, pubkey) or None (anything
+    unusual falls back to the generic interpreter).
+
+    spk must be exactly OP_DUP OP_HASH160 <20> OP_EQUALVERIFY OP_CHECKSIG;
+    scriptSig exactly two direct pushes (0x01-0x4b length opcodes, or OP_0
+    for an empty item) with no trailing bytes."""
+    if (len(spk) != 25 or spk[0] != 0x76 or spk[1] != 0xA9 or spk[2] != 20
+            or spk[23] != 0x88 or spk[24] != 0xAC):
+        return None
+    ss = script_sig
+
+    def read_push(pos: int):
+        if pos >= len(ss):
+            return None
+        op = ss[pos]
+        if op == 0:
+            return b"", pos + 1
+        if 1 <= op <= 75:
+            end = pos + 1 + op
+            if end > len(ss):
+                return None
+            return ss[pos + 1:end], end
+        return None
+
+    got = read_push(0)
+    if got is None:
+        return None
+    sig, pos = got
+    got = read_push(pos)
+    if got is None:
+        return None
+    pub, pos = got
+    if pos != len(ss):
+        return None
+    return sig, pub
+
+
+def _p2pkh_fast_verify(sig: bytes, pub: bytes, spk: bytes, flags: int,
+                       checker) -> None:
+    """The exact EvalScript outcome for the P2PKH template without the
+    generic opcode machinery: DUP/HASH160/EQUALVERIFY collapse to one
+    hash160 compare, then the OP_CHECKSIG tail verbatim (same helper
+    functions, same error codes, same NULLFAIL/final-truthiness rules as
+    interpreter.py:~653). Raises ScriptError exactly where the generic
+    path would; returns on success."""
+    if hash160(pub) != spk[3:23]:
+        raise ScriptError("equalverify")
+    check_signature_encoding(sig, flags)
+    check_pubkey_encoding(pub, flags)
+    ok = checker.check_sig(sig, pub, spk, flags)
+    if not ok:
+        if (flags & SCRIPT_VERIFY_NULLFAIL) and sig:
+            raise ScriptError("sig-nullfail")
+        raise ScriptError("eval-false")
 
 
 def block_script_flags(height: int, block_time: int,
@@ -155,11 +228,23 @@ class BlockScriptVerifier:
                         checker = _InlineCountingChecker(
                             tx, i, coin.out.value, cache
                         )
+                    fast = (
+                        _p2pkh_template(txin.script_sig,
+                                        coin.out.script_pubkey)
+                        if not flags & _FAST_PATH_EXCLUDES else None
+                    )
                     try:
-                        VerifyScript(
-                            txin.script_sig, coin.out.script_pubkey, flags,
-                            checker
-                        )
+                        if fast is not None:
+                            ecdsa_batch.STATS.p2pkh_fast_path += 1
+                            _p2pkh_fast_verify(
+                                fast[0], fast[1], coin.out.script_pubkey,
+                                flags, checker
+                            )
+                        else:
+                            VerifyScript(
+                                txin.script_sig, coin.out.script_pubkey,
+                                flags, checker
+                            )
                     except ScriptError as e:
                         raise BlockValidationError(
                             "blk-bad-inputs",

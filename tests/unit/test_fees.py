@@ -108,3 +108,29 @@ def test_persistence_roundtrip(tmp_path):
         f.write("{broken")
     est3 = FeeEstimator(path)
     assert est3.estimate_fee(2) == -1
+
+
+def test_truncated_stats_file_never_fatal(tmp_path):
+    """A stats file with right outer shape but truncated inner arrays must
+    start cold, not IndexError inside block connection."""
+    import json
+
+    path = os.path.join(tmp_path, "fee_estimates.json")
+    est = FeeEstimator()
+    nb = len(est.buckets)
+    with open(path, "w") as f:
+        json.dump({"version": 1, "best_height": 5,
+                   "tx_avg": [0.0] * nb,
+                   "fee_sum": [0.0] * (nb - 3),          # truncated
+                   "conf_avg": [[0.0] * nb] * MAX_TARGET}, f)
+    est2 = FeeEstimator(path)
+    est2.process_tx(_txid(1), 10, 5000)
+    est2.process_block(11, [_txid(1)])  # must not raise
+    with open(path, "w") as f:
+        json.dump({"version": 1, "best_height": 5,
+                   "tx_avg": [0.0] * nb,
+                   "fee_sum": [0.0] * nb,
+                   "conf_avg": [[0.0] * 2] * MAX_TARGET}, f)  # ragged rows
+    est3 = FeeEstimator(path)
+    est3.process_tx(_txid(2), 10, 5000)
+    est3.process_block(11, [_txid(2)])  # must not raise

@@ -406,7 +406,8 @@ class TestMempoolPersist:
             self.mempool = pool
             self._cs, self._sigcache = cs, sigcache
 
-        def accept_to_mempool(self, tx, now=None):
+        def accept_to_mempool(self, tx, now=None,
+                              fee_estimate=True):
             return accept_to_memory_pool(self.mempool, self._cs, tx,
                                          sigcache=self._sigcache, now=now)
 

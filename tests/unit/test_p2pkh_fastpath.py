@@ -1,0 +1,158 @@
+"""P2PKH fast-path differential tests: for every input shape the template
+accepts, the fast verify must produce EXACTLY the generic interpreter's
+outcome — same success, same ScriptError code — and the template detector
+must reject anything whose semantics it cannot reproduce."""
+
+import random
+
+import pytest
+
+from bitcoincashplus_tpu.consensus.tx import COutPoint, CTransaction, CTxIn, CTxOut
+from bitcoincashplus_tpu.crypto import secp256k1 as o
+from bitcoincashplus_tpu.script import script as S
+from bitcoincashplus_tpu.script.interpreter import (
+    SCRIPT_ENABLE_SIGHASH_FORKID,
+    SCRIPT_VERIFY_LOW_S,
+    SCRIPT_VERIFY_NULLFAIL,
+    SCRIPT_VERIFY_P2SH,
+    SCRIPT_VERIFY_STRICTENC,
+    ScriptError,
+    TransactionSignatureChecker,
+    VerifyScript,
+)
+from bitcoincashplus_tpu.script.sighash import SIGHASH_ALL, SIGHASH_FORKID
+from bitcoincashplus_tpu.validation.scriptcheck import (
+    _p2pkh_fast_verify,
+    _p2pkh_template,
+)
+from bitcoincashplus_tpu.wallet.keys import CKey
+from bitcoincashplus_tpu.wallet.signing import make_signature
+
+KEY = CKey(0xD00D)
+KEY2 = CKey(0xBEEF)
+FLAGS = (SCRIPT_VERIFY_P2SH | SCRIPT_VERIFY_STRICTENC | SCRIPT_VERIFY_LOW_S
+         | SCRIPT_VERIFY_NULLFAIL | SCRIPT_ENABLE_SIGHASH_FORKID)
+AMOUNT = 50_000_000
+
+
+def _spend(spk: bytes, script_sig: bytes) -> CTransaction:
+    tx = CTransaction(
+        version=2,
+        vin=(CTxIn(COutPoint(b"\x55" * 32, 0), script_sig, 0xFFFFFFFE),),
+        vout=(CTxOut(AMOUNT - 1000, b"\x51"),),
+    )
+    return tx
+
+
+def _outcome_generic(spk, script_sig, flags=FLAGS):
+    tx = _spend(spk, script_sig)
+    try:
+        VerifyScript(script_sig, spk, flags,
+                     TransactionSignatureChecker(tx, 0, AMOUNT))
+        return "OK"
+    except ScriptError as e:
+        return e.code
+
+
+def _outcome_fast(spk, script_sig, flags=FLAGS):
+    tpl = _p2pkh_template(script_sig, spk)
+    if tpl is None:
+        return None  # template rejected: generic path would be used
+    tx = _spend(spk, script_sig)
+    try:
+        _p2pkh_fast_verify(tpl[0], tpl[1], spk, flags,
+                           TransactionSignatureChecker(tx, 0, AMOUNT))
+        return "OK"
+    except ScriptError as e:
+        return e.code
+
+
+def _signed_sig(key, spk, script_sig_placeholder=b"", flags=FLAGS,
+                hashtype=SIGHASH_ALL | SIGHASH_FORKID):
+    tx = _spend(spk, script_sig_placeholder)
+    return make_signature(key, spk, tx, 0, AMOUNT, hashtype & 0xBF,
+                          enable_forkid=bool(hashtype & SIGHASH_FORKID))
+
+
+def _push(b: bytes) -> bytes:
+    return S.push_data_raw(b)
+
+
+def test_differential_matrix():
+    spk = KEY.p2pkh_script()
+    sig = _signed_sig(KEY, spk)
+    r, s = o.sig_der_decode(sig[:-1])
+    high_s = o.sig_der_encode(r, o.N - s) + sig[-1:]
+    wrong_key_sig = _signed_sig(KEY2, spk)
+    legacy_sig = _signed_sig(KEY, spk, hashtype=SIGHASH_ALL)
+    pt = o.pubkey_parse(KEY.pubkey)
+    hybrid = bytes([6 + (pt[1] & 1)]) + pt[0].to_bytes(32, "big") + \
+        pt[1].to_bytes(32, "big")
+
+    cases = [
+        _push(sig) + _push(KEY.pubkey),                  # valid
+        _push(wrong_key_sig) + _push(KEY.pubkey),        # wrong key
+        _push(sig) + _push(KEY2.pubkey),                 # wrong pkh
+        _push(high_s) + _push(KEY.pubkey),               # high-S vs LOW_S
+        _push(legacy_sig) + _push(KEY.pubkey),           # must-use-forkid
+        _push(sig[:-1]) + _push(KEY.pubkey),             # hashtype missing
+        _push(sig[:10]) + _push(KEY.pubkey),             # truncated DER
+        _push(b"") + _push(KEY.pubkey),                  # empty sig (OP_0)
+        b"\x00" + _push(KEY.pubkey),                     # OP_0 empty sig
+        _push(sig) + _push(hybrid),                      # hybrid pubkey
+        _push(sig) + _push(KEY.pubkey[:-1]),             # truncated pubkey
+        _push(b"\x30\x06\x02\x01\x01\x02\x01\x01\x01")
+        + _push(KEY.pubkey),                             # garbage DER-ish
+    ]
+    for i, ss in enumerate(cases):
+        generic = _outcome_generic(spk, ss)
+        fast = _outcome_fast(spk, ss)
+        assert fast is not None, f"case {i}: template should accept"
+        assert fast == generic, f"case {i}: fast={fast} generic={generic}"
+
+    # without NULLFAIL, a failing sig ends as eval-false on both paths
+    flags2 = FLAGS & ~SCRIPT_VERIFY_NULLFAIL
+    assert _outcome_generic(spk, cases[1], flags2) == \
+        _outcome_fast(spk, cases[1], flags2) == "eval-false"
+
+    # and without STRICTENC the hybrid pubkey verifies on both paths
+    flags3 = (SCRIPT_VERIFY_NULLFAIL | SCRIPT_ENABLE_SIGHASH_FORKID)
+    hspk = spk  # hash160 mismatch for hybrid encoding vs compressed key
+    got_g = _outcome_generic(hspk, cases[9], flags3)
+    got_f = _outcome_fast(hspk, cases[9], flags3)
+    assert got_g == got_f  # equalverify (hash of hybrid form differs)
+
+
+def test_template_rejects_nonstandard_shapes():
+    spk = KEY.p2pkh_script()
+    sig = _signed_sig(KEY, spk)
+    ok_ss = _push(sig) + _push(KEY.pubkey)
+    # wrong spk shapes
+    assert _p2pkh_template(ok_ss, spk[:-1]) is None
+    assert _p2pkh_template(ok_ss, b"\x51" * 25) is None
+    assert _p2pkh_template(ok_ss, S.p2sh_script(b"\x11" * 20)) is None
+    # trailing bytes, extra push, PUSHDATA1 form, non-push opcode
+    assert _p2pkh_template(ok_ss + b"\x51", spk) is None
+    assert _p2pkh_template(ok_ss + _push(b"x"), spk) is None
+    pd1 = b"\x4c" + bytes([len(sig)]) + sig + _push(KEY.pubkey)
+    assert _p2pkh_template(pd1, spk) is None
+    assert _p2pkh_template(b"\x76" + ok_ss, spk) is None
+    # truncated push length
+    assert _p2pkh_template(b"\x4b\x01", spk) is None
+    assert _p2pkh_template(b"", spk) is None
+
+
+def test_fastpath_randomized_mutations():
+    rng = random.Random(99)
+    spk = KEY.p2pkh_script()
+    sig = _signed_sig(KEY, spk)
+    base = _push(sig) + _push(KEY.pubkey)
+    for _ in range(120):
+        ss = bytearray(base)
+        pos = rng.randrange(len(ss))
+        ss[pos] ^= 1 << rng.randrange(8)
+        ss = bytes(ss)
+        fast = _outcome_fast(spk, ss)
+        if fast is None:
+            continue  # template rejected the mutation: generic path used
+        assert fast == _outcome_generic(spk, ss), ss.hex()
