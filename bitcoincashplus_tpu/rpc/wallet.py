@@ -80,6 +80,7 @@ def sendtoaddress(node, params):
         tx = w.create_transaction(
             address, amount, node.chainstate.tip().height,
             fee=_wallet_fee(node), enable_forkid=True,
+            fee_rate=_wallet_fee(node),
         )
     except WalletError as e:
         raise RPCError(RPC_WALLET_UNLOCK_NEEDED, str(e)) from None
@@ -488,6 +489,7 @@ def sendmany(node, params):
         tx = w.create_transaction_multi(
             outputs, node.chainstate.tip().height,
             fee=_wallet_fee(node), enable_forkid=True,
+            fee_rate=_wallet_fee(node),
         )
     except WalletError as e:
         raise RPCError(RPC_WALLET_UNLOCK_NEEDED, str(e)) from None

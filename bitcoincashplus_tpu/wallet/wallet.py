@@ -459,13 +459,14 @@ class Wallet:
         tip_height: int,
         fee: int = 1000,
         enable_forkid: bool = False,
+        fee_rate: Optional[int] = None,
     ) -> CTransaction:
         script_pubkey = address_to_script(address, self.params)
         if script_pubkey is None:
             raise ValueError(f"bad address {address}")
         return self.create_transaction_multi(
             [(script_pubkey, amount)], tip_height, fee=fee,
-            enable_forkid=enable_forkid,
+            enable_forkid=enable_forkid, fee_rate=fee_rate,
         )
 
     def create_transaction_multi(
@@ -474,9 +475,16 @@ class Wallet:
         tip_height: int,
         fee: int = 1000,
         enable_forkid: bool = False,
+        fee_rate: Optional[int] = None,
     ) -> CTransaction:
         """CWallet::CreateTransaction: select coins (largest-first), build,
-        sign, with change back to a fresh key."""
+        sign, with change back to a fresh key.
+
+        ``fee`` is the flat floor; with ``fee_rate`` (sat/kB) the fee
+        scales with the ESTIMATED size like the reference's selection loop
+        — a wallet full of small UTXOs needs hundreds of inputs, and a
+        flat 1000-sat fee on a 40 kB transaction would be rejected by
+        every relay policy on the network (and by our own ATMP)."""
         if self.is_locked:
             raise WalletError(
                 "wallet is locked; unlock with walletpassphrase first"
@@ -487,16 +495,30 @@ class Wallet:
             key=lambda c: c.txout.value, reverse=True,
         )
         selected, total = [], 0
-        for coin in coins:
-            selected.append(coin)
-            total += coin.txout.value
-            if total >= amount + fee:
+        fee_used = fee
+        need = amount + fee_used
+        idx = 0
+        while True:
+            while total < need:
+                if idx >= len(coins):
+                    raise ValueError(
+                        f"insufficient funds: {total} < {need}"
+                    )
+                selected.append(coins[idx])
+                total += coins[idx].txout.value
+                idx += 1
+            if fee_rate is None:
                 break
-        if total < amount + fee:
-            raise ValueError(f"insufficient funds: {total} < {amount + fee}")
+            # ~148 B per P2PKH input, ~34 B per output (+1 for change)
+            size_est = 10 + len(selected) * 148 + (len(outputs) + 1) * 34
+            required = max(fee, -(-size_est * fee_rate // 1000))
+            if amount + required <= total:
+                fee_used = required
+                break
+            need = amount + required  # select more coins, re-estimate
 
         vout = [CTxOut(v, s) for s, v in outputs]
-        change = total - amount - fee
+        change = total - amount - fee_used
         if change > 546:  # dust threshold (policy)
             change_key = self.derive_new_key()
             self.add_key(change_key)
