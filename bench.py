@@ -393,8 +393,12 @@ def bench_reindex():
             _sk = _rng.randrange(1, _o.N)
             _pub = _o.point_mul(_sk, _o.G)
             _sign = _nat.ecdsa_sign if _nat.available() else _o.ecdsa_sign
+            # warm the EXACT bucket shape the dense blocks will dispatch
+            # (inputs_per_tx * txs_per_block = 2000 -> bucket 2048): the
+            # jit program is shape-keyed, so warming a different bucket
+            # would leave the ~1-2 min compile inside the measured wall
             warm_recs = []
-            for i in range(130):  # > 128 lanes: exercises the 3D program
+            for i in range(1100):  # bucket_for(1100) == 2048
                 _e = _rng.getrandbits(256)
                 _r, _s = _sign(_sk, _e)
                 warm_recs.append(SigCheckRecord(_pub, _r, _s, _e))

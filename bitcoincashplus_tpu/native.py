@@ -120,7 +120,8 @@ def hash_headers(headers: bytes) -> list[bytes]:
     assert lib is not None, "native library unavailable"
     out = ctypes.create_string_buffer(32 * n)
     lib.bcp_hash_headers(headers, n, out)
-    return [out.raw[32 * i:32 * i + 32] for i in range(n)]
+    raw = out.raw  # ONE copy: .raw copies the whole buffer per access
+    return [raw[32 * i:32 * i + 32] for i in range(n)]
 
 
 class BlockScan:
@@ -145,8 +146,9 @@ def scan_block(raw: bytes, max_tx: int = 100_000) -> Optional[BlockScan]:
     n = lib.bcp_scan_block(raw, len(raw), txids, offsets, max_tx)
     if n < 0:
         return None
+    raw_txids = txids.raw  # ONE copy (see hash_headers)
     return BlockScan(
-        [txids.raw[32 * i:32 * i + 32] for i in range(n)],
+        [raw_txids[32 * i:32 * i + 32] for i in range(n)],
         [(int(offsets[2 * i]), int(offsets[2 * i + 1])) for i in range(n)],
     )
 
