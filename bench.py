@@ -448,6 +448,14 @@ def bench_reindex():
                 "model_sig_inputs": MAINNET_SIG_INPUTS,
                 "model_bytes": MAINNET_BYTES,
                 "model_blocks": MAINNET_BLOCKS,
+                # the reference's DEFAULT -reindex skips script/sig checks
+                # below the assumevalid checkpoint (~90% of history); the
+                # headline number above is the conservative FULL-verify
+                # projection. Model: 10% of sig inputs above checkpoint.
+                "assumevalid_projected_min": round(
+                    (proj_sig_leg * 0.10 + proj_byte_leg) / 60
+                ),
+                "model_above_assumevalid_fraction": 0.10,
             },
             note="synthetic P2PKH sig-dense chain via tools/gen_sigchain.py; "
                  "full script+sig validation (no assumevalid skip); target "

@@ -10,7 +10,6 @@ changes (no C++ template metaprogramming — plain functions + a cursor).
 from __future__ import annotations
 
 import struct
-from dataclasses import dataclass
 
 MAX_SIZE = 0x02000000  # src/serialize.h:~26 (MAX_SIZE) — sanity bound for sizes
 
@@ -19,35 +18,43 @@ class DeserializationError(ValueError):
     """Raised on malformed wire bytes (reference: std::ios_base::failure)."""
 
 
-@dataclass
 class ByteReader:
-    """Cursor over immutable bytes — replaces CDataStream's read side."""
+    """Cursor over immutable bytes — replaces CDataStream's read side.
+    __slots__ + a cached length: this type's read methods are the hottest
+    Python frames in a -reindex (hundreds of calls per transaction), so
+    every attribute lookup and len() matters."""
 
-    data: memoryview
-    pos: int = 0
+    __slots__ = ("data", "pos", "_len")
 
     def __init__(self, data: bytes | bytearray | memoryview, pos: int = 0):
         self.data = memoryview(data)
         self.pos = pos
+        self._len = len(self.data)
 
     def read(self, n: int) -> memoryview:
-        if n < 0 or self.pos + n > len(self.data):
+        pos = self.pos
+        if n < 0 or pos + n > self._len:
             raise DeserializationError(
-                f"read past end: want {n} at {self.pos}, have {len(self.data)}"
+                f"read past end: want {n} at {pos}, have {self._len}"
             )
-        out = self.data[self.pos : self.pos + n]
-        self.pos += n
-        return out
+        self.pos = pos + n
+        return self.data[pos:pos + n]
 
     def read_bytes(self, n: int) -> bytes:
-        return bytes(self.read(n))
+        pos = self.pos
+        if n < 0 or pos + n > self._len:
+            raise DeserializationError(
+                f"read past end: want {n} at {pos}, have {self._len}"
+            )
+        self.pos = pos + n
+        return bytes(self.data[pos:pos + n])
 
     @property
     def remaining(self) -> int:
-        return len(self.data) - self.pos
+        return self._len - self.pos
 
     def empty(self) -> bool:
-        return self.pos >= len(self.data)
+        return self.pos >= self._len
 
 
 # ---- fixed-width little-endian integers ----
@@ -76,28 +83,47 @@ def ser_i64(v: int) -> bytes:
     return struct.pack("<q", v)
 
 
+# precompiled Structs + unpack_from straight off the memoryview: no slice
+# objects, no per-call format parse (reindex-hot)
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_I32 = struct.Struct("<i")
+_U64 = struct.Struct("<Q")
+_I64 = struct.Struct("<q")
+
+
 def deser_u8(r: ByteReader) -> int:
     return r.read(1)[0]
 
 
+def _deser_fixed(r: ByteReader, st, n: int) -> int:
+    pos = r.pos
+    if pos + n > r._len:
+        raise DeserializationError(
+            f"read past end: want {n} at {pos}, have {r._len}"
+        )
+    r.pos = pos + n
+    return st.unpack_from(r.data, pos)[0]
+
+
 def deser_u16(r: ByteReader) -> int:
-    return struct.unpack("<H", r.read(2))[0]
+    return _deser_fixed(r, _U16, 2)
 
 
 def deser_u32(r: ByteReader) -> int:
-    return struct.unpack("<I", r.read(4))[0]
+    return _deser_fixed(r, _U32, 4)
 
 
 def deser_i32(r: ByteReader) -> int:
-    return struct.unpack("<i", r.read(4))[0]
+    return _deser_fixed(r, _I32, 4)
 
 
 def deser_u64(r: ByteReader) -> int:
-    return struct.unpack("<Q", r.read(8))[0]
+    return _deser_fixed(r, _U64, 8)
 
 
 def deser_i64(r: ByteReader) -> int:
-    return struct.unpack("<q", r.read(8))[0]
+    return _deser_fixed(r, _I64, 8)
 
 
 # ---- CompactSize varint (src/serialize.h:~200 WriteCompactSize/ReadCompactSize) ----
