@@ -10,14 +10,21 @@ mitigation) is intentionally dropped — it has no behavioral surface.
 from __future__ import annotations
 
 import sqlite3
+import threading
 from typing import Iterator, Optional
 
 
 class KVStore:
     def __init__(self, path: str):
         # isolation_level=None -> explicit transaction control.
-        # check_same_thread=False: RPC worker threads reach the store, but
-        # every access serializes under the node's cs_main lock.
+        # check_same_thread=False: RPC worker threads reach the store.
+        # Most access serializes under the node's cs_main, but not ALL of
+        # it — node INIT keeps working while the background txindex
+        # backfill thread writes under cs_main, and two overlapping
+        # BEGIN/COMMIT spans on ONE sqlite connection raise ("cannot start
+        # a transaction within a transaction"). The store owns its write
+        # lock so atomicity never depends on every caller's locking.
+        self._write_lock = threading.Lock()
         self._db = sqlite3.connect(path, isolation_level=None,
                                    check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
@@ -45,23 +52,25 @@ class KVStore:
     def write_batch(self, puts: dict[bytes, bytes], deletes: list[bytes] = (),
                     sync: bool = False) -> None:
         """CDBBatch + WriteBatch: all-or-nothing (one sqlite transaction)."""
-        cur = self._db.cursor()
-        cur.execute("BEGIN")
-        try:
-            if deletes:
-                cur.executemany("DELETE FROM kv WHERE k = ?", [(k,) for k in deletes])
-            if puts:
-                cur.executemany(
-                    "INSERT INTO kv (k, v) VALUES (?, ?) "
-                    "ON CONFLICT(k) DO UPDATE SET v=excluded.v",
-                    list(puts.items()),
-                )
-            cur.execute("COMMIT")
-        except BaseException:
-            cur.execute("ROLLBACK")
-            raise
-        if sync:
-            self._db.execute("PRAGMA wal_checkpoint(FULL)")
+        with self._write_lock:
+            cur = self._db.cursor()
+            cur.execute("BEGIN")
+            try:
+                if deletes:
+                    cur.executemany("DELETE FROM kv WHERE k = ?",
+                                    [(k,) for k in deletes])
+                if puts:
+                    cur.executemany(
+                        "INSERT INTO kv (k, v) VALUES (?, ?) "
+                        "ON CONFLICT(k) DO UPDATE SET v=excluded.v",
+                        list(puts.items()),
+                    )
+                cur.execute("COMMIT")
+            except BaseException:
+                cur.execute("ROLLBACK")
+                raise
+            if sync:
+                self._db.execute("PRAGMA wal_checkpoint(FULL)")
 
     def iterate(self, prefix: bytes = b"") -> Iterator[tuple[bytes, bytes]]:
         """Ordered iteration over keys with the given prefix — CDBIterator."""

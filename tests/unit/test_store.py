@@ -127,3 +127,32 @@ class TestBlockIndexDB:
         assert not db.get_flag(b"txindex")
         db.put_flag(b"txindex", True)
         assert db.get_flag(b"txindex")
+
+
+def test_concurrent_write_batches_serialize(tmp_path):
+    """Two threads batching into one store must not interleave sqlite
+    transactions ('cannot start a transaction within a transaction' — the
+    txindex-backfill-vs-init race)."""
+    import threading
+
+    from bitcoincashplus_tpu.store.kvstore import KVStore
+
+    kv = KVStore(str(tmp_path / "kv.sqlite"))
+    errors = []
+
+    def writer(tag: bytes):
+        try:
+            for i in range(200):
+                kv.write_batch({tag + bytes([i % 256]): tag * 4})
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(bytes([t]),))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert kv.get(b"\x00\x00") is not None
+    kv.close()
