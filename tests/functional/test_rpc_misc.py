@@ -193,3 +193,26 @@ def test_longpoll_and_wait_rpcs():
         assert info[0]["connected"] is False
         node.rpc.addnode("127.0.0.1:1", "remove")
         assert node.rpc.getaddednodeinfo() == []
+
+
+def test_fee_estimator_rpc():
+    """estimatefee/estimatesmartfee over the bucketed estimator: cold start
+    errors, then confirmed wallet txs feed per-target estimates."""
+    with FunctionalFramework(num_nodes=1) as f:
+        node = f.nodes[0]
+        # cold: estimatefee -1, smart falls back to the relay floor + error
+        assert node.rpc.estimatefee(2) == -1
+        cold = node.rpc.estimatesmartfee(2)
+        assert cold["errors"]
+        addr = node.rpc.getnewaddress()
+        node.rpc.generatetoaddress(103, addr)
+        # a few wallet txs confirming next-block at wallet feerates
+        for _ in range(6):
+            node.rpc.sendtoaddress(node.rpc.getnewaddress(), 0.5)
+            node.rpc.generatetoaddress(1, addr)
+        est = node.rpc.estimatesmartfee(2)
+        assert "errors" not in est, est
+        assert est["feerate"] > 0
+        assert est["blocks"] >= 1
+        # estimatefee agrees within the answering horizon
+        assert node.rpc.estimatefee(est["blocks"]) > 0
