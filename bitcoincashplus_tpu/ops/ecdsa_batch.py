@@ -74,18 +74,23 @@ STATS = BatchStats()
 
 def _bucket_for(n: int, pallas: bool = False) -> int:
     if pallas and n > 128:
-        # w4-bytes program buckets: powers of two in [1024, 16384], then
-        # 16384-granular (the program splits at 16384 per call) — the jit
-        # bakes B into shapes and grid, so bucket sizes ARE compiled-
-        # program shapes and must stay a small bounded set (a fresh Mosaic
-        # compile is ~1-2 min on a tunneled chip). Batches <= 128 lanes
-        # use the 2D kernel's small buckets.
-        b = 1024
-        while b < n and b < 16384:
-            b *= 2
-        if n > b:  # > 16384: round to 16384-granular multi-call batches
-            return ((n + 16383) // 16384) * 16384
-        return b
+        # w4-bytes program buckets: {1024, 2048, 4096} then 2048-granular
+        # up to 16384, then 16384-granular (the program splits at 16384
+        # per call) — the jit bakes B into shapes and grid, so bucket
+        # sizes ARE compiled-program shapes and must stay a small bounded
+        # set (a fresh Mosaic compile is ~1-2 min on a tunneled chip; at
+        # most 9 shapes exist, and only the ones actually hit compile).
+        # 2048-granularity bounds worst-case padding waste at ~33%
+        # (n=4097 -> 6144) and ~20% at the 10k scale — a pure pow2 ladder
+        # padded the bench's 10k batch to 16384 (39% wasted grid steps).
+        # Batches <= 128 lanes use the 2D kernel's small buckets.
+        if n <= 1024:
+            return 1024
+        if n <= 4096:
+            return 2048 if n <= 2048 else 4096
+        if n <= 16384:
+            return ((n + 2047) // 2048) * 2048
+        return ((n + 16383) // 16384) * 16384
     for b in BUCKETS:
         if n <= b:
             return b

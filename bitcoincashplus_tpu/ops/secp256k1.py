@@ -1038,8 +1038,9 @@ def ecdsa_verify_batch_pallas_w4_bytes(u1m, u2m, qxb, qyb, q_inf8, r0b,
                                        rnb, wrap8):
     """Byte-matrix w4 verify (see _w4_bytes_program). B must be a multiple
     of 1024; batches beyond 16384 are split into 16384-lane program calls
-    so compiled shapes stay the bounded set {1024, 2048, 4096, 8192,
-    16384} (the jit bakes B into shapes + grid; see _bucket_for). Returns
+    so compiled shapes stay the bounded set {1024, 2048, 4096, then
+    2048-granular to 16384} — at most 9 shapes, only those actually hit
+    compile (the jit bakes B into shapes + grid; see _bucket_for). Returns
     (ok, degen) bool (B,) arrays — still device futures until
     materialized."""
     B = qxb.shape[0]

@@ -127,3 +127,23 @@ def test_sharded_batch_differential():
     recs, expected = make_records(16, n_bad=5)
     ok = verify_batch_sharded(recs, 8)
     assert ok.tolist() == expected
+
+
+def test_pallas_bucket_ladder_boundaries():
+    """The w4 bucket ladder: every bucket is >= n, a multiple of 1024 (the
+    3D program's hard assert), and drawn from the bounded shape set."""
+    from bitcoincashplus_tpu.ops.ecdsa_batch import _bucket_for
+
+    allowed = {1024, 2048, 4096} | set(range(6144, 16385, 2048))
+    for n in (129, 1000, 1024, 1025, 2048, 2049, 4096, 4097, 6144, 6145,
+              10000, 16384):
+        b = _bucket_for(n, pallas=True)
+        assert b >= n and b % 1024 == 0, (n, b)
+        assert b in allowed, (n, b)
+    # beyond the split point: 16384-granular multiples
+    for n in (16385, 30000, 32769):
+        b = _bucket_for(n, pallas=True)
+        assert b >= n and b % 16384 == 0, (n, b)
+    # small batches keep the 2D kernel's buckets
+    assert _bucket_for(128, pallas=True) == 128
+    assert _bucket_for(8, pallas=True) == 32
