@@ -407,8 +407,15 @@ def _note_pallas_failure(e: Exception) -> None:
     """Pallas compile failure bookkeeping (jit compilation is synchronous,
     so failures surface at the dispatch call). Deterministic Mosaic/
     lowering failures latch _PALLAS_BROKEN; transient remote-compile-
-    service errors do NOT — the next dispatch retries."""
+    service errors do NOT — the next dispatch retries.
+
+    Programming errors are NOT toolchain failures: a NameError inside the
+    kernel code would otherwise degrade silently to the XLA fallback
+    forever (it happened — a refactor deleted _PALLAS_SUPER and every
+    test stayed green on the fallback). Those re-raise."""
     global _PALLAS_BROKEN
+    if isinstance(e, (NameError, AttributeError, UnboundLocalError)):
+        raise e
     STATS.pallas_fallbacks += 1
     text = f"{type(e).__name__}: {e}"
     if ("Mosaic" in text or "NotImplementedError" in text

@@ -581,168 +581,18 @@ def _pt_select_u(mask_u, t: dict, f: dict) -> dict:
     }
 
 
-def _pt_add_mixed_u(pt: dict, qx, qy, q_inf_u, one) -> dict:
-    """pt_add_mixed with int32 0/1 masks (see pt_add_mixed for the math and
-    the completeness case analysis — this is the same formulae with the
-    bool algebra replaced by 0/1 integer products)."""
-    X, Y, Z = pt["X"], pt["Y"], pt["Z"]
-    Z1Z1 = f_sqr(Z)
-    U2 = f_mul(qx, Z1Z1)
-    S2 = f_mul(qy, f_mul(Z, Z1Z1))
-    H = f_carry_sub(U2, X)
-    R = f_carry_sub(S2, Y)
-    h_zero = _is_zero_u(H)
-    r_zero = _is_zero_u(R)
-    finite_both = (1 - pt["inf"]) * (1 - q_inf_u)
-    same = h_zero * r_zero * finite_both
-    opposite = h_zero * (1 - r_zero) * finite_both
-    HH = f_sqr(H)
-    HHH = f_mul(H, HH)
-    V = f_mul(X, HH)
-    X3 = f_carry_sub(f_sqr(R), f_carry(f_add(HHH, f_carry(f_add(V, V)))))
-    Y3 = f_carry_sub(f_mul(R, f_carry_sub(V, X3)), f_mul(Y, HHH))
-    Z3 = f_mul(Z, H)
-    out = {"X": X3, "Y": Y3, "Z": Z3, "inf": opposite}
+# (The round-3 bit-at-a-time Pallas ladder — _verify_core_2d /
+# ecdsa_verify_batch_pallas — was removed in round 4: the w=4 windowed
+# kernels below replaced it in dispatch and nothing else consumed it.
+# The XLA bit-ladder form ecdsa_verify_batch_jit above remains as the
+# compile-failure fallback and the mesh-sharded path.)
 
-    out = _pt_select_u(same, pt_double(pt), out)
-    q_as_jac = {
-        "X": jnp.broadcast_to(qx, X.shape).astype(jnp.uint32),
-        "Y": jnp.broadcast_to(qy, X.shape).astype(jnp.uint32),
-        "Z": one,
-        "inf": q_inf_u,
-    }
-    out = _pt_select_u(pt["inf"], q_as_jac, out)
-    out = _pt_select_u(q_inf_u * (1 - pt["inf"]), pt, out)
-    return out
-
-
-def _verify_core_2d(get_u1, get_u2, qx, qy, q_inf2, r0, rn, wrap2,
-                    in_kernel: bool = False):
-    """ecdsa_verify_batch_device with (1, B) int32 masks — the form the
-    Pallas kernel runs (1D vectors and bool data don't lower in Mosaic).
-    get_u1/get_u2 fetch bit-plane row i as (1, B) (a ref dynamic-slice in
-    the kernel — Mosaic can't dynamic_slice loaded values). Returns (1, B)
-    int32 0/1 validity."""
-    batch = qx.shape[1]
-    if in_kernel:
-        gx = _build_const_limbs(to_limbs_np(GX), (N_LIMBS, batch))
-        gy = _build_const_limbs(to_limbs_np(GY), (N_LIMBS, batch))
-        one = _build_const_limbs([1], (N_LIMBS, batch))
-    else:
-        gx = jnp.broadcast_to(_GX_CONST, (N_LIMBS, batch)).astype(jnp.uint32)
-        gy = jnp.broadcast_to(_GY_CONST, (N_LIMBS, batch)).astype(jnp.uint32)
-        one = jnp.broadcast_to(_const(1), (N_LIMBS, batch)).astype(jnp.uint32)
-    q_inf_u = q_inf2.astype(jnp.int32)
-    never_inf = jnp.zeros((1, batch), jnp.int32)
-
-    def step(i, acc):
-        acc = pt_double(acc)
-        with_g = _pt_add_mixed_u(acc, gx, gy, never_inf, one)
-        acc = _pt_select_u(get_u1(i).astype(jnp.int32), with_g, acc)
-        with_q = _pt_add_mixed_u(acc, qx, qy, q_inf_u, one)
-        acc = _pt_select_u(
-            get_u2(i).astype(jnp.int32) * (1 - q_inf_u), with_q, acc
-        )
-        return acc
-
-    zero_v = qx * U32_0
-    acc0 = {
-        "X": zero_v + one,
-        "Y": zero_v + one,
-        "Z": zero_v,
-        "inf": jnp.ones((1, batch), jnp.int32) * (1 + q_inf_u * 0),
-    }
-    acc = jax.lax.fori_loop(0, 256, step, acc0)
-
-    ZZ = f_sqr(acc["Z"])
-    ok0 = _is_zero_u(f_carry_sub(acc["X"], f_mul(r0, ZZ)))
-    ok1 = (
-        _is_zero_u(f_carry_sub(acc["X"], f_mul(rn, ZZ)))
-        * wrap2.astype(jnp.int32)
-    )
-    return (1 - acc["inf"]) * (1 - q_inf_u) * jnp.maximum(ok0, ok1)
-
-
-def _verify_kernel(u1_ref, u2_ref, qx_ref, qy_ref, qinf_ref, r0_ref, rn_ref,
-                   wrap_ref, out_ref):
-    from jax.experimental import pallas as pl
-
-    # mask planes arrive 8-row-replicated (Mosaic crashes on sublane-1
-    # blocks across multi-step grids); row 0 is the real data
-    with _KernelConsts(u1_ref.shape[1]):
-        ok = _verify_core_2d(
-            lambda i: u1_ref[pl.ds(i, 1), :],
-            lambda i: u2_ref[pl.ds(i, 1), :],
-            qx_ref[...], qy_ref[...], qinf_ref[0:1, :],
-            r0_ref[...], rn_ref[...], wrap_ref[0:1, :], in_kernel=True,
-        )
-    out_ref[...] = jnp.broadcast_to(
-        ok.astype(jnp.uint32), out_ref.shape
-    )
-
-
-# Mosaic (jax 0.9.0 / this libtpu) SIGABRTs compiling this kernel at lane
-# widths > 128 and on multi-step grids, so the lane axis is chunked as
-# grid-1, 128-lane invocations stitched by XLA; and the remote compile
-# service chokes on programs with ~128 custom-calls, so jitted programs are
-# capped at a 4096-lane super-chunk (32 calls) with a host loop above.
-# Measured: 4100 sigs/s vs 1468 for the XLA fori_loop form (2.8x) — the
-# entire win is the 256-step ladder keeping its working set in VMEM.
+# Mosaic on this toolchain rejects >128-LANE tiles; small (<=128-lane)
+# batches run the 2D kernel in one 128-lane tile, and the 2D wrapper
+# splits anything larger into <=4096-lane jit programs (the 3D byte
+# pipeline below is the production path for those).
 _PALLAS_TILE = 128
 _PALLAS_SUPER = 4096
-
-
-@jax.jit
-def _pallas_verify_program(u1_bits, u2_bits, qx, qy, q2, r0, rn, w2):
-    """<=4096-lane slice -> (8, S) validity plane (row 0 real). One
-    compiled program per distinct slice width (shape-keyed jit cache)."""
-    from jax.experimental import pallas as pl
-
-    S = qx.shape[1]
-    tile = min(_PALLAS_TILE, S)
-    assert S % tile == 0, (S, tile)
-    bs = lambda r: pl.BlockSpec((r, tile), lambda i: (0, 0))  # noqa: E731
-    call = pl.pallas_call(
-        _verify_kernel,
-        grid=(1,),
-        in_specs=[bs(256), bs(256), bs(N_LIMBS), bs(N_LIMBS), bs(8),
-                  bs(N_LIMBS), bs(N_LIMBS), bs(8)],
-        out_specs=bs(8),
-        out_shape=jax.ShapeDtypeStruct((8, tile), jnp.uint32),
-    )
-    outs = []
-    for c in range(S // tile):
-        sl = slice(c * tile, (c + 1) * tile)
-        outs.append(call(
-            u1_bits[:, sl], u2_bits[:, sl], qx[:, sl], qy[:, sl],
-            q2[:, sl], r0[:, sl], rn[:, sl], w2[:, sl],
-        ))
-    return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
-
-
-def ecdsa_verify_batch_pallas(u1_bits, u2_bits, qx, qy, q_inf, r0, rn,
-                              wrap_ok):
-    """Pallas verify: the whole 256-step ladder runs as Mosaic kernels with
-    every intermediate in VMEM/registers (same math and results as
-    ecdsa_verify_batch_jit; dispatch stays async — the returned array is a
-    device future until materialized)."""
-    B = qx.shape[1]
-    q2 = jnp.broadcast_to(
-        jnp.asarray(q_inf).astype(jnp.uint32).reshape(1, B), (8, B)
-    )
-    w2 = jnp.broadcast_to(
-        jnp.asarray(wrap_ok).astype(jnp.uint32).reshape(1, B), (8, B)
-    )
-    pieces = []
-    for s in range(0, B, _PALLAS_SUPER):
-        sl = slice(s, min(s + _PALLAS_SUPER, B))
-        pieces.append(_pallas_verify_program(
-            u1_bits[:, sl], u2_bits[:, sl], qx[:, sl], qy[:, sl],
-            q2[:, sl], r0[:, sl], rn[:, sl], w2[:, sl],
-        )[0])
-    out = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
-    return out.astype(bool)
-
 
 # ---- w=4 windowed Pallas verify kernel (round 4) --------------------------
 #

@@ -147,3 +147,22 @@ def test_pallas_bucket_ladder_boundaries():
     # small batches keep the 2D kernel's buckets
     assert _bucket_for(128, pallas=True) == 128
     assert _bucket_for(8, pallas=True) == 32
+
+
+def test_pallas_programming_errors_are_not_swallowed(monkeypatch):
+    """A NameError/AttributeError inside the Pallas path is a BUG, not a
+    toolchain limitation — it must propagate, not degrade silently to the
+    XLA fallback (regression: a refactor deleted a module constant and
+    every test stayed green on the fallback)."""
+    import pytest
+
+    from bitcoincashplus_tpu.ops import ecdsa_batch as eb
+
+    with pytest.raises(NameError):
+        eb._note_pallas_failure(NameError("name '_GONE' is not defined"))
+    with pytest.raises(AttributeError):
+        eb._note_pallas_failure(AttributeError("no attribute"))
+    # toolchain-class failures still fall back (and latch when Mosaic)
+    before = eb.STATS.pallas_fallbacks
+    eb._note_pallas_failure(RuntimeError("remote compile service sneeze"))
+    assert eb.STATS.pallas_fallbacks == before + 1
