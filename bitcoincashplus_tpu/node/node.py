@@ -41,6 +41,12 @@ class InitError(Exception):
     pass
 
 
+class _NativeImportAbort(Exception):
+    """A staged fast-import block's signature batch failed after commit —
+    recover by rebuilding from the last flush and replaying through the
+    Python engine (node.import_block_files)."""
+
+
 class Node:
     """One full node over a datadir. Construct → (optionally) start_rpc/start_p2p
     → work → close(). Usable in-process (tests) or via bcpd (cli/)."""
@@ -105,6 +111,7 @@ class Node:
         self.notify_cv = threading.Condition()
 
         reindex = config.get_bool("reindex")
+        self.last_import_stats: Optional[dict] = None
         blocks_dir = os.path.join(self.datadir, "blocks")
         index_path = os.path.join(blocks_dir, "index.sqlite")
         coins_path = os.path.join(self.datadir, "chainstate.sqlite")
@@ -114,6 +121,15 @@ class Node:
                 for suffix in ("", "-wal", "-shm"):
                     if os.path.exists(p + suffix):
                         os.remove(p + suffix)
+            # undo data is derived too: the import rebuilds every record,
+            # and the wiped undo_positions would otherwise leave the old
+            # records stranded in the rev files forever (the reference
+            # rewrites undo during a reindex as well)
+            import glob as _glob
+
+            for p in _glob.glob(os.path.join(blocks_dir, "rev*.dat")):
+                with open(p, "wb"):
+                    pass
             log_printf("-reindex: wiped block index and chainstate")
 
         os.makedirs(blocks_dir, exist_ok=True)
@@ -486,7 +502,383 @@ class Node:
         bootstrap.dat form): scan (netmagic, size, block) records,
         re-register data positions, and ProcessNewBlock each one.
         Out-of-order blocks park via accept-header failure and are retried
-        once their parent lands."""
+        once their parent lands.
+
+        Two engines run this path. The NATIVE fast import (the reference's
+        all-C++ pipeline shape: parse, sanity, merkle, UTXO apply, undo and
+        the P2PKH sig scan in native/connect.cpp; TPU batch for the ECDSA
+        math) handles the dominant linear case; the Python loop below is
+        the reference implementation and handles everything the fast path
+        declines (reorgs, invalid blocks, -loadblock, hook listeners) —
+        every fast-path block still ends in a byte-identical chainstate
+        (differential: tests/unit/test_native_connect.py)."""
+        from .. import native as _nat
+
+        if (paths is None
+                and _nat.engine_available()
+                and not os.environ.get("BCP_NO_NATIVE_IMPORT")
+                and not self.chainstate.on_block_connected
+                and not self.chainstate.on_block_disconnected):
+            try:
+                return self._import_block_files_native()
+            except _NativeImportAbort as e:
+                # rare: an in-flight signature batch failed after its block
+                # was staged — rebuild the in-memory state from the last
+                # flush and let the Python engine produce the verdict
+                log_printf("native import aborted (%s); replaying through "
+                           "the Python engine", e)
+                self._rebuild_chainstate_from_disk()
+        return self._import_block_files_python(paths)
+
+    def _rebuild_chainstate_from_disk(self) -> None:
+        """Reset the in-memory chain objects to the last flushed on-disk
+        state (the native fast-import recovery path). Only callable before
+        servers start — import runs during init."""
+        verifier = BlockScriptVerifier(self.params, backend=self.backend,
+                                       sigcache=self.sigcache)
+        self.block_store.positions.clear()
+        self.block_store.undo_positions.clear()
+        self.chainstate = ChainstateManager(
+            self.params, self.coins_db, self.block_store,
+            script_verifier=verifier, index_db=self.index_db,
+        )
+        self.chainstate.load_block_index()
+
+    def _import_block_files_native(self) -> int:
+        """The fast -reindex import: native connect engine + packed TPU
+        signature batches, linear-extension blocks only (anything else
+        flushes and defers to the Python engine per block)."""
+        import struct
+
+        import numpy as np
+
+        from .. import native
+        from ..consensus.block import CBlockHeader
+        from ..consensus.params import get_block_subsidy
+        from ..consensus.serialize import ByteReader
+        from ..consensus.tx import CTransaction
+        from ..ops import ecdsa_batch
+        from ..script.interpreter import (
+            SCRIPT_VERIFY_NULLFAIL,
+            DeferringSignatureChecker,
+            ScriptError,
+            VerifyScript,
+        )
+        from ..script.script import script_int
+        from ..script.sighash import SighashCache
+        from ..validation.chain import BlockStatus, CBlockIndex
+        from ..validation.scriptcheck import block_script_flags
+
+        cs = self.chainstate
+        params = self.params
+        consensus = params.consensus
+        magic = params.netmagic
+        # import runs before __init__ assigns the post-import knobs
+        flush_interval = self.config.get_int("flushinterval",
+                                             DEFAULT_FLUSH_INTERVAL)
+        dbcache_bytes = max(
+            1, self.config.get_int("dbcache", 300)) * 1024 * 1024
+        t_start = time.perf_counter()
+        cs.flush()  # the engine's base view must be current before takeover
+
+        eng = native.ConnectEngine()
+        eng.set_best(cs.coins.best_block())
+        stats = {"blocks": 0, "bytes": 0, "native_connect_s": 0.0,
+                 "verify_s": 0.0, "flush_s": 0.0, "slow_path_blocks": 0,
+                 "fallback_inputs": 0, "fast_inputs": 0}
+        n_imported = 0
+        pending: dict[bytes, list[tuple[bytes, Optional[tuple]]]] = {}
+        # in-flight signature batches: (block hash, BatchHandle)
+        inflight: list[tuple[bytes, object]] = []
+        MAX_INFLIGHT = 3
+
+        def settle_oldest():
+            h, handle = inflight.pop(0)
+            t0 = time.perf_counter()
+            ok = handle.result()
+            dt = time.perf_counter() - t0
+            stats["verify_s"] += dt
+            cs.bench["verify_ms"] += dt * 1e3
+            if not bool(np.all(ok)):
+                raise _NativeImportAbort(
+                    f"sig batch failed in block {hash_to_hex(h)[:16]}"
+                )
+
+        def settle_all():
+            while inflight:
+                settle_oldest()
+
+        def fast_flush():
+            settle_all()
+            t0 = time.perf_counter()
+            self.block_store.flush()
+            cs.flush_index()
+            best = eng.best()
+            self.coins_db.batch_write_serialized(eng.flush_entries(), best)
+            eng.clear()
+            # keep the Python cache's best-block in step: a later
+            # cs.flush() must not rewind the marker to its stale cached
+            # value (it survives CoinsCache.flush)
+            cs.coins.set_best_block(best)
+            dt = time.perf_counter() - t0
+            stats["flush_s"] += dt
+            cs.bench["flush_ms"] += dt * 1e3
+
+        def service_misses(missing_keys) -> int:
+            rows = self.coins_db.get_serialized_many(missing_keys)
+            for key, ser in rows.items():
+                r = ByteReader(ser)
+                from ..consensus.serialize import (
+                    deser_compact_size,
+                    deser_var_bytes,
+                )
+
+                code = deser_compact_size(r, range_check=False)
+                value = deser_compact_size(r, range_check=False)
+                spk = deser_var_bytes(r)
+                eng.insert(key, code, value, spk)
+            return len(rows)
+
+        def slow_path(raw: bytes, pos_info: Optional[tuple]) -> bool:
+            """Flush engine state, process via the Python engine, resync."""
+            stats["slow_path_blocks"] += 1
+            fast_flush()
+            block = CBlock.from_bytes(raw)
+            connected = try_process(block, pos_info)
+            cs.flush()
+            eng.set_best(cs.coins.best_block())
+            return connected
+
+        def try_process(block: CBlock, pos_info: Optional[tuple]) -> bool:
+            """The Python-engine leg (same parking semantics as the
+            reference loop below)."""
+            nonlocal n_imported
+            h = block.get_hash()
+            if pos_info is not None:
+                self.block_store.positions.setdefault(h, pos_info)
+            try:
+                self.chainstate.process_new_block(block)
+            except BlockValidationError as e:
+                if e.reason == "prev-blk-not-found":
+                    pending.setdefault(block.header.hash_prev_block,
+                                       []).append((block.serialize(),
+                                                   pos_info))
+                elif e.reason != "duplicate":
+                    log_printf("reindex: rejected %s: %s",
+                               hash_to_hex(h)[:16], e.reason)
+                return False
+            n_imported += 1
+            return True
+
+        def fast_connect(raw: bytes, h: bytes, prev, pos_info) -> bool:
+            """One linear-extension block through the native engine.
+            Returns False when the block must go through the Python path."""
+            nonlocal n_imported
+            header = CBlockHeader.deserialize(ByteReader(raw[:80]))
+            try:
+                cs.check_block_header(header)
+                cs.contextual_check_block_header(header, prev)
+            except BlockValidationError:
+                return False  # Python path gives the authoritative verdict
+            height = prev.height + 1
+            idx = CBlockIndex(header, h, prev)
+            check_scripts = (cs.script_checks_needed(idx)
+                             and cs.script_verifier is not None)
+            flags = block_script_flags(height, header.time, params)
+            if check_scripts and not (flags & SCRIPT_VERIFY_NULLFAIL):
+                return False  # pre-NULLFAIL: inline-verify via Python
+            bip34 = (script_int(height)
+                     if height >= consensus.bip34_height else None)
+            mtp = prev.get_median_time_past()
+            subsidy = get_block_subsidy(height, consensus)
+            t0 = time.perf_counter()
+            try:
+                try:
+                    res = eng.connect_block(
+                        raw, height, subsidy, params.max_block_size,
+                        consensus.coinbase_maturity, mtp, bip34, flags,
+                        want_sigs=check_scripts, commit=False)
+                except native.EngineMissing as miss:
+                    if service_misses(miss.keys) == 0:
+                        return False  # truly missing inputs: Python path
+                    res = eng.connect_block(
+                        raw, height, subsidy, params.max_block_size,
+                        consensus.coinbase_maturity, mtp, bip34, flags,
+                        want_sigs=check_scripts, commit=False)
+            except (native.EngineMissing, native.EngineError):
+                eng.abort()
+                return False
+            stats["native_connect_s"] += time.perf_counter() - t0
+            cs.bench["connect_ms"] += (time.perf_counter() - t0) * 1e3
+
+            # BIP30 base-store leg: only pre-BIP34 heights can mint
+            # duplicate txids (the engine checked its in-memory map; rows
+            # flushed out of it need the batched base lookup)
+            if height < consensus.bip34_height and res.n_tx:
+                keys = []
+                for i in range(res.n_tx):
+                    txid = res.txid(i)
+                    for o in range(int(res.tx_out_counts[i])):
+                        keys.append(txid + struct.pack("<I", o))
+                if self.coins_db.get_serialized_many(keys):
+                    eng.abort()
+                    return False  # Python path raises bad-txns-BIP30
+
+            handle = None
+            if check_scripts and res.n_inputs:
+                t0 = time.perf_counter()
+                status = res.sig_status
+                fast_idx = np.nonzero(status == 0)[0]
+                stats["fast_inputs"] += int(fast_idx.size)
+                ecdsa_batch.STATS.p2pkh_fast_path += int(fast_idx.size)
+                pub = res.sig_pub[fast_idx]
+                rs = res.sig_rs[fast_idx]
+                msg = res.sig_msg[fast_idx]
+                rn = res.sig_rn[fast_idx]
+                wrap = res.sig_wrap[fast_idx]
+                fb_idx = np.nonzero(status == 1)[0]
+                if fb_idx.size:
+                    # generic-script inputs: the Python interpreter is the
+                    # authority; its deferred records join the same batch
+                    stats["fallback_inputs"] += int(fb_idx.size)
+                    records = []
+                    tx_cache: dict[int, tuple] = {}
+                    spk_off = res.spent_spk_offsets
+                    try:
+                        for g in fb_idx:
+                            t_i, in_i = (int(res.sig_txin[g, 0]),
+                                         int(res.sig_txin[g, 1]))
+                            if t_i not in tx_cache:
+                                s, e_ = (int(res.tx_offsets[t_i, 0]),
+                                         int(res.tx_offsets[t_i, 1]))
+                                tx = CTransaction.from_bytes(raw[s:e_])
+                                tx_cache[t_i] = (tx, SighashCache(tx))
+                            tx, cache = tx_cache[t_i]
+                            spk = res.spent_spk_blob[
+                                int(spk_off[g]):int(spk_off[g + 1])]
+                            checker = DeferringSignatureChecker(
+                                tx, in_i, int(res.spent_values[g]),
+                                records, cache)
+                            VerifyScript(tx.vin[in_i].script_sig, spk,
+                                         flags, checker)
+                    except ScriptError:
+                        eng.abort()
+                        return False  # Python path re-derives the reject
+                    if records:
+                        epub, ers, emsg, ern, ewrap = (
+                            ecdsa_batch.records_to_blobs(records))
+                        pub = np.concatenate([pub, epub])
+                        rs = np.concatenate([rs, ers])
+                        msg = np.concatenate([msg, emsg])
+                        rn = np.concatenate([rn, ern])
+                        wrap = np.concatenate([wrap, ewrap])
+                if len(msg):
+                    handle = ecdsa_batch.dispatch_packed(
+                        pub, rs, msg, rn, wrap,
+                        backend=self.backend if self.backend == "cpu"
+                        else "auto")
+                dt = time.perf_counter() - t0
+                stats["verify_s"] += dt
+                cs.bench["verify_ms"] += dt * 1e3
+
+            eng.commit()
+            # -- Python bookkeeping (index, chain, stores) --
+            idx.n_tx = res.n_tx
+            cs._seq += 1
+            idx.sequence_id = cs._seq
+            idx.status |= BlockStatus.HAVE_DATA | BlockStatus.HAVE_UNDO
+            idx.raise_validity(
+                BlockStatus.VALID_SCRIPTS if check_scripts
+                else BlockStatus.VALID_CHAIN)
+            idx.chain_tx = prev.chain_tx + idx.n_tx
+            cs.block_index[h] = idx
+            cs._dirty_index.add(idx)
+            if pos_info is not None:
+                self.block_store.positions.setdefault(h, pos_info)
+            self.block_store.put_undo(h, res.undo)
+            cs.chain.set_tip(idx)
+            cs.bench["blocks"] += 1
+            if handle is not None:
+                inflight.append((h, handle))
+                if len(inflight) > MAX_INFLIGHT:
+                    settle_oldest()
+            n_imported += 1
+            stats["blocks"] += 1
+            return True
+
+        def process_raw(raw: bytes, pos_info: Optional[tuple]) -> bool:
+            h = sha256d_py(raw[:80])
+            idx = cs.block_index.get(h)
+            if idx is not None and (idx.status & BlockStatus.HAVE_DATA):
+                if pos_info is not None:
+                    self.block_store.positions.setdefault(h, pos_info)
+                return False  # duplicate
+            prev_hash = raw[4:36]
+            prev = cs.block_index.get(prev_hash)
+            if prev is None:
+                pending.setdefault(prev_hash, []).append((raw, pos_info))
+                return False
+            if prev is cs.chain.tip() and idx is None:
+                if fast_connect(raw, h, prev, pos_info):
+                    return True
+            return slow_path(raw, pos_info)
+
+        from ..crypto.hashes import sha256d as sha256d_py
+
+        # enumerate the store's own blk files (reindex source of truth)
+        n_file = 0
+        while True:
+            path = os.path.join(self.datadir, "blocks",
+                                f"blk{n_file:05d}.dat")
+            if not os.path.exists(path):
+                break
+            with open(path, "rb") as f:
+                data = f.read()
+            pos = 0
+            blocks_since_flush = 0
+            while pos + 8 <= len(data):
+                if data[pos:pos + 4] != magic:
+                    pos += 1
+                    continue
+                (size,) = struct.unpack_from("<I", data, pos + 4)
+                start = pos + 8
+                if start + size > len(data):
+                    break  # truncated tail record (crash mid-append)
+                raw = data[start:start + size]
+                pos_info = (n_file, start, size)
+                stats["bytes"] += size
+                if process_raw(raw, pos_info):
+                    # cascade children parked on this block
+                    queue = [sha256d_py(raw[:80])]
+                    while queue:
+                        hh = queue.pop()
+                        for c_raw, c_pos in pending.pop(hh, ()):
+                            if process_raw(c_raw, c_pos):
+                                queue.append(sha256d_py(c_raw[:80]))
+                pos = start + size
+                blocks_since_flush += 1
+                if (blocks_since_flush >= flush_interval
+                        or eng.mem_bytes() >= dbcache_bytes):
+                    fast_flush()
+                    blocks_since_flush = 0
+            n_file += 1
+
+        fast_flush()
+        cs.activate_best_chain()  # safety: settle any side-chain candidates
+        cs.flush()
+        eng.close()
+        stats["wall_s"] = time.perf_counter() - t_start
+        self.last_import_stats = stats
+        log_printf(
+            "native import: %d blocks (%d slow-path), %.1f MB in %.1fs "
+            "(connect %.1fs verify %.1fs flush %.1fs)",
+            n_imported, stats["slow_path_blocks"], stats["bytes"] / 1e6,
+            stats["wall_s"], stats["native_connect_s"], stats["verify_s"],
+            stats["flush_s"])
+        return n_imported
+
+    def _import_block_files_python(self, paths: Optional[list[str]] = None) -> int:
+        """The Python-engine import loop (reference implementation)."""
         import struct
 
         magic = self.params.netmagic
@@ -518,17 +910,25 @@ class Node:
                     queue.append(child.get_hash())
             return True
 
+        # (path, store file number | None). Scanning the store's OWN blk
+        # files re-registers positions in place; re-appending each block
+        # via put_block would double the on-disk chain every -reindex.
+        # Explicit -loadblock files are foreign: those DO append.
+        file_list: list[tuple[str, Optional[int]]]
         if paths is None:
-            paths = []
+            file_list = []
             n_file = 0
             while True:
                 p = os.path.join(self.datadir, "blocks",
                                  f"blk{n_file:05d}.dat")
                 if not os.path.exists(p):
                     break
-                paths.append(p)
+                file_list.append((p, n_file))
                 n_file += 1
-        for path in paths:
+        else:
+            file_list = [(p, None) for p in paths]
+        positions = getattr(self.block_store, "positions", None)
+        for path, n_file in file_list:
             if not os.path.exists(path):
                 log_printf("loadblock: %s not found, skipping", path)
                 continue
@@ -548,6 +948,9 @@ class Node:
                 except Exception:
                     pos += 1
                     continue
+                if n_file is not None and positions is not None:
+                    positions.setdefault(block.get_hash(),
+                                         (n_file, start, size))
                 try_process(block)
                 pos = start + size
         self.chainstate.flush()
@@ -760,6 +1163,21 @@ class Node:
                         lambda block, idx: self._walletnotify(notify, block)
                     )
                 self._wallet_ready.set()
+            except BaseException:
+                # a failed load (corrupt wallet.json, rescan error) must
+                # not leave self.wallet half-set with _wallet_ready never
+                # signaled — every later wallet RPC would spin in the wait
+                # loop forever (ADVICE r4). Reset so a retry can load.
+                bad = self.wallet
+                self.wallet = None
+                if bad is not None:
+                    for lst in (self.chainstate.on_block_connected,
+                                self.chainstate.on_block_disconnected):
+                        for cb in (bad.block_connected,
+                                   bad.block_disconnected):
+                            if cb in lst:
+                                lst.remove(cb)
+                raise
             finally:
                 self._wallet_loader = None
             return self.wallet

@@ -72,6 +72,18 @@ class BatchStats:
 STATS = BatchStats()
 
 
+def _note_device_dispatch(n: int, bucket: int) -> None:
+    """Shared per-dispatch STATS bookkeeping (record-level and packed
+    entry points must never diverge on the gettpuinfo counters)."""
+    STATS.dispatches += 1
+    STATS.sigs_verified += n
+    STATS.sigs_padded += bucket - n
+    STATS.last_batch = n
+    STATS.buckets_used[bucket] = STATS.buckets_used.get(bucket, 0) + 1
+    STATS.in_flight += 1
+    STATS.max_in_flight = max(STATS.max_in_flight, STATS.in_flight)
+
+
 def _bucket_for(n: int, pallas: bool = False) -> int:
     if pallas and n > 128:
         # w4-bytes program buckets: {1024, 2048, 4096} then 2048-granular
@@ -377,13 +389,7 @@ def dispatch_batch(records: Sequence, backend: str = "auto") -> BatchHandle:
         bucket = _bucket_for(len(records), pallas=False)
         arrays = pack_records(records, bucket)
         device_ok = dev.ecdsa_verify_batch_jit(*map(np.asarray, arrays))
-    STATS.dispatches += 1
-    STATS.sigs_verified += len(records)
-    STATS.sigs_padded += bucket - len(records)
-    STATS.last_batch = len(records)
-    STATS.buckets_used[bucket] = STATS.buckets_used.get(bucket, 0) + 1
-    STATS.in_flight += 1
-    STATS.max_in_flight = max(STATS.max_in_flight, STATS.in_flight)
+    _note_device_dispatch(len(records), bucket)
     return BatchHandle(len(records), bucket, device_ok,
                        degen=degen, records=records if degen is not None
                        else None)
@@ -431,3 +437,129 @@ def _note_pallas_failure(e: Exception) -> None:
 def verify_batch(records: Sequence, backend: str = "auto") -> np.ndarray:
     """Verify all records synchronously; returns (len(records),) bool."""
     return dispatch_batch(records, backend).result()
+
+
+# ---------------------------------------------------------------------------
+# Blob-level dispatch — the native connect engine's sigscan
+# (native/connect.cpp) emits (pub64, r||s, msg, rn, wrap) byte blobs; this
+# entry feeds them straight into the w4-bytes device program (or the native
+# threaded CPU verify) with zero per-record Python-int work. The record-level
+# dispatch_batch above remains the generic path (script interpreter output).
+# ---------------------------------------------------------------------------
+
+class _LazyRecords:
+    """SigCheckRecord view over packed blobs, materialized per index — only
+    degenerate-lane rechecks (rare) ever touch it."""
+
+    __slots__ = ("pub", "rs", "msg")
+
+    def __init__(self, pub: np.ndarray, rs: np.ndarray, msg: np.ndarray):
+        self.pub = pub
+        self.rs = rs
+        self.msg = msg
+
+    def __getitem__(self, i: int):
+        from ..script.interpreter import SigCheckRecord
+
+        pub = self.pub[i].tobytes()
+        rs = self.rs[i].tobytes()
+        return SigCheckRecord(
+            (int.from_bytes(pub[:32], "big"), int.from_bytes(pub[32:], "big")),
+            int.from_bytes(rs[:32], "big"), int.from_bytes(rs[32:], "big"),
+            int.from_bytes(self.msg[i].tobytes(), "big"),
+        )
+
+
+def records_to_blobs(records: Sequence):
+    """Pack script-interpreter SigCheckRecords into the blob layout so the
+    occasional generic-path record can join a packed dispatch. Also emits
+    rn/wrap (the x-wraparound candidate gate)."""
+    n = len(records)
+    pub = np.frombuffer(
+        b"".join(r.pubkey[0].to_bytes(32, "big") + r.pubkey[1].to_bytes(32, "big")
+                 for r in records), np.uint8).reshape(n, 64)
+    rs = np.frombuffer(
+        b"".join((r.r % (1 << 256)).to_bytes(32, "big")
+                 + (r.s % (1 << 256)).to_bytes(32, "big")
+                 for r in records), np.uint8).reshape(n, 64)
+    msg = np.frombuffer(
+        b"".join((r.msg_hash % (1 << 256)).to_bytes(32, "big")
+                 for r in records), np.uint8).reshape(n, 32)
+    wraps = [r.r + oracle.N < oracle.P for r in records]
+    rn = np.frombuffer(
+        b"".join((r.r + oracle.N if w else r.r).to_bytes(32, "big")
+                 for r, w in zip(records, wraps)), np.uint8).reshape(n, 32)
+    return pub, rs, msg, rn, np.asarray(wraps, np.uint8)
+
+
+# below this lane count the device round trip loses to the threaded native
+# CPU verify even on real hardware (dispatch+transfer latency)
+PACKED_DEVICE_FLOOR = 512
+
+
+def dispatch_packed(pub: np.ndarray, rs: np.ndarray, msg: np.ndarray,
+                    rn: np.ndarray, wrap: np.ndarray,
+                    backend: str = "auto") -> BatchHandle:
+    """Enqueue a packed verify batch: pub (n,64), rs (n,64), msg (n,32),
+    rn (n,32), wrap (n,) — all uint8, big-endian fields, caller-validated
+    ranges (1 <= r,s < N; pubkey on-curve affine)."""
+    from .. import native
+
+    n = len(msg)
+    if n == 0:
+        return BatchHandle(0, cpu_ok=np.zeros(0, bool))
+    use_device = backend == "device" or (
+        backend == "auto" and n >= PACKED_DEVICE_FLOOR and _device_available()
+    )
+    if not use_device and native.available():
+        STATS.cpu_fallback_sigs += n
+        ok = native.ecdsa_verify_batch_blobs(
+            pub.tobytes(), rs.tobytes(), msg.tobytes(), n)
+        return BatchHandle(n, cpu_ok=np.asarray(ok, bool))
+    if not (use_device and pallas_enabled()):
+        # XLA fallback (pallas broken / no native lib): go through the
+        # record-level path — rare, and it keeps one source of truth
+        recs = _LazyRecords(pub, rs, msg)
+        return dispatch_batch([recs[i] for i in range(n)], backend=backend)
+
+    from . import secp256k1 as dev
+
+    bucket = max(1024, _bucket_for(n, pallas=True))
+
+    def pad(mat: np.ndarray, width: int) -> np.ndarray:
+        out = np.zeros((bucket, width), np.uint8)
+        out[:n] = mat
+        return out
+
+    # u1/u2 via the threaded native modular-inverse leg; Python-int loop
+    # only if the native library is missing
+    if native.available():
+        u1_blob, u2_blob, ok = native.ecdsa_precompute_blobs(
+            rs.tobytes(), msg.tobytes(), n)
+        u1 = np.frombuffer(u1_blob, np.uint8).reshape(n, 32)
+        u2 = np.frombuffer(u2_blob, np.uint8).reshape(n, 32)
+        range_bad = ~np.asarray(ok, bool)
+    else:
+        recs = _LazyRecords(pub, rs, msg)
+        scalars = decompose_scalars([recs[i] for i in range(n)])
+        u1 = np.frombuffer(b"".join(a.to_bytes(32, "big") for a, _ in scalars),
+                           np.uint8).reshape(n, 32)
+        u2 = np.frombuffer(b"".join(b.to_bytes(32, "big") for _, b in scalars),
+                           np.uint8).reshape(n, 32)
+        range_bad = np.zeros(n, bool)
+    q_inf = np.ones(bucket, np.uint8)
+    q_inf[:n] = range_bad.astype(np.uint8)
+    wrap8 = np.zeros(bucket, np.uint8)
+    wrap8[:n] = wrap
+    try:
+        device_ok, degen = dev.ecdsa_verify_batch_pallas_w4_bytes(
+            pad(u1, 32), pad(u2, 32), pad(pub[:, :32], 32),
+            pad(pub[:, 32:], 32), q_inf, pad(rs[:, :32], 32),
+            pad(rn, 32), wrap8)
+    except Exception as e:
+        _note_pallas_failure(e)
+        recs = _LazyRecords(pub, rs, msg)
+        return dispatch_batch([recs[i] for i in range(n)], backend=backend)
+    _note_device_dispatch(n, bucket)
+    return BatchHandle(n, bucket, device_ok, degen=degen,
+                       records=_LazyRecords(pub, rs, msg))

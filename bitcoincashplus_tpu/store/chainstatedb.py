@@ -62,6 +62,29 @@ class CoinsDB(CoinsView):
     def count_coins(self) -> int:
         return sum(1 for _ in self.kv.iterate(_COIN))
 
+    # -- raw-key entry points for the native connect engine --------------
+    # (native/connect.cpp speaks 36-byte outpoint keys + Coin.serialize
+    # blobs; these avoid a COutPoint/Coin object round trip per row)
+
+    def get_serialized_many(self, keys36: list[bytes]) -> dict[bytes, bytes]:
+        """{key36: coin_serialization} for present rows (miss servicing)."""
+        rows = self.kv.get_many([_COIN + k for k in keys36])
+        return {k[1:]: v for k, v in rows.items()}
+
+    def batch_write_serialized(self, entries, best_block: bytes) -> None:
+        """entries: iterable of (key36, coin_ser | None-for-delete); one
+        transaction with the best-block marker, same crash-consistency
+        unit as batch_write."""
+        puts: dict[bytes, bytes] = {}
+        deletes: list[bytes] = []
+        for k, ser in entries:
+            if ser is None:
+                deletes.append(_COIN + k)
+            else:
+                puts[_COIN + k] = ser
+        puts[_BEST] = best_block
+        self.kv.write_batch(puts, deletes, sync=True)
+
 
 class BlockIndexDB:
     """CBlockTreeDB — headers + file positions + flags, enough to rebuild

@@ -37,14 +37,31 @@ class KVStore:
         row = self._db.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
         return row[0] if row else None
 
+    def get_many(self, keys: list[bytes]) -> dict[bytes, bytes]:
+        """Present rows for ``keys`` in one query per 500 keys (sqlite's
+        bound-parameter limit is 999) — the block-import miss-fetch path."""
+        out: dict[bytes, bytes] = {}
+        for i in range(0, len(keys), 500):
+            chunk = keys[i:i + 500]
+            q = ("SELECT k, v FROM kv WHERE k IN (%s)"
+                 % ",".join("?" * len(chunk)))
+            for k, v in self._db.execute(q, chunk):
+                out[k] = v
+        return out
+
     def put(self, key: bytes, value: bytes) -> None:
-        self._db.execute(
-            "INSERT INTO kv (k, v) VALUES (?, ?) ON CONFLICT(k) DO UPDATE SET v=excluded.v",
-            (key, value),
-        )
+        # under the write lock: a lone put during another thread's open
+        # BEGIN would otherwise join (and possibly roll back with) that
+        # transaction on this shared connection — ADVICE r4
+        with self._write_lock:
+            self._db.execute(
+                "INSERT INTO kv (k, v) VALUES (?, ?) ON CONFLICT(k) DO UPDATE SET v=excluded.v",
+                (key, value),
+            )
 
     def delete(self, key: bytes) -> None:
-        self._db.execute("DELETE FROM kv WHERE k = ?", (key,))
+        with self._write_lock:
+            self._db.execute("DELETE FROM kv WHERE k = ?", (key,))
 
     def exists(self, key: bytes) -> bool:
         return self.get(key) is not None

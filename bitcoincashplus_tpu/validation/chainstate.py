@@ -684,6 +684,14 @@ class ChainstateManager:
         blocks are re-activated from their on-disk data."""
         t0 = _time.perf_counter()
         self.block_store.flush()
+        self.flush_index()
+        self.coins.flush()
+        self.bench["index_ms"] += (_time.perf_counter() - t0) * 1e3
+
+    def flush_index(self) -> None:
+        """Step (2) of the flush contract alone: batch-write dirty block
+        index entries. The native fast-import path orders its own coins
+        batch after this (node.py _fast_flush)."""
         if self.index_db is not None and self._dirty_index:
             positions = getattr(self.block_store, "positions", {})
             undo_positions = getattr(self.block_store, "undo_positions", {})
@@ -701,8 +709,6 @@ class ChainstateManager:
             ]
             self.index_db.put_index_batch(entries)
             self._dirty_index.clear()
-        self.coins.flush()
-        self.bench["index_ms"] += (_time.perf_counter() - t0) * 1e3
 
     # -- queries used by RPC / mining --
 

@@ -1,0 +1,306 @@
+// Shared native-runtime primitives: SHA-256 / SHA-256d, RIPEMD-160 and the
+// bounds-checked wire reader. Header-only so each TU (bcp_native.cpp,
+// connect.cpp) can use them without a separate link step — the Makefile
+// compiles every .cpp straight into libbcpnative.so.
+//
+// Reference lineage: src/crypto/sha256.cpp, src/crypto/ripemd160.cpp,
+// src/serialize.h (ReadCompactSize). Consensus behavior (canonical
+// CompactSize, MAX_SIZE bound) mirrors consensus/serialize.py, the Python
+// reference implementation in this repo.
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace bcpn {
+
+// ---------------------------------------------------------------------------
+// SHA-256 (FIPS-180-4)
+// ---------------------------------------------------------------------------
+
+static const uint32_t SHA256_K[64] = {
+    0x428a2f98,0x71374491,0xb5c0fbcf,0xe9b5dba5,0x3956c25b,0x59f111f1,
+    0x923f82a4,0xab1c5ed5,0xd807aa98,0x12835b01,0x243185be,0x550c7dc3,
+    0x72be5d74,0x80deb1fe,0x9bdc06a7,0xc19bf174,0xe49b69c1,0xefbe4786,
+    0x0fc19dc6,0x240ca1cc,0x2de92c6f,0x4a7484aa,0x5cb0a9dc,0x76f988da,
+    0x983e5152,0xa831c66d,0xb00327c8,0xbf597fc7,0xc6e00bf3,0xd5a79147,
+    0x06ca6351,0x14292967,0x27b70a85,0x2e1b2138,0x4d2c6dfc,0x53380d13,
+    0x650a7354,0x766a0abb,0x81c2c92e,0x92722c85,0xa2bfe8a1,0xa81a664b,
+    0xc24b8b70,0xc76c51a3,0xd192e819,0xd6990624,0xf40e3585,0x106aa070,
+    0x19a4c116,0x1e376c08,0x2748774c,0x34b0bcb5,0x391c0cb3,0x4ed8aa4a,
+    0x5b9cca4f,0x682e6ff3,0x748f82ee,0x78a5636f,0x84c87814,0x8cc70208,
+    0x90befffa,0xa4506ceb,0xbef9a3f7,0xc67178f2,
+};
+
+inline uint32_t rotr32(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+}
+
+struct Sha256 {
+    uint32_t h[8];
+    uint8_t buf[64];
+    uint64_t total = 0;
+    size_t fill = 0;
+
+    Sha256() {
+        static const uint32_t init[8] = {
+            0x6a09e667,0xbb67ae85,0x3c6ef372,0xa54ff53a,
+            0x510e527f,0x9b05688c,0x1f83d9ab,0x5be0cd19,
+        };
+        memcpy(h, init, sizeof(h));
+    }
+
+    void transform(const uint8_t* p) {
+        uint32_t w[64];
+        for (int i = 0; i < 16; i++)
+            w[i] = (uint32_t(p[4*i]) << 24) | (uint32_t(p[4*i+1]) << 16)
+                 | (uint32_t(p[4*i+2]) << 8) | uint32_t(p[4*i+3]);
+        for (int i = 16; i < 64; i++) {
+            uint32_t s0 = rotr32(w[i-15],7) ^ rotr32(w[i-15],18) ^ (w[i-15] >> 3);
+            uint32_t s1 = rotr32(w[i-2],17) ^ rotr32(w[i-2],19) ^ (w[i-2] >> 10);
+            w[i] = w[i-16] + s0 + w[i-7] + s1;
+        }
+        uint32_t a=h[0],b=h[1],c=h[2],d=h[3],e=h[4],f=h[5],g=h[6],hh=h[7];
+        for (int i = 0; i < 64; i++) {
+            uint32_t S1 = rotr32(e,6) ^ rotr32(e,11) ^ rotr32(e,25);
+            uint32_t ch = (e & f) ^ (~e & g);
+            uint32_t t1 = hh + S1 + ch + SHA256_K[i] + w[i];
+            uint32_t S0 = rotr32(a,2) ^ rotr32(a,13) ^ rotr32(a,22);
+            uint32_t mj = (a & b) ^ (a & c) ^ (b & c);
+            uint32_t t2 = S0 + mj;
+            hh=g; g=f; f=e; e=d+t1; d=c; c=b; b=a; a=t1+t2;
+        }
+        h[0]+=a; h[1]+=b; h[2]+=c; h[3]+=d; h[4]+=e; h[5]+=f; h[6]+=g; h[7]+=hh;
+    }
+
+    void update(const uint8_t* data, size_t len) {
+        total += len;
+        if (fill) {
+            size_t take = 64 - fill;
+            if (take > len) take = len;
+            memcpy(buf + fill, data, take);
+            fill += take; data += take; len -= take;
+            if (fill == 64) { transform(buf); fill = 0; }
+        }
+        while (len >= 64) { transform(data); data += 64; len -= 64; }
+        if (len) { memcpy(buf, data, len); fill = len; }
+    }
+
+    void final(uint8_t out[32]) {
+        uint64_t bits = total * 8;
+        uint8_t pad = 0x80;
+        update(&pad, 1);
+        uint8_t z = 0;
+        while (fill != 56) update(&z, 1);
+        uint8_t lenb[8];
+        for (int i = 0; i < 8; i++) lenb[i] = uint8_t(bits >> (56 - 8*i));
+        update(lenb, 8);
+        for (int i = 0; i < 8; i++) {
+            out[4*i]   = uint8_t(h[i] >> 24);
+            out[4*i+1] = uint8_t(h[i] >> 16);
+            out[4*i+2] = uint8_t(h[i] >> 8);
+            out[4*i+3] = uint8_t(h[i]);
+        }
+    }
+};
+
+inline void sha256(const uint8_t* data, size_t len, uint8_t out[32]) {
+    Sha256 a; a.update(data, len); a.final(out);
+}
+
+inline void sha256d(const uint8_t* data, size_t len, uint8_t out[32]) {
+    uint8_t mid[32];
+    Sha256 a; a.update(data, len); a.final(mid);
+    Sha256 b; b.update(mid, 32); b.final(out);
+}
+
+// ---------------------------------------------------------------------------
+// RIPEMD-160 (for HASH160 = RIPEMD160(SHA256(x)) — script P2PKH matching)
+// ---------------------------------------------------------------------------
+
+struct Ripemd160 {
+    uint32_t h[5] = {0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0};
+    uint8_t buf[64];
+    uint64_t total = 0;
+    size_t fill = 0;
+
+    static uint32_t rol(uint32_t x, int n) { return (x << n) | (x >> (32 - n)); }
+    static uint32_t f1(uint32_t x, uint32_t y, uint32_t z) { return x ^ y ^ z; }
+    static uint32_t f2(uint32_t x, uint32_t y, uint32_t z) { return (x & y) | (~x & z); }
+    static uint32_t f3(uint32_t x, uint32_t y, uint32_t z) { return (x | ~y) ^ z; }
+    static uint32_t f4(uint32_t x, uint32_t y, uint32_t z) { return (x & z) | (y & ~z); }
+    static uint32_t f5(uint32_t x, uint32_t y, uint32_t z) { return x ^ (y | ~z); }
+
+    void transform(const uint8_t* p) {
+        static const int R1[80] = {
+            0,1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,
+            7,4,13,1,10,6,15,3,12,0,9,5,2,14,11,8,
+            3,10,14,4,9,15,8,1,2,7,0,6,13,11,5,12,
+            1,9,11,10,0,8,12,4,13,3,7,15,14,5,6,2,
+            4,0,5,9,7,12,2,10,14,1,3,8,11,6,15,13};
+        static const int R2[80] = {
+            5,14,7,0,9,2,11,4,13,6,15,8,1,10,3,12,
+            6,11,3,7,0,13,5,10,14,15,8,12,4,9,1,2,
+            15,5,1,3,7,14,6,9,11,8,12,2,10,0,4,13,
+            8,6,4,1,3,11,15,0,5,12,2,13,9,7,10,14,
+            12,15,10,4,1,5,8,7,6,2,13,14,0,3,9,11};
+        static const int S1[80] = {
+            11,14,15,12,5,8,7,9,11,13,14,15,6,7,9,8,
+            7,6,8,13,11,9,7,15,7,12,15,9,11,7,13,12,
+            11,13,6,7,14,9,13,15,14,8,13,6,5,12,7,5,
+            11,12,14,15,14,15,9,8,9,14,5,6,8,6,5,12,
+            9,15,5,11,6,8,13,12,5,12,13,14,11,8,5,6};
+        static const int S2[80] = {
+            8,9,9,11,13,15,15,5,7,7,8,11,14,14,12,6,
+            9,13,15,7,12,8,9,11,7,7,12,7,6,15,13,11,
+            9,7,15,11,8,6,6,14,12,13,5,14,13,13,7,5,
+            15,5,8,11,14,14,6,14,6,9,12,9,12,5,15,8,
+            8,5,12,9,12,5,14,6,8,13,6,5,15,13,11,11};
+        static const uint32_t K1[5] = {0, 0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC, 0xA953FD4E};
+        static const uint32_t K2[5] = {0x50A28BE6, 0x5C4DD124, 0x6D703EF3, 0x7A6D76E9, 0};
+        uint32_t x[16];
+        for (int i = 0; i < 16; i++)
+            x[i] = uint32_t(p[4*i]) | (uint32_t(p[4*i+1]) << 8)
+                 | (uint32_t(p[4*i+2]) << 16) | (uint32_t(p[4*i+3]) << 24);
+        uint32_t a1=h[0],b1=h[1],c1=h[2],d1=h[3],e1=h[4];
+        uint32_t a2=h[0],b2=h[1],c2=h[2],d2=h[3],e2=h[4];
+        for (int j = 0; j < 80; j++) {
+            int rd = j / 16;
+            uint32_t f, g;
+            switch (rd) {
+                case 0: f = f1(b1,c1,d1); g = f5(b2,c2,d2); break;
+                case 1: f = f2(b1,c1,d1); g = f4(b2,c2,d2); break;
+                case 2: f = f3(b1,c1,d1); g = f3(b2,c2,d2); break;
+                case 3: f = f4(b1,c1,d1); g = f2(b2,c2,d2); break;
+                default: f = f5(b1,c1,d1); g = f1(b2,c2,d2); break;
+            }
+            uint32_t t = rol(a1 + f + x[R1[j]] + K1[rd], S1[j]) + e1;
+            a1 = e1; e1 = d1; d1 = rol(c1, 10); c1 = b1; b1 = t;
+            t = rol(a2 + g + x[R2[j]] + K2[rd], S2[j]) + e2;
+            a2 = e2; e2 = d2; d2 = rol(c2, 10); c2 = b2; b2 = t;
+        }
+        uint32_t t = h[1] + c1 + d2;
+        h[1] = h[2] + d1 + e2;
+        h[2] = h[3] + e1 + a2;
+        h[3] = h[4] + a1 + b2;
+        h[4] = h[0] + b1 + c2;
+        h[0] = t;
+    }
+
+    void update(const uint8_t* data, size_t len) {
+        total += len;
+        if (fill) {
+            size_t take = 64 - fill;
+            if (take > len) take = len;
+            memcpy(buf + fill, data, take);
+            fill += take; data += take; len -= take;
+            if (fill == 64) { transform(buf); fill = 0; }
+        }
+        while (len >= 64) { transform(data); data += 64; len -= 64; }
+        if (len) { memcpy(buf, data, len); fill = len; }
+    }
+
+    void final(uint8_t out[20]) {
+        uint64_t bits = total * 8;
+        uint8_t pad = 0x80;
+        update(&pad, 1);
+        uint8_t z = 0;
+        while (fill != 56) update(&z, 1);
+        uint8_t lenb[8];
+        for (int i = 0; i < 8; i++) lenb[i] = uint8_t(bits >> (8 * i));
+        update(lenb, 8);
+        for (int i = 0; i < 5; i++) {
+            out[4*i]   = uint8_t(h[i]);
+            out[4*i+1] = uint8_t(h[i] >> 8);
+            out[4*i+2] = uint8_t(h[i] >> 16);
+            out[4*i+3] = uint8_t(h[i] >> 24);
+        }
+    }
+};
+
+inline void hash160(const uint8_t* data, size_t len, uint8_t out[20]) {
+    uint8_t mid[32];
+    sha256(data, len, mid);
+    Ripemd160 r; r.update(mid, 32); r.final(out);
+}
+
+// ---------------------------------------------------------------------------
+// Bounds-checked wire reader (CompactSize canonical per serialize.py)
+// ---------------------------------------------------------------------------
+
+constexpr uint64_t MAX_WIRE_SIZE = 0x02000000;  // serialize.py MAX_SIZE
+
+struct WireReader {
+    const uint8_t* p;
+    size_t len, pos = 0;
+
+    bool skip(size_t n) {
+        if (len - pos < n) return false;
+        pos += n;
+        return true;
+    }
+    bool u8(uint8_t* out) {
+        if (pos >= len) return false;
+        *out = p[pos++];
+        return true;
+    }
+    bool u32(uint32_t* out) {
+        if (len - pos < 4) return false;
+        memcpy(out, p + pos, 4);  // little-endian hosts only
+        pos += 4;
+        return true;
+    }
+    bool i64(int64_t* out) {
+        if (len - pos < 8) return false;
+        memcpy(out, p + pos, 8);
+        pos += 8;
+        return true;
+    }
+    // Canonical CompactSize with the MAX_SIZE range check, exactly as
+    // deser_compact_size(range_check=True) enforces.
+    bool compact(uint64_t* out) {
+        uint8_t tag;
+        if (!u8(&tag)) return false;
+        uint64_t v;
+        if (tag < 253) {
+            v = tag;
+        } else {
+            size_t n = tag == 253 ? 2 : tag == 254 ? 4 : 8;
+            if (len - pos < n) return false;
+            v = 0;
+            for (size_t i = 0; i < n; i++) v |= uint64_t(p[pos + i]) << (8 * i);
+            pos += n;
+            if (tag == 253 && v < 253) return false;          // non-canonical
+            if (tag == 254 && v < 0x10000) return false;
+            if (tag == 255 && v < 0x100000000ULL) return false;
+        }
+        if (v > MAX_WIRE_SIZE) return false;
+        *out = v;
+        return true;
+    }
+};
+
+// ---------------------------------------------------------------------------
+// CompactSize writer (for undo/coin serialization byte-identical to
+// consensus/serialize.py ser_compact_size)
+// ---------------------------------------------------------------------------
+
+template <class Vec>
+inline void put_compact(Vec& out, uint64_t n) {
+    if (n < 253) {
+        out.push_back(uint8_t(n));
+    } else if (n <= 0xFFFF) {
+        out.push_back(0xFD);
+        out.push_back(uint8_t(n)); out.push_back(uint8_t(n >> 8));
+    } else if (n <= 0xFFFFFFFFULL) {
+        out.push_back(0xFE);
+        for (int i = 0; i < 4; i++) out.push_back(uint8_t(n >> (8 * i)));
+    } else {
+        out.push_back(0xFF);
+        for (int i = 0; i < 8; i++) out.push_back(uint8_t(n >> (8 * i)));
+    }
+}
+
+}  // namespace bcpn
