@@ -31,6 +31,7 @@ Differentially tested against crypto/secp256k1.py (the Python-int oracle).
 from __future__ import annotations
 
 import os
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -836,8 +837,9 @@ def _verify_kernel_w4_3d(u1w_ref, u2w_ref, qx_ref, qy_ref, qinf_ref, r0_ref,
     )
 
 
-@jax.jit
-def _w4_bytes_program(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8):
+@partial(jax.jit, static_argnames=("interpret",))
+def _w4_bytes_program(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8,
+                      interpret: bool = False):
     """The production w4 pipeline, ONE dispatch end-to-end: byte-matrix
     inputs ((B, 32) uint8 per 256-bit field — 1.7 MB per 10k sigs vs
     8.5 MB of pre-expanded u32 planes, which matters through a serving
@@ -879,13 +881,15 @@ def _w4_bytes_program(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8):
                   bs(N_LIMBS), bs(N_LIMBS), bs(1)],
         out_specs=bs(2),
         out_shape=jax.ShapeDtypeStruct((2, 8, T), jnp.uint32),
+        interpret=interpret,  # CPU meshes (sig_shard virtual-8) have no
+        # Mosaic; interpret lowers the same kernel to plain XLA ops
     )
     return call(windows(u1m), windows(u2m), limbs(qxb), limbs(qyb), q2,
                 limbs(r0b), limbs(rnb), w2)
 
 
 def ecdsa_verify_batch_pallas_w4_bytes(u1m, u2m, qxb, qyb, q_inf8, r0b,
-                                       rnb, wrap8):
+                                       rnb, wrap8, interpret: bool = False):
     """Byte-matrix w4 verify (see _w4_bytes_program). B must be a multiple
     of 1024; batches beyond 16384 are split into 16384-lane program calls
     so compiled shapes stay the bounded set {1024, 2048, 4096, then
@@ -897,7 +901,8 @@ def ecdsa_verify_batch_pallas_w4_bytes(u1m, u2m, qxb, qyb, q_inf8, r0b,
     assert B % 1024 == 0, B
     SPLIT = 16384
     if B <= SPLIT:
-        out = _w4_bytes_program(u1m, u2m, qxb, qyb, q_inf8, r0b, rnb, wrap8)
+        out = _w4_bytes_program(u1m, u2m, qxb, qyb, q_inf8, r0b, rnb, wrap8,
+                                interpret=interpret)
         return (out[0].reshape(B).astype(bool),
                 out[1].reshape(B).astype(bool))
     oks, dgs = [], []
@@ -905,7 +910,8 @@ def ecdsa_verify_batch_pallas_w4_bytes(u1m, u2m, qxb, qyb, q_inf8, r0b,
         sl = slice(s, s + SPLIT)
         n = min(SPLIT, B - s)
         out = _w4_bytes_program(u1m[sl], u2m[sl], qxb[sl], qyb[sl],
-                                q_inf8[sl], r0b[sl], rnb[sl], wrap8[sl])
+                                q_inf8[sl], r0b[sl], rnb[sl], wrap8[sl],
+                                interpret=interpret)
         oks.append(out[0].reshape(n))
         dgs.append(out[1].reshape(n))
     return (jnp.concatenate(oks).astype(bool),

@@ -1,12 +1,19 @@
 """Multi-chip ECDSA batch sharding (P1 in SURVEY.md §3.2).
 
 The signature-batch axis is embarrassingly parallel: shard the B lanes of
-ops/secp256k1.ecdsa_verify_batch_device across the ('chip',) mesh with
-shard_map — each chip verifies B/n_chips lanes, the per-lane validity mask
-gathers back over ICI (out_spec P('chip')), and a psum'd failure count
-gives the block-level verdict without materializing the mask on host
-first. This is the 8-chip scale-out of the CCheckQueue replacement: the
-reference's `-par=N` worker threads become mesh shards.
+the PRODUCTION w=4 windowed Pallas pipeline (ops/secp256k1._w4_bytes_program
+— the same kernel behind bench config 4) across the ('chip',) mesh with
+shard_map. Inputs are the byte matrices ((B, 32) uint8 per field) sharded on
+the batch axis; each chip expands its shard to window planes / 13-bit limbs
+on device and runs the Pallas grid locally; the per-lane validity mask
+gathers back over ICI, and a psum'd failure count gives the block-level
+verdict without a host round trip. This is the 8-chip scale-out of the
+CCheckQueue replacement: the reference's `-par=N` worker threads become mesh
+shards.
+
+On CPU meshes (the virtual-8 dryrun/bench — no Mosaic backend) the same
+kernel runs in pallas interpret mode, so the sharded program is the real
+w4 pipeline everywhere, not a stand-in ladder (VERDICT r4 #3/weak-3).
 """
 
 from __future__ import annotations
@@ -19,53 +26,83 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ..ops.secp256k1 import ecdsa_verify_batch_device
+from ..ops.secp256k1 import _w4_bytes_program
 from .mesh import CHIP_AXIS, chip_mesh
 
+# per-chip lane granularity: the w4 bytes program reshapes its local batch
+# to (8, T) vregs with T a multiple of 128
+_CHIP_BUCKET = 1024
 
-@partial(jax.jit, static_argnames=("n_chips",))
-def _sharded_verify_jit(u1b, u2b, qx, qy, q_inf, r0, rn, wrap_ok,
-                        n_chips: int):
+
+def _use_interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+@partial(jax.jit, static_argnames=("n_chips", "interpret"))
+def _sharded_w4_jit(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8,
+                    n_chips: int, interpret: bool):
     mesh = chip_mesh(n_chips)
-    lane = P(None, CHIP_AXIS)  # (256,B) / (20,B): shard the batch axis
+    row = P(CHIP_AXIS)  # (B, 32) byte matrices: shard the batch axis
 
-    def body(u1b, u2b, qx, qy, q_inf, r0, rn, wrap_ok):
-        ok = ecdsa_verify_batch_device(
-            u1b, u2b, qx, qy, q_inf, r0, rn, wrap_ok
-        )
-        # block verdict: total failures among real (non-poisoned... the
-        # caller masks padding) lanes, reduced over ICI
+    def body(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8):
+        out = _w4_bytes_program(u1m, u2m, qxb, qyb, qinf8, r0b, rnb,
+                                wrap8, interpret=interpret)
+        b_local = u1m.shape[0]
+        ok = out[0].reshape(b_local).astype(bool)
+        degen = out[1].reshape(b_local).astype(bool)
+        # block verdict: total failures among real (non-poisoned) lanes,
+        # reduced over ICI (degenerate lanes settle on host; count them
+        # as failures here so the fast verdict stays conservative)
         fails = jax.lax.psum(
-            jnp.sum((~ok & ~q_inf).astype(jnp.uint32)), CHIP_AXIS
+            jnp.sum(((~ok | degen) & (qinf8 == 0)).astype(jnp.uint32)),
+            CHIP_AXIS,
         )
-        return ok, fails
+        return ok, degen, fails
 
     fn = shard_map(
         body,
         mesh=mesh,
-        in_specs=(lane, lane, lane, lane, P(CHIP_AXIS), lane, lane,
-                  P(CHIP_AXIS)),
-        out_specs=(P(CHIP_AXIS), P()),
+        in_specs=(row,) * 8,
+        out_specs=(P(CHIP_AXIS), P(CHIP_AXIS), P()),
+        # pallas_call's out_shape carries no varying-mesh-axes annotation;
+        # the specs above state the sharding explicitly
+        check_vma=False,
     )
-    return fn(u1b, u2b, qx, qy, q_inf, r0, rn, wrap_ok)
+    return fn(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8)
 
 
 def verify_batch_sharded(records, n_chips: int) -> np.ndarray:
     """Shard a record batch across the mesh; returns (len(records),) bool.
-    Pads B to a multiple of n_chips with poisoned lanes."""
-    from ..ops.ecdsa_batch import pack_records
+    Pads B up to n_chips * 1024-lane shards with poisoned lanes; degenerate
+    lanes (H == 0 collisions) re-verify on the host scalar path exactly
+    like the single-chip dispatch (ops/ecdsa_batch.BatchHandle)."""
+    from ..ops.ecdsa_batch import _verify_cpu, pack_records_w4_bytes
 
     n = len(records)
-    bucket = max(n_chips, ((n + n_chips - 1) // n_chips) * n_chips)
-    arrays = pack_records(records, bucket)
-    ok, _fails = jax.block_until_ready(
-        _sharded_verify_jit(*map(np.asarray, arrays), n_chips=n_chips)
+    per_chip = max(
+        _CHIP_BUCKET,
+        ((n + n_chips - 1) // n_chips + _CHIP_BUCKET - 1)
+        // _CHIP_BUCKET * _CHIP_BUCKET,
     )
-    return np.asarray(ok)[:n]
+    bucket = per_chip * n_chips
+    arrays = pack_records_w4_bytes(records, bucket)
+    ok, degen, _fails = jax.block_until_ready(
+        _sharded_w4_jit(*map(np.asarray, arrays), n_chips=n_chips,
+                        interpret=_use_interpret())
+    )
+    out = np.asarray(ok)[:n].copy()
+    degen = np.asarray(degen)[:n]
+    idxs = np.nonzero(degen)[0]
+    if idxs.size:
+        from ..ops.ecdsa_batch import STATS
+
+        STATS.degenerate_rechecks += int(idxs.size)
+        out[idxs] = _verify_cpu([records[i] for i in idxs])
+    return out
 
 
 def dryrun(n_devices: int) -> None:
-    """Driver dryrun leg: one sharded sig-batch dispatch on the virtual
+    """Driver dryrun leg: one sharded w4 sig-batch dispatch on the virtual
     mesh — one valid and one invalid signature among padded lanes."""
     import random
 
@@ -85,4 +122,4 @@ def dryrun(n_devices: int) -> None:
         expected.append(oracle.ecdsa_verify(pub, r, s, e))
     got = verify_batch_sharded(recs, n_devices)
     assert got.tolist() == expected, (got.tolist(), expected)
-    print(f"sig_shard dryrun: {n_devices}-chip sharded sig batch OK")
+    print(f"sig_shard dryrun: {n_devices}-chip sharded w4 sig batch OK")
