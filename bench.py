@@ -148,34 +148,48 @@ def bench_merkle():
               "see ROOFLINE.md §6")
 
 
-def bench_ecdsa_batch():
-    """Config 4: the 10k-sig ConnectBlock batch through the real dispatch
-    path (pack -> bucket-pad -> device kernel -> unpack)."""
+def _make_sig_records(rng, n_distinct: int, n_total: int):
+    """n_total SigCheckRecords tiled from n_distinct fresh (key, sig, msg)
+    triples — FRESH per timed run: the serving tunnel memoizes identical
+    (program, args) dispatches, so reusing one batch across runs over-reads
+    by up to 1.5x (VERDICT r4 weak-2)."""
+    from bitcoincashplus_tpu import native as _nat
     from bitcoincashplus_tpu.crypto import secp256k1 as oracle
-    from bitcoincashplus_tpu.ops import ecdsa_batch
     from bitcoincashplus_tpu.script.interpreter import SigCheckRecord
 
-    rng = np.random.default_rng(5)
+    sign = _nat.ecdsa_sign if _nat.available() else oracle.ecdsa_sign
     base = []
-    for _ in range(64):  # 64 distinct real (key, sig, msg) triples
+    for _ in range(n_distinct):
         secret = int.from_bytes(rng.bytes(32), "big") % (oracle.N - 1) + 1
         pub = oracle.point_mul(secret, oracle.G)
         e = int.from_bytes(rng.bytes(32), "big") % oracle.N
-        r, s = oracle.ecdsa_sign(secret, e)
+        r, s = sign(secret, e)
         base.append((pub, r, s, e))
-    records = [  # tiled to 10k lanes (device work identical per lane)
-        SigCheckRecord(*base[i % 64], b"\x00" * 32, 0) for i in range(10_000)
-    ]
-    ok = ecdsa_batch.verify_batch(records, backend="device")  # warm/compile
+    return [SigCheckRecord(*base[i % n_distinct], b"\x00" * 32, 0)
+            for i in range(n_total)]
+
+
+def bench_ecdsa_batch():
+    """Config 4: the 10k-sig ConnectBlock batch through the real dispatch
+    path (pack -> bucket-pad -> device kernel -> unpack). Every timed run
+    verifies a freshly signed batch (content-randomized per iteration —
+    VERDICT r4 item 3). Returns the measured device sigs/s for the reindex
+    projection."""
+    from bitcoincashplus_tpu.ops import ecdsa_batch
+
+    rng = np.random.default_rng(5)
+    warm = _make_sig_records(rng, 64, 10_000)
+    ok = ecdsa_batch.verify_batch(warm, backend="device")  # warm/compile
     assert bool(ok.all())
     ts = []
     for _ in range(3):
+        records = _make_sig_records(rng, 64, 10_000)  # fresh content
         t0 = time.perf_counter()
         ok = ecdsa_batch.verify_batch(records, backend="device")
         ts.append(time.perf_counter() - t0)
         assert bool(ok.all())
     dt = sorted(ts)[1]
-    sps = len(records) / dt
+    sps = len(warm) / dt
     from bitcoincashplus_tpu.ops.ecdsa_batch import STATS as _st
     from bitcoincashplus_tpu.ops.ecdsa_batch import pallas_enabled as _pe
 
@@ -188,7 +202,7 @@ def bench_ecdsa_batch():
 
     cpu_sps = None
     if _nat.available():
-        sample = records[:1000]
+        sample = warm[:1000]
         t0 = time.perf_counter()
         _nat.ecdsa_verify_batch(sample)
         cpu_sps = len(sample) / (time.perf_counter() - t0)
@@ -196,11 +210,10 @@ def bench_ecdsa_batch():
          round(sps / cpu_sps, 2) if cpu_sps else 0.0,
          kernel=kernel,
          cpu_native_sigs_per_s=round(cpu_sps) if cpu_sps else None,
-         note=f"B=10000 through the full dispatch path ({dt:.2f}s, median "
-              "of 3); 64 distinct sigs tiled (per-lane work identical); "
-              "w=4 windowed ladder in (rows,8,128) exact-vreg tiles, "
-              "degenerate-collision lanes host-rechecked; vs_baseline = "
+         note=f"B=10000, fresh signatures per timed run ({dt:.2f}s, median "
+              "of 3); w=4 windowed Pallas ladder; vs_baseline = "
               "device/cpu-core ratio")
+    return sps
 
 
 def bench_virtual_shard():
@@ -214,12 +227,14 @@ def bench_virtual_shard():
     program itself is identical to what rides ICI on real hardware.
     Subprocess keeps JAX_PLATFORMS clean."""
     code = r"""
-import os, time, json
+import os, time, json, tempfile
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 import sys; sys.path.insert(0, %r)
 import jax
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(tempfile.gettempdir(), "bcp-jax-test-cache"))
 from bitcoincashplus_tpu.parallel.nonce_shard import sweep_header_sharded
 header = bytes(range(80))
 def timed(n_chips, tiles_per_chip):
@@ -229,18 +244,21 @@ def timed(n_chips, tiles_per_chip):
         tile=4096, n_chips=n_chips, return_per_chip=True)
     return time.perf_counter() - t0, hashes, per_chip
 curve = {}
+spread = {}
 per_chip_8 = None
 for n in (1, 2, 4, 8):
     timed(n, 1)  # warm/compile this mesh shape
-    best = 0.0
-    for _ in range(3):
+    rates = []
+    for _ in range(5):  # median-of-5 + spread (VERDICT r4 item 7)
         t, h, pc = timed(n, 16)
-        best = max(best, h / t)
+        rates.append(h / t)
         if n == 8:
             per_chip_8 = pc
-    curve[n] = best / 1e6
-# sig_shard leg: the ECDSA batch sharded over the virtual mesh (XLA
-# bit-ladder kernel; small batch keeps CPU wall-time sane)
+    rates.sort()
+    curve[n] = rates[2] / 1e6
+    spread[n] = [round(rates[0] / 1e6, 2), round(rates[-1] / 1e6, 2)]
+# sig_shard leg: the PRODUCTION w4 kernel sharded over the virtual mesh
+# (pallas interpret mode on CPU — same program that rides ICI on hardware)
 from dataclasses import dataclass
 import random
 from bitcoincashplus_tpu.crypto import secp256k1 as o
@@ -249,21 +267,29 @@ from bitcoincashplus_tpu.parallel.sig_shard import verify_batch_sharded
 class Rec:
     pubkey: tuple; r: int; s: int; msg_hash: int
 rng = random.Random(7)
-recs = []
+base = []
 for _ in range(16):
     sk = rng.randrange(1, o.N); e = rng.getrandbits(256)
     r, s = o.ecdsa_sign(sk, e)
-    recs.append(Rec(o.point_mul(sk, o.G), r, s, e))
-recs = recs * 8  # 128 lanes
+    base.append(Rec(o.point_mul(sk, o.G), r, s, e))
+recs = base * 512  # 8192 lanes: 1024-lane shards on the 8-way mesh
 sig = {}
+sig_spread = {}
 for n in (1, 8):
-    verify_batch_sharded(recs, n)  # warm with the SAME batch shape
-    t0 = time.perf_counter()
-    ok = verify_batch_sharded(recs, n)
-    sig[n] = len(recs) / (time.perf_counter() - t0)
-    assert ok.all()
-print(json.dumps({"curve_mhs": curve, "per_chip_tiles_8": per_chip_8,
-                  "sig_1": sig[1], "sig_8": sig[8]}))
+    verify_batch_sharded(recs, n)  # warm/compile this mesh shape
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ok = verify_batch_sharded(recs, n)
+        rates.append(len(recs) / (time.perf_counter() - t0))
+        assert ok.all()
+    rates.sort()
+    sig[n] = rates[1]
+    sig_spread[n] = [round(rates[0]), round(rates[-1])]
+print(json.dumps({"curve_mhs": curve, "curve_spread_mhs": spread,
+                  "per_chip_tiles_8": per_chip_8,
+                  "sig_1": sig[1], "sig_8": sig[8],
+                  "sig_spread": sig_spread}))
 """ % os.path.dirname(os.path.abspath(__file__))
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     try:
@@ -276,14 +302,15 @@ print(json.dumps({"curve_mhs": curve, "per_chip_tiles_8": per_chip_8,
             round(curve[8] / curve[1], 2)
         emit("nonce_shard_virtual8_speedup", speedup, "x", 0.0,
              scaling_curve_mhs={k: round(v, 2) for k, v in curve.items()},
+             curve_spread_mhs=r["curve_spread_mhs"],
              per_chip_tiles_8=r["per_chip_tiles_8"],
              sig_shard_sigs_per_s={"1": round(r["sig_1"]),
                                    "8": round(r["sig_8"])},
-             note="VIRTUAL 8-device CPU mesh (no multi-chip hardware here): "
-                  "virtual chips share host cores, so the curve is a "
-                  "correctness/lower-bound check, NOT an ICI scaling claim; "
-                  "run-to-run variance on this host is large (1.8x-4.5x "
-                  "observed for identical code)")
+             sig_shard_spread=r["sig_spread"],
+             sig_shard_kernel="pallas-w4-3d (interpret on CPU mesh)",
+             note="VIRTUAL 8-device CPU mesh (no multi-chip hardware): "
+                  "median-of-5 + [min,max] spread; lower-bound sanity "
+                  "check, NOT an ICI claim")
     except Exception as e:  # pragma: no cover - diagnostics only
         emit("nonce_shard_virtual8_speedup", -1, "x", 0.0,
              note=f"subprocess failed: {e}")
@@ -343,25 +370,55 @@ def bench_sweep_headline():
     emit("sha256d_sweep_throughput_per_chip", round(ghs, 4), "GH/s",
          round(ghs / BASELINE_GHS, 6),
          kernel=kernel,
-         note="truncated-h7 specialized double-SHA at ~90% of the chip's "
-              "6.17T u32-op/s VPU integer ceiling — see ROOFLINE.md")
+         note="truncated-h7 specialized double-SHA; r4 measured 88% of "
+              "the 1.04 GH/s op-bound VPU ceiling — see ROOFLINE.md")
 
 
-def bench_reindex():
+def _run_reindex(workdir):
+    """One Node(-reindex) import; returns a stats dict (the native import's
+    last_import_stats when that path ran, else a wall/verify decomposition
+    from the chainstate bench counters that the Python path populates)."""
+    from bitcoincashplus_tpu.node.config import Config
+    from bitcoincashplus_tpu.node.node import Node
+
+    cfg = Config()
+    cfg.args["datadir"] = [workdir]
+    cfg.args["regtest"] = ["1"]
+    cfg.args["reindex"] = ["1"]
+    t0 = time.perf_counter()
+    node = Node(config=cfg)
+    wall_total = time.perf_counter() - t0
+    stats = node.last_import_stats or {}
+    # Python-path import (no native engine): verify time lives in the
+    # chainstate bench counters, not last_import_stats
+    stats.setdefault("verify_s", node.chainstate.bench["verify_ms"] / 1e3)
+    tip = node.chainstate.tip()
+    node.close()
+    stats.setdefault("wall_s", wall_total)
+    stats["node_wall_s"] = wall_total
+    stats["tip_height"] = tip.height
+    return stats
+
+
+def bench_reindex(device_sps=None):
     """Config 6 — the NORTH STAR (BASELINE.json: mainnet -reindex wall-clock
     < 45 min on v5e-8): generate a synthetic signature-dense regtest chain
     (tools/gen_sigchain.py), run the full Node(-reindex) import over it
-    (LoadExternalBlockFile -> ProcessNewBlock -> ConnectBlock -> TPU sig
-    batch), and report measured blocks/s / tx/s / sigs/s plus a projected
-    mainnet wall-clock from the component profile.
+    (native connect engine -> packed TPU sig batches, the production path),
+    and project a mainnet wall-clock from measured component rates.
 
     Projection model (constants are fork-era public chain shape, NOT from
-    the empty reference mount): total = sig_leg + byte_leg where
-    sig_leg = MAINNET_SIG_INPUTS * (verify_seconds / sigs) and
-    byte_leg = MAINNET_BYTES / (chain_bytes / non_verify_seconds).
-    The verify leg contains host script interpretation + device ECDSA (the
-    synthetic chain is 1 sig per input, like the P2PKH-dominated mainnet);
-    the byte leg carries deserialize/connect/flush/index."""
+    the empty reference mount), additive (conservative — the import
+    pipelines device verify under host byte work, so the true wall is
+    closer to max of the legs):
+      byte_leg = MAINNET_BYTES / (chain_bytes / non_verify_import_seconds)
+      sig_leg  = MAINNET_SIG_INPUTS / device_sigs_per_s   (config 4's
+                 content-randomized measurement; the import's own verify
+                 waits are partially hidden by pipelining, so the raw
+                 dispatch rate is the honest per-sig cost)
+    A second, heterogeneous chain (mixed input counts, P2PK, P2SH
+    multisig — tools/gen_sigchain._mixed_phase) reports the script-shape
+    bias of the uniform best case (VERDICT r4 item 6)."""
     import shutil
     import tempfile
 
@@ -370,104 +427,103 @@ def bench_reindex():
     MAINNET_BYTES = 130e9         # ~130 GB serialized chain at that height
 
     n_sigs = int(os.environ.get("BCP_BENCH_REINDEX_SIGS", "16000"))
+    n_mixed = int(os.environ.get("BCP_BENCH_REINDEX_MIXED_SIGS", "4000"))
     workdir = tempfile.mkdtemp(prefix="bcp-reindex-bench-")
+    mixdir = tempfile.mkdtemp(prefix="bcp-reindex-mixed-")
     try:
         from tools.gen_sigchain import generate
 
-        gen = generate(workdir, n_sigs)
-
-        from bitcoincashplus_tpu.node.config import Config
-        from bitcoincashplus_tpu.node.node import Node
         from bitcoincashplus_tpu.ops import ecdsa_batch
 
-        # warm the verify kernel: the w4 Pallas compile is ~1-2 min on the
-        # tunneled chip and would otherwise land inside the first block's
-        # measured verify time (a mainnet-scale run amortizes it to zero)
+        gen = generate(workdir, n_sigs)
+        genm = generate(mixdir, n_mixed, mixed=True)
+
+        # warm the verify kernel at the dense blocks' exact bucket shape
+        # (2000 records -> 2048): the w4 Pallas compile is ~1-2 min on the
+        # tunneled chip and must not land inside the measured import
         if jax.default_backend() != "cpu":
-            import random as _random
-
-            from bitcoincashplus_tpu.crypto import secp256k1 as _o
-            from bitcoincashplus_tpu.script.interpreter import SigCheckRecord
-
-            from bitcoincashplus_tpu import native as _nat
-
-            _rng = _random.Random(1)
-            _sk = _rng.randrange(1, _o.N)
-            _pub = _o.point_mul(_sk, _o.G)
-            _sign = _nat.ecdsa_sign if _nat.available() else _o.ecdsa_sign
-            # warm the EXACT bucket shape the dense blocks will dispatch
-            # (inputs_per_tx * txs_per_block = 2000 -> bucket 2048): the
-            # jit program is shape-keyed, so warming a different bucket
-            # would leave the ~1-2 min compile inside the measured wall
-            warm_recs = []
-            for i in range(1100):  # bucket_for(1100) == 2048
-                _e = _rng.getrandbits(256)
-                _r, _s = _sign(_sk, _e)
-                warm_recs.append(SigCheckRecord(_pub, _r, _s, _e))
-            ecdsa_batch.verify_batch(warm_recs, backend="device")
+            rng = np.random.default_rng(11)
+            ecdsa_batch.verify_batch(_make_sig_records(rng, 8, 1100),
+                                     backend="device")
 
         stats0 = ecdsa_batch.STATS.snapshot()
-        cfg = Config()
-        cfg.args["datadir"] = [workdir]
-        cfg.args["regtest"] = ["1"]
-        cfg.args["reindex"] = ["1"]
-        t0 = time.perf_counter()
-        node = Node(config=cfg)
-        wall = time.perf_counter() - t0
-        tip = node.chainstate.tip()
-        bench = dict(node.chainstate.bench)
-        assert tip.height == gen["tip_height"], (tip.height, gen)
-
-        verify_s = bench["verify_ms"] / 1e3
-        other_s = max(wall - verify_s, 1e-9)
-        sig_rate = gen["sigs"] / max(verify_s, 1e-9)
-        byte_rate = gen["bytes"] / other_s
-        proj_sig_leg = MAINNET_SIG_INPUTS / sig_rate
-        proj_byte_leg = MAINNET_BYTES / byte_rate
-        proj_min = (proj_sig_leg + proj_byte_leg) / 60
+        stats = _run_reindex(workdir)
+        assert stats["tip_height"] == gen["tip_height"], (stats, gen)
         stats1 = ecdsa_batch.STATS.snapshot()
-        device_s = stats1["device_seconds"] - stats0.get("device_seconds", 0)
+        device_wait_s = (stats1["device_seconds"]
+                         - stats0.get("device_seconds", 0))
+        statsm = _run_reindex(mixdir)
+        assert statsm["tip_height"] == genm["tip_height"], (statsm, genm)
+
+        wall = stats["wall_s"]
+        verify_s = stats.get("verify_s", 0.0)
+        other_s = max(wall - verify_s, 1e-9)
+        byte_rate = gen["bytes"] / other_s
+        sig_sps = device_sps or (gen["sigs"] / max(verify_s, 1e-9))
+        proj_byte_leg = MAINNET_BYTES / byte_rate
+        proj_sig_leg = MAINNET_SIG_INPUTS / sig_sps
+        proj_min = (proj_sig_leg + proj_byte_leg) / 60
+        mixed_wall = statsm["wall_s"]
+        mixed_other = max(mixed_wall - statsm.get("verify_s", 0.0), 1e-9)
         emit(
             "reindex_projected_mainnet_min", round(proj_min), "min",
             round(45.0 / max(proj_min, 1e-9), 6),
             measured={
                 "sigs": gen["sigs"], "blocks": gen["blocks"],
-                "txs": gen["txs"], "bytes": gen["bytes"],
-                "wall_s": round(wall, 1),
-                "blocks_per_s": round(gen["blocks"] / wall, 2),
-                "txs_per_s": round(gen["txs"] / wall, 1),
+                "bytes": gen["bytes"],
+                # the host's core count bounds the threaded native legs
+                # (sigscan, txid hashing, CPU ECDSA): this sandbox exposes
+                # 1 core, a real v5e-8 host has >100 — the byte leg
+                # projection is a per-core lower bound
+                "host_cpus": os.cpu_count(),
+                "import_wall_s": round(wall, 2),
+                "blocks_per_s": round(gen["blocks"] / wall, 1),
                 "sigs_per_s_end_to_end": round(gen["sigs"] / wall),
-                "verify_s": round(verify_s, 1),
-                "device_verify_s": round(device_s, 1),
-                "host_interpret_s": round(verify_s - device_s, 1),
-                "connect_s": round(bench["connect_ms"] / 1e3, 1),
-                "flush_s": round(bench["flush_ms"] / 1e3, 1),
-                "other_s": round(other_s, 1),
+                "byte_MB_per_s": round(byte_rate / 1e6, 2),
+                "verify_wait_s": round(verify_s, 2),
+                "device_wait_s": round(device_wait_s, 2),
+                "native_connect_s": round(
+                    stats.get("native_connect_s", 0.0), 2),
+                "flush_s": round(stats.get("flush_s", 0.0), 2),
+                "slow_path_blocks": stats.get("slow_path_blocks"),
+            },
+            mixed={
+                "sigs": genm["sigs"], "bytes": genm["bytes"],
+                "blocks": genm["blocks"],
+                "import_wall_s": round(mixed_wall, 2),
+                "sigs_per_s_end_to_end": round(genm["sigs"] / mixed_wall),
+                "byte_MB_per_s": round(genm["bytes"] / mixed_other / 1e6,
+                                       2),
+                "fallback_inputs": statsm.get("fallback_inputs"),
             },
             projection={
                 "sig_leg_min": round(proj_sig_leg / 60),
                 "byte_leg_min": round(proj_byte_leg / 60),
+                "device_sigs_per_s": round(sig_sps),
                 "model_sig_inputs": MAINNET_SIG_INPUTS,
                 "model_bytes": MAINNET_BYTES,
                 "model_blocks": MAINNET_BLOCKS,
                 # the reference's DEFAULT -reindex skips script/sig checks
-                # below the assumevalid checkpoint (~90% of history); the
-                # headline number above is the conservative FULL-verify
-                # projection. Model: 10% of sig inputs above checkpoint.
+                # below the assumevalid checkpoint (~90% of history)
                 "assumevalid_projected_min": round(
                     (proj_sig_leg * 0.10 + proj_byte_leg) / 60
                 ),
                 "model_above_assumevalid_fraction": 0.10,
             },
-            note="synthetic P2PKH sig-dense chain via tools/gen_sigchain.py; "
-                 "full script+sig validation (no assumevalid skip); target "
-                 "45 min => vs_baseline = 45/projected",
+            note="native C++ import engine + packed TPU batches; mixed = "
+                 "heterogeneous script shapes; additive projection "
+                 "(pipelining makes it conservative); vs_baseline = "
+                 "45/projected",
         )
+        return {"projected_min": round(proj_min),
+                "byte_MBs": round(byte_rate / 1e6, 1)}
     except Exception as e:  # pragma: no cover - diagnostics only
         emit("reindex_projected_mainnet_min", -1, "min", 0.0,
              error=f"{type(e).__name__}: {e}")
+        return None
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
+        shutil.rmtree(mixdir, ignore_errors=True)
 
 
 def _device_reachable(timeout_s: int = 180) -> bool:
@@ -495,11 +551,20 @@ def main():
     on_cpu = jax.default_backend() == "cpu"
     bench_header_hash()
     bench_merkle()
+    device_sps = None
     if not on_cpu:
-        bench_ecdsa_batch()  # device kernel; CPU fallback would not be news
-    bench_reindex()  # config 6: the north-star metric
+        # device kernel; CPU fallback would not be news
+        device_sps = bench_ecdsa_batch()
+    reindex = bench_reindex(device_sps)  # config 6: the north-star metric
     bench_virtual_shard()
-    bench_sweep_headline()  # headline LAST: the driver parses the final line
+    # compact recap line so every config's headline value survives the
+    # driver's 2000-byte tail capture (VERDICT r4 item 5); the true
+    # headline still goes LAST (the driver parses the final line)
+    recap = {"ecdsa_sigs_per_s": round(device_sps) if device_sps else None}
+    if reindex:
+        recap.update(reindex)
+    emit("summary_recap", 1, "-", 0.0, values=recap)
+    bench_sweep_headline()  # headline LAST
 
 
 if __name__ == "__main__":

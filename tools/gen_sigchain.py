@@ -96,8 +96,86 @@ def _make_block(prev_hash: bytes, height: int, block_time: int, bits: int,
     return CBlock(_mine(header, target), vtx)
 
 
+def _mixed_phase(utxos, push, key, spk, total_sigs, inputs_per_tx,
+                 progress):
+    """Heterogeneous segment (VERDICT r4 #6): varied input counts across
+    the dispatch padding buckets, P2PK spends (generic-interpreter deferred
+    path), and P2SH 2-of-3 multisig spends (the eager CPU CHECKMULTISIG
+    path) — the script-shape mix a real mainnet block range has, where the
+    uniform P2PKH chain is the TPU fast path's best case. Returns the
+    number of ECDSA checks generated."""
+    import itertools
+
+    from bitcoincashplus_tpu.crypto.hashes import hash160
+    from bitcoincashplus_tpu.script.script import (
+        multisig_script,
+        p2pk_script,
+        p2sh_script_for_redeem,
+    )
+
+    keys = [CKey(0xA11CE0 + 7 * i, compressed=(i % 2 == 0))
+            for i in range(3)]
+    redeem = multisig_script(2, [k.pubkey for k in keys])
+    p2sh_spk = p2sh_script_for_redeem(redeem)
+    pk_spk = p2pk_script(keys[0].pubkey)
+
+    def key_for(ident):
+        if ident in (key.pubkey_hash, key.pubkey):
+            return key
+        for k in keys:
+            if ident in (k.pubkey_hash, k.pubkey):
+                return k
+        return None
+
+    sizes = itertools.cycle([1, 3, 25, 80, min(250, inputs_per_tx)])
+    sigs_done = 0
+    carry = []  # (txid, idx, value, spk, redeem|None) to spend next block
+    while (sigs_done < total_sigs and utxos) or carry:
+        txs = []
+        if carry:
+            spent = [(s, v) for _, _, v, s, _ in carry]
+            unsigned = CTransaction(
+                version=1,
+                vin=tuple(CTxIn(COutPoint(t, i), b"", 0xFFFFFFFE)
+                          for t, i, _, _, _ in carry),
+                vout=(CTxOut(sum(v for _, _, v, _, _ in carry) - FEE,
+                             spk),),
+            )
+            rs = {hash160(r): r for *_, r in carry if r}
+            txs.append(sign_transaction(unsigned, spent, key_for,
+                                        enable_forkid=True,
+                                        redeem_scripts=rs))
+            sigs_done += sum(2 if r else 1 for *_, r in carry)
+            carry = []
+        if sigs_done < total_sigs and utxos:
+            k = next(sizes)
+            chunk = utxos[:k]
+            del utxos[:k]
+            total_in = sum(v for _, _, v in chunk)
+            out_each = (total_in - FEE) // 3
+            assert out_each > 546, "chunk too small for the 3-way split"
+            unsigned = CTransaction(
+                version=1,
+                vin=tuple(CTxIn(COutPoint(t, i), b"", 0xFFFFFFFE)
+                          for t, i, _ in chunk),
+                vout=(CTxOut(out_each, pk_spk),
+                      CTxOut(out_each, p2sh_spk),
+                      CTxOut(out_each, spk)),
+            )
+            txs.append(sign_transaction(
+                unsigned, [(spk, v) for _, _, v in chunk], key_for,
+                enable_forkid=True))
+            sigs_done += len(chunk)
+            carry = [(txs[-1].txid, 0, out_each, pk_spk, None),
+                     (txs[-1].txid, 1, out_each, p2sh_spk, redeem)]
+        push(txs)
+        progress(f"mixed block: {sigs_done}/{total_sigs} sigs")
+    return sigs_done
+
+
 def generate(datadir: str, total_sigs: int, inputs_per_tx: int = 250,
              txs_per_block: int = 8, fan_k: int = 2000,
+             mixed: bool = False,
              progress=lambda s: None) -> dict:
     params = regtest_params()
     net_dir = os.path.join(datadir, "regtest")
@@ -173,7 +251,24 @@ def generate(datadir: str, total_sigs: int, inputs_per_tx: int = 250,
 
     # Phase 3: dense blocks — txs_per_block txs of inputs_per_tx P2PKH
     # spends each; every input is one ECDSA verification at reindex.
+    # (mixed=True swaps in the heterogeneous segment instead.)
     utxos = utxos[:total_sigs]
+    if mixed:
+        n_sigs = _mixed_phase(utxos, push, key, spk, total_sigs,
+                              inputs_per_tx, progress)
+        store.flush()
+        cs.flush()
+        store.close()
+        index_kv.close()
+        coins_kv.close()
+        return {
+            "blocks": n_blocks[0],
+            "txs": n_txs[0],
+            "sigs": n_sigs,
+            "bytes": n_bytes[0],
+            "tip_height": n_blocks[0],
+            "mixed": True,
+        }
     progress(f"dense: {len(utxos)} sig-inputs, "
              f"{sigs_per_dense_block} per block")
     sigs_done = 0
@@ -223,12 +318,14 @@ def main():
     ap.add_argument("--inputs-per-tx", type=int, default=250)
     ap.add_argument("--txs-per-block", type=int, default=8)
     ap.add_argument("--fan-k", type=int, default=2000)
+    ap.add_argument("--mixed", action="store_true",
+                    help="heterogeneous script shapes (see _mixed_phase)")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args()
     progress = (lambda s: None) if args.quiet else (
         lambda s: print(f"[gen_sigchain] {s}", file=sys.stderr, flush=True))
     summary = generate(args.datadir, args.sigs, args.inputs_per_tx,
-                       args.txs_per_block, args.fan_k, progress)
+                       args.txs_per_block, args.fan_k, args.mixed, progress)
     print(json.dumps(summary))
 
 
