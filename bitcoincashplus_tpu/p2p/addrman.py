@@ -94,9 +94,18 @@ class AddrMan:
         self.addrs: dict[str, AddrInfo] = {}
         self._rng = random.Random(seed)
         # nKey — the secret bucketing key (persisted: rebucketing on every
-        # restart would let an observer correlate placements)
-        self._k0 = self._rng.getrandbits(64)
-        self._k1 = self._rng.getrandbits(64)
+        # restart would let an observer correlate placements). Bucket
+        # placement is the eclipse defense, so the key comes from a CSPRNG
+        # like the reference's nKey (ADVICE r4) — the deterministic seed
+        # stays test-only.
+        if seed is None:
+            import secrets
+
+            self._k0 = secrets.randbits(64)
+            self._k1 = secrets.randbits(64)
+        else:
+            self._k0 = self._rng.getrandbits(64)
+            self._k1 = self._rng.getrandbits(64)
         # (bucket, slot) -> addr key; inverse position map on the side
         self.new_tbl: dict[tuple, str] = {}
         self.tried_tbl: dict[tuple, str] = {}

@@ -206,9 +206,17 @@ def test_fee_estimator_rpc():
         assert cold["errors"]
         addr = node.rpc.getnewaddress()
         node.rpc.generatetoaddress(103, addr)
-        # a few wallet txs confirming next-block at wallet feerates
+        # the estimator needs reference-scale samples (~50 decayed
+        # observations, EstimateMedianVal's sufficientTxVal/(1-decay)
+        # gate): a handful of txs must stay cold...
         for _ in range(6):
             node.rpc.sendtoaddress(node.rpc.getnewaddress(), 0.5)
+        node.rpc.generatetoaddress(1, addr)
+        assert node.rpc.estimatefee(2) == -1
+        # ...and ~60 confirmed wallet txs flip it warm
+        for _ in range(7):
+            for _ in range(9):
+                node.rpc.sendtoaddress(node.rpc.getnewaddress(), 0.2)
             node.rpc.generatetoaddress(1, addr)
         est = node.rpc.estimatesmartfee(2)
         assert "errors" not in est, est

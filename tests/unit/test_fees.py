@@ -56,12 +56,37 @@ def test_insufficient_data_cold():
     est = FeeEstimator()
     assert est.estimate_fee(1) == -1
     assert est.estimate_smart_fee(1) == (-1.0, 1)
-    # a couple of observations are not enough to flip every target wildly;
-    # smart fee widens the horizon and reports the answering target
-    _run_schedule(est, 1, 50, [(10_000, 2)])
+    # below the reference-scale sample gate (sufficientTxVal/(1-decay)
+    # ~= 50 decayed observations) NO estimate is minted — a single tracked
+    # tx must never answer (VERDICT r4 item 9)
+    _run_schedule(est, 1, 12, [(10_000, 2)])
+    assert est.estimate_smart_fee(1) == (-1.0, 1)
+    # past the gate, smart fee widens the horizon and reports the
+    # answering target
+    _run_schedule(est, 13, 120, [(10_000, 2)])
     est_fee, answered = est.estimate_smart_fee(1)
     assert est_fee > 0
     assert answered >= 2  # nothing ever confirmed in 1 block
+
+
+def test_congestion_unconfirmed_txs_suppress_estimate():
+    """A bucket whose txs mostly sit unconfirmed must not read as ~100%
+    success (ADVICE r4 medium: unconfirmed txs join the denominator)."""
+    est = FeeEstimator()
+    # 200 blocks of 1 tx/block confirming in 2 blocks: warm, answers
+    _run_schedule(est, 1, 200, [(10_000, 2)])
+    warm = est.estimate_fee(3)
+    assert warm > 0
+    # congestion: a flood of same-bucket txs enters and NEVER confirms
+    for i in range(400):
+        est.process_tx(_txid(10_000_000 + i), 200, 10_000)
+    for h in range(201, 215):
+        est.process_block(h, [])
+    assert est.estimate_fee(3) == -1  # success ratio collapsed
+    # the flood clearing (eviction) restores the historical answer
+    for i in range(400):
+        est.remove_tx(_txid(10_000_000 + i))
+    assert est.estimate_fee(3) > 0
 
 
 def test_slow_confirmations_fail_tight_targets():

@@ -333,3 +333,52 @@ class TestManySmallUtxos:
         pool2 = CTxMemPool()
         entry = accept_to_memory_pool(pool2, cs, tx, min_fee_rate=1000)
         assert entry.fee == fee_paid
+
+
+def test_knapsack_selection_avoids_fragmenting_change():
+    """SelectCoins/ApproximateBestSubset regression (VERDICT r4 item 10):
+    a small spend from a wallet holding many small UTXOs plus a few huge
+    ones must select a near-target subset of the small coins, not one huge
+    coin with maximal change; an exact-value coin must win outright."""
+    from bitcoincashplus_tpu.consensus.params import regtest_params
+    from bitcoincashplus_tpu.consensus.serialize import ser_u32
+    from bitcoincashplus_tpu.consensus.tx import COutPoint, CTxOut
+    from bitcoincashplus_tpu.wallet.wallet import (
+        MIN_CHANGE,
+        Wallet,
+        WalletCoin,
+    )
+
+    w = Wallet(params=regtest_params())
+
+    def coin(i, value):
+        return WalletCoin(COutPoint(ser_u32(i) * 8, 0),
+                          CTxOut(value, b"\x51"), 1, False)
+
+    small = [coin(i, 1_000_000) for i in range(50)]        # 50 x 0.01
+    huge = [coin(100 + i, 1_000_000_000) for i in range(2)]  # 2 x 10
+    coins = small + huge
+
+    # near-target subset: 2.5M target -> small coins only. The reference
+    # re-aims at target + CENT when the first pass can't land exactly
+    # (change below CENT is near-dust), so the bound is target + 2*CENT —
+    # a far cry from largest-first's 10-coin pick with ~9.975 in change.
+    sel = w.select_coins(coins, 2_500_000)
+    total = sum(c.txout.value for c in sel)
+    assert all(c.txout.value == 1_000_000 for c in sel), \
+        "picked a huge coin for a small spend"
+    assert 2_500_000 <= total <= 2_500_000 + 2 * MIN_CHANGE
+
+    # exact match wins outright (single input, zero change)
+    sel = w.select_coins(coins, 1_000_000)
+    assert len(sel) == 1 and sel[0].txout.value == 1_000_000
+
+    # target above the small pool: the lowest larger coin answers
+    sel = w.select_coins(coins, 200_000_000)
+    assert len(sel) == 1 and sel[0].txout.value == 1_000_000_000
+
+    # insufficient funds still raises
+    import pytest
+
+    with pytest.raises(ValueError):
+        w.select_coins(coins, 10_000_000_000)
