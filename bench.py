@@ -232,6 +232,9 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 import sys; sys.path.insert(0, %r)
 import jax
+# config, not just env: the accelerator plugin wins default-backend
+# selection over JAX_PLATFORMS=cpu (tests/conftest.py documents this)
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
 jax.config.update("jax_compilation_cache_dir",
                   os.path.join(tempfile.gettempdir(), "bcp-jax-test-cache"))
@@ -308,9 +311,14 @@ print(json.dumps({"curve_mhs": curve, "curve_spread_mhs": spread,
                                    "8": round(r["sig_8"])},
              sig_shard_spread=r["sig_spread"],
              sig_shard_kernel="pallas-w4-3d (interpret on CPU mesh)",
+             host_cpus=os.cpu_count(),
              note="VIRTUAL 8-device CPU mesh (no multi-chip hardware): "
                   "median-of-5 + [min,max] spread; lower-bound sanity "
-                  "check, NOT an ICI claim")
+                  "check, NOT an ICI claim. On a 1-core host a "
+                  "work-conserving shard can at best TIE 1-way (the 8-way "
+                  "deficit is shard_map partition overhead); the claim is "
+                  "kernel identity — the sharded program IS config 4's w4 "
+                  "pipeline (sig_shard dryrun proves execution)")
     except Exception as e:  # pragma: no cover - diagnostics only
         emit("nonce_shard_virtual8_speedup", -1, "x", 0.0,
              note=f"subprocess failed: {e}")
@@ -457,14 +465,20 @@ def bench_reindex(device_sps=None):
 
         wall = stats["wall_s"]
         verify_s = stats.get("verify_s", 0.0)
-        other_s = max(wall - verify_s, 1e-9)
+        sigscan_s = stats.get("sigscan_s", 0.0)
+        other_s = max(wall - verify_s - sigscan_s, 1e-9)
         byte_rate = gen["bytes"] / other_s
         sig_sps = device_sps or (gen["sigs"] / max(verify_s, 1e-9))
         proj_byte_leg = MAINNET_BYTES / byte_rate
         proj_sig_leg = MAINNET_SIG_INPUTS / sig_sps
-        proj_min = (proj_sig_leg + proj_byte_leg) / 60
+        # host signature scan (sighash + encodings + pubkey parse): per-sig
+        # work, threaded under -par — measured here on host_cpus cores
+        proj_sigscan_leg = (MAINNET_SIG_INPUTS
+                            * (sigscan_s / max(gen["sigs"], 1)))
+        proj_min = (proj_sig_leg + proj_byte_leg + proj_sigscan_leg) / 60
         mixed_wall = statsm["wall_s"]
-        mixed_other = max(mixed_wall - statsm.get("verify_s", 0.0), 1e-9)
+        mixed_other = max(mixed_wall - statsm.get("verify_s", 0.0)
+                          - statsm.get("sigscan_s", 0.0), 1e-9)
         emit(
             "reindex_projected_mainnet_min", round(proj_min), "min",
             round(45.0 / max(proj_min, 1e-9), 6),
@@ -482,6 +496,9 @@ def bench_reindex(device_sps=None):
                 "byte_MB_per_s": round(byte_rate / 1e6, 2),
                 "verify_wait_s": round(verify_s, 2),
                 "device_wait_s": round(device_wait_s, 2),
+                "sigscan_s": round(sigscan_s, 2),
+                "sigscan_us_per_sig": round(
+                    sigscan_s / max(gen["sigs"], 1) * 1e6, 1),
                 "native_connect_s": round(
                     stats.get("native_connect_s", 0.0), 2),
                 "flush_s": round(stats.get("flush_s", 0.0), 2),
@@ -499,14 +516,23 @@ def bench_reindex(device_sps=None):
             projection={
                 "sig_leg_min": round(proj_sig_leg / 60),
                 "byte_leg_min": round(proj_byte_leg / 60),
+                "host_sigscan_leg_min": round(proj_sigscan_leg / 60),
+                # v5e-8 model: sig leg /8 (parallel/sig_shard over ICI);
+                # host legs UNSCALED from this host's core count — a real
+                # v5e-8 host threads them across >100 cores
+                "v5e8_modeled_min": round(
+                    (proj_sig_leg / 8 + proj_byte_leg
+                     + proj_sigscan_leg) / 60),
                 "device_sigs_per_s": round(sig_sps),
                 "model_sig_inputs": MAINNET_SIG_INPUTS,
                 "model_bytes": MAINNET_BYTES,
                 "model_blocks": MAINNET_BLOCKS,
                 # the reference's DEFAULT -reindex skips script/sig checks
-                # below the assumevalid checkpoint (~90% of history)
+                # below the assumevalid checkpoint (~90% of history) —
+                # that skips the host sigscan too, not just the device leg
                 "assumevalid_projected_min": round(
-                    (proj_sig_leg * 0.10 + proj_byte_leg) / 60
+                    ((proj_sig_leg + proj_sigscan_leg) * 0.10
+                     + proj_byte_leg) / 60
                 ),
                 "model_above_assumevalid_fraction": 0.10,
             },

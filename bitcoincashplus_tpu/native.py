@@ -141,6 +141,8 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.bcp_engine_connect_block.restype = ctypes.c_long
     lib.bcp_engine_commit.argtypes = [ctypes.c_void_p]
     lib.bcp_engine_commit.restype = None
+    lib.bcp_engine_sigscan_ns.argtypes = [ctypes.c_void_p]
+    lib.bcp_engine_sigscan_ns.restype = ctypes.c_uint64
     lib.bcp_engine_abort.argtypes = [ctypes.c_void_p]
     lib.bcp_engine_abort.restype = None
     lib.bcp_engine_flush.argtypes = [
@@ -412,6 +414,7 @@ class NativeConnectResult:
     call reuses). Sig arrays are numpy for vectorized compaction."""
 
     __slots__ = ("block_hash", "n_tx", "n_inputs", "undo", "txids_blob",
+                 "sigscan_s",
                  "tx_offsets", "tx_out_counts", "sig_status", "sig_msg",
                  "sig_rs", "sig_pub", "sig_rn", "sig_wrap", "sig_txin",
                  "spent_values", "spent_heightcodes", "spent_spk_offsets",
@@ -550,6 +553,7 @@ class ConnectEngine:
         np = _np()
         res = NativeConnectResult()
         res.block_hash = hash_out.raw
+        res.sigscan_s = lib.bcp_engine_sigscan_ns(self._h) / 1e9
         res.n_tx = lib.bcp_engine_n_tx(self._h)
         res.n_inputs = lib.bcp_engine_n_inputs(self._h)
         ulen = ctypes.c_size_t()

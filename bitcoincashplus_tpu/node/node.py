@@ -584,8 +584,9 @@ class Node:
         eng = native.ConnectEngine()
         eng.set_best(cs.coins.best_block())
         stats = {"blocks": 0, "bytes": 0, "native_connect_s": 0.0,
-                 "verify_s": 0.0, "flush_s": 0.0, "slow_path_blocks": 0,
-                 "fallback_inputs": 0, "fast_inputs": 0}
+                 "sigscan_s": 0.0, "verify_s": 0.0, "flush_s": 0.0,
+                 "slow_path_blocks": 0, "fallback_inputs": 0,
+                 "fast_inputs": 0}
         n_imported = 0
         pending: dict[bytes, list[tuple[bytes, Optional[tuple]]]] = {}
         # in-flight signature batches: (block hash, BatchHandle)
@@ -709,6 +710,7 @@ class Node:
                 eng.abort()
                 return False
             stats["native_connect_s"] += time.perf_counter() - t0
+            stats["sigscan_s"] += res.sigscan_s
             cs.bench["connect_ms"] += (time.perf_counter() - t0) * 1e3
 
             # BIP30 base-store leg: only pre-BIP34 heights can mint

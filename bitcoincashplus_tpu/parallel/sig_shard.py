@@ -34,8 +34,12 @@ from .mesh import CHIP_AXIS, chip_mesh
 _CHIP_BUCKET = 1024
 
 
-def _use_interpret() -> bool:
-    return jax.default_backend() == "cpu"
+def _use_interpret(n_chips: int) -> bool:
+    """Interpret mode iff the mesh's devices are CPUs — NOT the default
+    backend: an accelerator plugin can win default-backend selection while
+    the virtual mesh is still CPU (tests/conftest.py documents the same
+    trap), and Mosaic-vs-interpret must follow where the kernel RUNS."""
+    return chip_mesh(n_chips).devices.flat[0].platform == "cpu"
 
 
 @partial(jax.jit, static_argnames=("n_chips", "interpret"))
@@ -88,7 +92,7 @@ def verify_batch_sharded(records, n_chips: int) -> np.ndarray:
     arrays = pack_records_w4_bytes(records, bucket)
     ok, degen, _fails = jax.block_until_ready(
         _sharded_w4_jit(*map(np.asarray, arrays), n_chips=n_chips,
-                        interpret=_use_interpret())
+                        interpret=_use_interpret(n_chips))
     )
     out = np.asarray(ok)[:n].copy()
     degen = np.asarray(degen)[:n]

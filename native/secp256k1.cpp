@@ -177,10 +177,26 @@ static void modmul(const N256& a, const N256& b, const u64 K[3],
 
 static void modpow(const N256& base, const N256& exp, const u64 K[3],
                    const N256& m, N256& out) {
+    // 4-bit fixed-window square-and-multiply: 14 precompute muls + 252
+    // squarings + <=63 window muls (~330 modmuls) vs the plain ladder's
+    // ~480 for the high-hamming-weight exponents this module actually
+    // raises to ((p+1)/4 sqrt, n-2 / p-2 inverses) — the per-signature
+    // host cost of pubkey decompression and scalar inversion.
+    N256 tbl[16];
+    tbl[1] = base;
+    for (int i = 2; i < 16; i++) modmul(tbl[i - 1], base, K, m, tbl[i]);
     N256 acc = ONE_C;
-    for (int i = 255; i >= 0; i--) {
-        modmul(acc, acc, K, m, acc);
-        if ((exp.d[i >> 6] >> (i & 63)) & 1) modmul(acc, base, K, m, acc);
+    bool started = false;
+    for (int i = 63; i >= 0; i--) {
+        int nib = int((exp.d[i >> 4] >> ((i & 15) * 4)) & 0xF);
+        if (!started) {
+            if (nib == 0) continue;
+            acc = tbl[nib];
+            started = true;
+            continue;
+        }
+        for (int k = 0; k < 4; k++) modmul(acc, acc, K, m, acc);
+        if (nib) modmul(acc, tbl[nib], K, m, acc);
     }
     out = acc;
 }

@@ -74,7 +74,11 @@ def test_congestion_unconfirmed_txs_suppress_estimate():
     success (ADVICE r4 medium: unconfirmed txs join the denominator)."""
     est = FeeEstimator()
     # 200 blocks of 1 tx/block confirming in 2 blocks: warm, answers
-    _run_schedule(est, 1, 200, [(10_000, 2)])
+    leftover = _run_schedule(est, 1, 200, [(10_000, 2)])
+    # the schedule's tail txs never got their confirmation block; drop
+    # them so only the deliberate flood below counts as congestion
+    for _at, t in leftover:
+        est.remove_tx(t)
     warm = est.estimate_fee(3)
     assert warm > 0
     # congestion: a flood of same-bucket txs enters and NEVER confirms
@@ -114,9 +118,9 @@ def test_reorg_replay_no_double_count():
     t = _txid(1)
     est.process_tx(t, 10, 10_000)
     est.process_block(11, [t])
-    before = sum(est.tx_avg)
+    before = [sum(st.tx_avg) for st in est.stats.values()]
     est.process_block(11, [t])  # replayed height: guard must ignore
-    assert sum(est.tx_avg) == before
+    assert [sum(st.tx_avg) for st in est.stats.values()] == before
 
 
 def test_persistence_roundtrip(tmp_path):
@@ -143,19 +147,36 @@ def test_truncated_stats_file_never_fatal(tmp_path):
     path = os.path.join(tmp_path, "fee_estimates.json")
     est = FeeEstimator()
     nb = len(est.buckets)
+
+    def horizon_blob(max_t, truncate_fee=0, ragged=False):
+        return {"tx_avg": [0.0] * nb,
+                "fee_sum": [0.0] * (nb - truncate_fee),
+                "conf_avg": [[0.0] * (2 if ragged else nb)] * max_t}
+
+    from bitcoincashplus_tpu.mempool.fees import HORIZONS
+
+    good = {name: horizon_blob(max_t)
+            for name, _d, max_t, _s in HORIZONS}
+    bad = dict(good)
+    bad["medium"] = horizon_blob(HORIZONS[1][2], truncate_fee=3)
     with open(path, "w") as f:
-        json.dump({"version": 1, "best_height": 5,
-                   "tx_avg": [0.0] * nb,
-                   "fee_sum": [0.0] * (nb - 3),          # truncated
-                   "conf_avg": [[0.0] * nb] * MAX_TARGET}, f)
+        json.dump({"version": 2, "best_height": 5, "horizons": bad}, f)
     est2 = FeeEstimator(path)
+    assert est2.best_height == 0  # rejected whole file, started cold
     est2.process_tx(_txid(1), 10, 5000)
     est2.process_block(11, [_txid(1)])  # must not raise
+    bad2 = dict(good)
+    bad2["long"] = horizon_blob(HORIZONS[2][2], ragged=True)
     with open(path, "w") as f:
-        json.dump({"version": 1, "best_height": 5,
-                   "tx_avg": [0.0] * nb,
-                   "fee_sum": [0.0] * nb,
-                   "conf_avg": [[0.0] * 2] * MAX_TARGET}, f)  # ragged rows
+        json.dump({"version": 2, "best_height": 5, "horizons": bad2}, f)
     est3 = FeeEstimator(path)
+    assert est3.best_height == 0
     est3.process_tx(_txid(2), 10, 5000)
     est3.process_block(11, [_txid(2)])  # must not raise
+    # a v1 (single-horizon) file is simply outgrown: cold start
+    with open(path, "w") as f:
+        json.dump({"version": 1, "best_height": 5,
+                   "tx_avg": [0.0] * nb, "fee_sum": [0.0] * nb,
+                   "conf_avg": [[0.0] * nb] * 25}, f)
+    est4 = FeeEstimator(path)
+    assert est4.best_height == 0
