@@ -446,13 +446,15 @@ def bench_reindex(device_sps=None):
         gen = generate(workdir, n_sigs)
         genm = generate(mixdir, n_mixed, mixed=True)
 
-        # warm the verify kernel at the dense blocks' exact bucket shape
-        # (2000 records -> 2048): the w4 Pallas compile is ~1-2 min on the
-        # tunneled chip and must not land inside the measured import
+        # warm the verify kernel at the import's dispatch shapes: the
+        # aggregator slices exact 8192-lane batches plus a sub-8192 tail
+        # (bucket 2048 here) — the w4 Pallas compile is ~1-2 min per shape
+        # on the tunneled chip and must not land inside the measured import
         if jax.default_backend() != "cpu":
             rng = np.random.default_rng(11)
-            ecdsa_batch.verify_batch(_make_sig_records(rng, 8, 1100),
-                                     backend="device")
+            for n in (8192, 1100, 600):  # buckets 8192 / 2048 / 1024
+                ecdsa_batch.verify_batch(_make_sig_records(rng, 8, n),
+                                         backend="device")
 
         stats0 = ecdsa_batch.STATS.snapshot()
         stats = _run_reindex(workdir)

@@ -138,11 +138,19 @@ class _ConfStats:
                 or any(len(row) != nb for row in data["conf_avg"])):
             return False
         try:
-            self.tx_avg = np.asarray(data["tx_avg"], dtype=float)
-            self.fee_sum = np.asarray(data["fee_sum"], dtype=float)
-            self.conf_avg = np.asarray(data["conf_avg"], dtype=float)
+            tx_avg = np.asarray(data["tx_avg"], dtype=float)
+            fee_sum = np.asarray(data["fee_sum"], dtype=float)
+            conf_avg = np.asarray(data["conf_avg"], dtype=float)
         except (TypeError, ValueError):
             return False
+        # shape, not just outer length: nested-list cells would build a
+        # 3-D array that passes len() checks and crashes estimate() later
+        if (tx_avg.shape != (nb,) or fee_sum.shape != (nb,)
+                or conf_avg.shape != (self.max_target, nb)):
+            return False
+        self.tx_avg = tx_avg
+        self.fee_sum = fee_sum
+        self.conf_avg = conf_avg
         return True
 
 
@@ -272,10 +280,13 @@ class FeeEstimator:
         widens the target (x2 steps, bounded) until an estimate exists.
         (-1, target) cold."""
         target = max(1, min(int(target), MAX_TARGET))
-        # early-out: if no horizon has gate-level decayed weight at all,
-        # no target can ever answer — skip the widening loop entirely
-        if all(float(st.tx_avg.sum()) < st.sufficient
-               for st in self.stats.values()):
+        # early-out: with nothing tracked and no horizon at gate-level
+        # decayed weight, no target can ever answer — skip the widening
+        # loop entirely (tracked unconfirmed txs also count toward the
+        # sufficiency gate, so the shortcut only applies when none exist)
+        if not self.tracked and all(
+                float(st.tx_avg.sum()) < st.sufficient
+                for st in self.stats.values()):
             return -1.0, target
         snapshot = self._tracked_snapshot()
         # widening ladder: target, then doubling steps, then MAX_TARGET —

@@ -180,3 +180,41 @@ def test_truncated_stats_file_never_fatal(tmp_path):
                    "conf_avg": [[0.0] * nb] * 25}, f)
     est4 = FeeEstimator(path)
     assert est4.best_height == 0
+
+
+def test_smart_fee_counts_unconf_toward_gate():
+    """estimatesmartfee must not early-out cold while estimate_fee answers
+    via tracked-unconfirmed denominators (review r5 regression)."""
+    est = FeeEstimator()
+    left = _run_schedule(est, 1, 199, [(10_000, 2)])
+    for _at, t in left:
+        est.remove_tx(t)
+    # idle blocks decay the horizons just below their gates
+    for h in range(200, 671):
+        est.process_block(h, [])
+    for i in range(5):
+        est.process_tx(_txid(5_000_000 + i), 600, 10_000)
+    raw = est.estimate_fee(30)
+    smart, _answered = est.estimate_smart_fee(30)
+    assert (raw > 0) == (smart > 0)
+
+
+def test_nested_conf_avg_cells_rejected(tmp_path):
+    """A v2 stats file whose conf_avg cells are lists (3-D after asarray)
+    must start cold, not crash later estimates (review r5 regression)."""
+    import json
+
+    from bitcoincashplus_tpu.mempool.fees import HORIZONS
+
+    path = os.path.join(tmp_path, "fee_estimates.json")
+    est = FeeEstimator()
+    nb = len(est.buckets)
+    horizons = {}
+    for name, _d, max_t, _s in HORIZONS:
+        horizons[name] = {"tx_avg": [1.0] * nb, "fee_sum": [1.0] * nb,
+                          "conf_avg": [[[50.0, 50.0]] * nb] * max_t}
+    with open(path, "w") as f:
+        json.dump({"version": 2, "best_height": 5, "horizons": horizons}, f)
+    est2 = FeeEstimator(path)
+    assert est2.best_height == 0
+    assert est2.estimate_fee(2) == -1  # cold, no ValueError
