@@ -400,3 +400,148 @@ def test_clean_inserts_not_flushed(chain):
     eng.clear()
     assert eng.entries() == 0
     eng.close()
+
+
+def test_mutation_matrix_verdicts_agree(chain):
+    """Broader native-vs-Python verdict agreement: structured mutations of
+    a valid block must be rejected by BOTH engines with the same reason
+    class (the fast import falls back to Python on any native error, so
+    agreement on 'invalid at all' is the safety bar; the reason match is
+    the quality bar)."""
+    import random
+
+    eng = _engine_for(chain)
+    _replay(chain, eng, upto=len(chain.raws) - 1)
+    height = len(chain.raws)
+    raw = chain.raws[-1]
+    times = sorted(
+        CBlockHeader.deserialize(ByteReader(r[:80])).time
+        for r in chain.raws[-12:-1]
+    )
+    mtp = times[len(times) // 2]
+    flags = block_script_flags(height, struct.unpack_from("<I", raw, 68)[0],
+                               PARAMS)
+
+    def native_verdict(mutated: bytes):
+        try:
+            eng.connect_block(
+                bytes(mutated), height,
+                get_block_subsidy(height, PARAMS.consensus),
+                PARAMS.max_block_size, PARAMS.consensus.coinbase_maturity,
+                mtp, script_int(height), flags, want_sigs=True,
+                commit=False)
+        except native.EngineError as e:
+            eng.abort()
+            return e.reason
+        except native.EngineMissing:
+            eng.abort()
+            return "missing-inputs"
+        eng.abort()
+        return None
+
+    # a Python chainstate at height len-1: the fixture's cs already holds
+    # the final block, whose coinbase would trip BIP30 and mask the real
+    # reason for any mutation that keeps the original coinbase
+    cs2 = ChainstateManager(PARAMS, MemoryCoinsView(), MemoryBlockStore(),
+                            script_verifier=None)
+    for r in chain.raws[:-1]:
+        cs2.process_new_block(CBlock.from_bytes(r))
+
+    def python_verdict(mutated: bytes):
+        try:
+            blk = CBlock.from_bytes(bytes(mutated))
+        except Exception:
+            return "deserialize"
+        from bitcoincashplus_tpu.validation.chain import CBlockIndex
+        from bitcoincashplus_tpu.validation.coins import CoinsCache
+
+        try:
+            cs2.check_block(blk, check_pow=False)
+            idx = CBlockIndex(blk.header, blk.get_hash(), cs2.tip())
+            cs2.connect_block(blk, idx, check_scripts=False,
+                              view=CoinsCache(cs2.coins))
+        except BlockValidationError as e:
+            return e.reason
+        return None
+
+    block = CBlock.from_bytes(raw)
+
+    def rebuild(vtx, header=None):
+        from bitcoincashplus_tpu.consensus.merkle import block_merkle_root
+
+        class _V:
+            pass
+
+        v = _V()
+        v.vtx = tuple(vtx)
+        root, _ = block_merkle_root(v)
+        hdr = header or block.header
+        hdr = CBlockHeader(
+            version=hdr.version, hash_prev_block=hdr.hash_prev_block,
+            hash_merkle_root=root, time=hdr.time, bits=hdr.bits,
+            nonce=hdr.nonce)
+        return CBlock(hdr, tuple(vtx)).serialize()
+
+    spend = block.vtx[1]
+    cases = []
+    # duplicate input within a tx
+    t = CTransaction(spend.version,
+                     (spend.vin[0], spend.vin[0]) + spend.vin[1:],
+                     spend.vout, spend.locktime)
+    cases.append(("dup-input", rebuild([block.vtx[0], t])))
+    # output value negative
+    t = CTransaction(spend.version, spend.vin,
+                     (CTxOut(-1, spend.vout[0].script_pubkey),),
+                     spend.locktime)
+    cases.append(("neg-value", rebuild([block.vtx[0], t])))
+    # in < out (value conjured from nowhere)
+    t = CTransaction(spend.version, spend.vin,
+                     (CTxOut(spend.vout[0].value + 10**12,
+                             spend.vout[0].script_pubkey),),
+                     spend.locktime)
+    cases.append(("in-below-out", rebuild([block.vtx[0], t])))
+    # spend of a nonexistent outpoint
+    t = CTransaction(spend.version,
+                     (CTxIn(COutPoint(b"\x77" * 32, 1), spend.vin[0].script_sig,
+                            0xFFFFFFFE),),
+                     spend.vout, spend.locktime)
+    cases.append(("missing-prevout", rebuild([block.vtx[0], t])))
+    # double coinbase
+    cases.append(("double-coinbase",
+                  rebuild([block.vtx[0], block.vtx[0], *block.vtx[1:]])))
+    # no coinbase first
+    cases.append(("cb-not-first", rebuild(list(block.vtx[1:]))))
+    # corrupt a signature byte (NULLFAIL-era: script error)
+    mutated = bytearray(raw)
+    # find the first scriptSig push in the spend tx region and flip a byte
+    off = raw.index(spend.vin[0].script_sig[:20])
+    mutated[off + 5] ^= 0x01
+    cases.append(("bad-sig-byte", bytes(mutated)))
+    # random byte flips (parse-level chaos)
+    rng = random.Random(7)
+    for i in range(20):
+        m = bytearray(raw)
+        pos = rng.randrange(80, len(m))
+        m[pos] ^= 1 << rng.randrange(8)
+        cases.append((f"flip-{pos}", bytes(m)))
+
+    for name, mut in cases:
+        nv = native_verdict(mut)
+        pv = python_verdict(mut)
+        if name == "bad-sig-byte":
+            # native catches it in the sigscan; the scripts-off python
+            # connect above doesn't check sigs — native must reject, and
+            # the full python interpreter agrees (covered by the
+            # scriptcheck differential suites); only assert native reject
+            assert nv is not None, name
+            continue
+        assert (nv is None) == (pv is None), (name, nv, pv)
+        if nv is not None and nv != "missing-inputs" \
+                and pv != "bad-txns-duplicate" and nv != "deserialize":
+            # exact reason match, modulo check-order differences where a
+            # mutation violates several rules at once
+            assert nv == pv or {nv, pv} <= {
+                "bad-txns-inputs-missingorspent", "bad-txns-BIP30",
+                "bad-cb-multiple", "bad-txnmrklroot",
+            }, (name, nv, pv)
+    eng.close()
