@@ -12,6 +12,7 @@ must agree with the Python reference implementation instead.
 
 from __future__ import annotations
 
+import os
 import struct
 
 import pytest
@@ -544,4 +545,117 @@ def test_mutation_matrix_verdicts_agree(chain):
                 "bad-txns-inputs-missingorspent", "bad-txns-BIP30",
                 "bad-cb-multiple", "bad-txnmrklroot",
             }, (name, nv, pv)
+    eng.close()
+
+
+def test_fast_import_falls_back_on_invalid_block(tmp_path):
+    """Node-level fast/slow interplay: a blk file containing a valid chain,
+    an INVALID block (premature coinbase spend), then more valid blocks on
+    the honest tip. The native fast path must reject the bad block, defer
+    to the Python engine for the authoritative verdict, and keep importing
+    the valid remainder."""
+    import os
+
+    from bitcoincashplus_tpu.node.config import Config
+    from bitcoincashplus_tpu.node.node import Node
+    from bitcoincashplus_tpu.store.blockstore import BlockStore
+    from bitcoincashplus_tpu.store.chainstatedb import BlockIndexDB, CoinsDB
+    from bitcoincashplus_tpu.store.kvstore import KVStore
+    from bitcoincashplus_tpu.validation.chain import BlockStatus
+
+    net_dir = os.path.join(tmp_path, "regtest")
+    blocks_dir = os.path.join(net_dir, "blocks")
+    os.makedirs(blocks_dir, exist_ok=True)
+    index_kv = KVStore(os.path.join(blocks_dir, "index.sqlite"))
+    coins_kv = KVStore(os.path.join(net_dir, "chainstate.sqlite"))
+    store = BlockStore(net_dir, PARAMS.netmagic)
+    cs = ChainstateManager(PARAMS, CoinsDB(coins_kv), store,
+                           script_verifier=None,
+                           index_db=BlockIndexDB(index_kv))
+
+    t = PARAMS.genesis.header.time
+    coinbases = []
+    for _ in range(103):
+        t += 60
+        tip = cs.tip()
+        blk = _block(tip.hash, tip.height + 1, t, ())
+        cs.process_new_block(blk)
+        coinbases.append((blk.vtx[0].txid, blk.vtx[0].vout[0].value))
+
+    # invalid: spends the height-103 coinbase at height 104 (immature) —
+    # write the raw record into the blk file BEHIND the store's back
+    tip = cs.tip()
+    bad_spend = _spend([COutPoint(coinbases[-1][0], 0)], [coinbases[-1][1]])
+    t += 60
+    bad = _block(tip.hash, tip.height + 1, t, (bad_spend,))
+    # valid continuation on the same tip: spends the MATURE height-1 coin
+    good_spend = _spend([COutPoint(coinbases[0][0], 0)], [coinbases[0][1]])
+    good = _block(tip.hash, tip.height + 1, t + 60, (good_spend,))
+    raw_bad = bad.serialize()
+    raw_good = good.serialize()
+    with open(os.path.join(blocks_dir, "blk00000.dat"), "ab") as f:
+        f.write(PARAMS.netmagic + struct.pack("<I", len(raw_bad)) + raw_bad)
+        f.write(PARAMS.netmagic + struct.pack("<I", len(raw_good)) + raw_good)
+    cs.flush()
+    store.close()
+    index_kv.close()
+    coins_kv.close()
+
+    cfg = Config()
+    cfg.args["datadir"] = [str(tmp_path)]
+    cfg.args["regtest"] = ["1"]
+    cfg.args["reindex"] = ["1"]
+    node = Node(config=cfg)
+    try:
+        assert node.chainstate.tip().hash == good.get_hash()
+        bad_idx = node.chainstate.block_index.get(bad.get_hash())
+        assert bad_idx is not None
+        assert bad_idx.status & BlockStatus.FAILED_MASK
+        if node.last_import_stats:  # native path ran
+            assert node.last_import_stats["slow_path_blocks"] >= 1
+    finally:
+        node.close()
+
+
+@pytest.mark.skipif(not os.environ.get("BCP_SLOW_TESTS"),
+                    reason="slow randomized campaign (BCP_SLOW_TESTS=1)")
+def test_randomized_differential_campaign():
+    """170-block randomized stream (random input counts, fan-outs,
+    intra-block chains) through both engines: identical undo blobs and
+    final coin sets. Run with BCP_SLOW_TESTS=1 (several minutes)."""
+    import random
+
+    rng = random.Random(20260731)
+    chain = _Chain(runway=140)
+    heights = {txid: i + 1 for i, (txid, _v) in enumerate(chain.coinbases)}
+    for _bi in range(30):
+        txs = []
+        next_h = chain.cs.tip().height + 1
+        mature = [e for e in chain.coinbases
+                  if next_h - heights[e[0]] >= 100]
+        for _ in range(rng.randrange(1, 4)):
+            if not mature:
+                break
+            txid, value = mature.pop(rng.randrange(len(mature)))
+            chain.coinbases.remove((txid, value))
+            t = _spend([COutPoint(txid, 0)], [value],
+                       n_out=rng.randrange(1, 5))
+            txs.append(t)
+            if rng.random() < 0.5:
+                t2 = _spend([COutPoint(t.txid, 0)], [t.vout[0].value])
+                txs.append(t2)
+        blk = chain.push(txs)
+        assert chain.cs.tip().height == next_h
+        chain.coinbases.append((blk.vtx[0].txid, blk.vtx[0].vout[0].value))
+        heights[blk.vtx[0].txid] = next_h
+
+    eng = _engine_for(chain)
+    results = _replay(chain, eng)
+    assert all(chain.undo[res.block_hash] == res.undo for res in results)
+    chain.cs.coins.flush()
+    py = {op.hash + struct.pack("<I", op.n): c.serialize()
+          for op, c in chain.cs.coins.base.all_coins()}
+    py.pop(PARAMS.genesis.vtx[0].txid + struct.pack("<I", 0), None)
+    nat = {k: s for k, s in eng.flush_entries() if s is not None}
+    assert nat == py
     eng.close()
