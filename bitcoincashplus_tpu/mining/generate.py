@@ -13,7 +13,8 @@ from __future__ import annotations
 from typing import Optional
 
 from ..consensus.block import CBlock
-from ..ops.miner import DEFAULT_TILE, sweep_header
+from ..ops.dispatch import supervised_sweep
+from ..ops.miner import DEFAULT_TILE
 from ..validation.chainstate import ChainstateManager
 from .assembler import BlockAssembler, increment_extranonce
 
@@ -26,11 +27,16 @@ MAX_TRIES_DEFAULT = 1_000_000  # reference default nMaxTries
 def mine_block(assembler: BlockAssembler, script_pubkey: bytes,
                max_tries: int = MAX_TRIES_DEFAULT,
                tile: int = DEFAULT_TILE,
-               sweep=sweep_header,
+               sweep=None,
                time_override: Optional[int] = None) -> Optional[CBlock]:
     """Assemble + PoW-search one block. Returns the mined block or None if
     max_tries hashes were exhausted. `sweep` is injectable (single-chip
-    default; parallel.nonce_shard.sweep_header_sharded for a mesh)."""
+    default; parallel.nonce_shard.sweep_header_sharded for a mesh); the
+    default is the SUPERVISED single-chip sweep (ops/dispatch): a claimed
+    hit is host re-verified and a dead device degrades to the scalar CPU
+    loop under the miner circuit breaker."""
+    if sweep is None:
+        sweep = supervised_sweep()
     tmpl = assembler.create_new_block(script_pubkey, time_override)
     height, target = tmpl.height, tmpl.target
     block = tmpl.block
@@ -53,9 +59,11 @@ def mine_block(assembler: BlockAssembler, script_pubkey: bytes,
 def generate_blocks(chainstate: ChainstateManager, script_pubkey: bytes,
                     n_blocks: int, max_tries: int = MAX_TRIES_DEFAULT,
                     mempool=None, tile: int = DEFAULT_TILE,
-                    sweep=sweep_header) -> list[bytes]:
+                    sweep=None) -> list[bytes]:
     """generatetoaddress backend: mine and connect n_blocks, returning their
     hashes (wire order), like the RPC's JSON array of hex hashes."""
+    if sweep is None:
+        sweep = supervised_sweep()
     assembler = BlockAssembler(chainstate, mempool)
     hashes: list[bytes] = []
     for _ in range(n_blocks):

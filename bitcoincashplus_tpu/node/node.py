@@ -115,12 +115,16 @@ class Node:
         blocks_dir = os.path.join(self.datadir, "blocks")
         index_path = os.path.join(blocks_dir, "index.sqlite")
         coins_path = os.path.join(self.datadir, "chainstate.sqlite")
+        journal_path = os.path.join(self.datadir, "chainstate.journal")
         if reindex:
             # wipe the derived state; blk*.dat files are the source of truth
             for p in (index_path, coins_path):
                 for suffix in ("", "-wal", "-shm"):
                     if os.path.exists(p + suffix):
                         os.remove(p + suffix)
+            for p in (journal_path, journal_path + ".tmp"):
+                if os.path.exists(p):
+                    os.remove(p)
             # undo data is derived too: the import rebuilds every record,
             # and the wiped undo_positions would otherwise leave the old
             # records stranded in the rev files forever (the reference
@@ -144,7 +148,12 @@ class Node:
                                          128 * 1024 * 1024),
         )
         self.index_db = BlockIndexDB(self._index_kv)
-        self.coins_db = CoinsDB(self._coins_kv)
+        # journaled coins commits: every connect/disconnect batch is made
+        # durable (fsync-before-rename) before it touches the DB, and
+        # ChainstateManager replays/rolls back the journal at startup —
+        # a crash at ANY point inside a commit leaves the UTXO set at
+        # exactly the pre- or post-block state, never a torn mix
+        self.coins_db = CoinsDB(self._coins_kv, journal_path=journal_path)
 
         self.sigcache = SignatureCache()
         self.versionbits_cache = VersionBitsCache()
@@ -410,19 +419,23 @@ class Node:
         kernel (ops/sha256_sweep) on a real accelerator — bit-identical
         results via host re-verify, ~2x the generic sweep (ROOFLINE.md) —
         and the generic looped sweep on CPU, where the unrolled kernel's
-        XLA compile is pathologically slow (ops/sha256._use_unrolled)."""
+        XLA compile is pathologically slow (ops/sha256._use_unrolled).
+        Either choice runs under miner-breaker supervision
+        (ops/dispatch.supervised_sweep): failures degrade to the scalar
+        host loop without stalling block production."""
+        from ..ops.dispatch import supervised_sweep
+
+        inner = None
         try:
             from ..ops.sha256 import backend_is_cpu
 
             if not backend_is_cpu():
                 from ..ops.sha256_sweep import sweep_header_fast
 
-                return sweep_header_fast
+                inner = sweep_header_fast
         except Exception:
             pass
-        from ..ops.miner import sweep_header
-
-        return sweep_header
+        return supervised_sweep(inner)
 
     def generate_to_script(self, script_pubkey: bytes, n_blocks: int,
                            max_tries: int = MAX_TRIES_DEFAULT) -> list[bytes]:
@@ -598,7 +611,10 @@ class Node:
         # records across blocks and dispatch at AGG_LANES. Failure
         # granularity stays sound: a bad batch aborts to the Python
         # replay, which re-derives the exact offending block.
-        AGG_LANES = 8192
+        # 8190 = 8192-bucket minus the 2 supervised-dispatch KAT lanes
+        # (ops/ecdsa_batch appends them per batch; an exact-8192 slice
+        # would spill into the 10240 bucket and pay a fresh compile).
+        AGG_LANES = 8190
         agg: list[tuple] = []  # (pub, rs, msg, rn, wrap) per block
         agg_count = [0]
         agg_last_hash = [b""]
@@ -626,11 +642,12 @@ class Node:
                 inflight.append((agg_last_hash[0], handle))
                 pos += AGG_LANES
             if everything:
-                # drain the tail in <=2048-lane chunks: together with the
-                # exact 8192 slices this bounds the compiled-shape set to
-                # {8192, 2048, 1024} for the whole import
+                # drain the tail in <=2046-lane chunks (2048-bucket minus
+                # the KAT lanes): together with the AGG_LANES slices this
+                # bounds the compiled-shape set to {8192, 2048, 1024} for
+                # the whole import
                 while pos < total:
-                    end = min(pos + 2048, total)
+                    end = min(pos + 2046, total)
                     handle = ecdsa_batch.dispatch_packed(
                         *(a[pos:end] for a in arrays),
                         backend=self.backend if self.backend == "cpu"
@@ -879,45 +896,59 @@ class Node:
 
         from ..crypto.hashes import sha256d as sha256d_py
 
-        # enumerate the store's own blk files (reindex source of truth)
-        n_file = 0
-        while True:
-            path = os.path.join(self.datadir, "blocks",
-                                f"blk{n_file:05d}.dat")
-            if not os.path.exists(path):
-                break
-            with open(path, "rb") as f:
-                data = f.read()
-            pos = 0
-            blocks_since_flush = 0
-            while pos + 8 <= len(data):
-                if data[pos:pos + 4] != magic:
-                    pos += 1
-                    continue
-                (size,) = struct.unpack_from("<I", data, pos + 4)
-                start = pos + 8
-                if start + size > len(data):
-                    break  # truncated tail record (crash mid-append)
-                raw = data[start:start + size]
-                pos_info = (n_file, start, size)
-                stats["bytes"] += size
-                if process_raw(raw, pos_info):
-                    # cascade children parked on this block
-                    queue = [sha256d_py(raw[:80])]
-                    while queue:
-                        hh = queue.pop()
-                        for c_raw, c_pos in pending.pop(hh, ()):
-                            if process_raw(c_raw, c_pos):
-                                queue.append(sha256d_py(c_raw[:80]))
-                pos = start + size
-                blocks_since_flush += 1
-                if (blocks_since_flush >= flush_interval
-                        or eng.mem_bytes() >= dbcache_bytes):
-                    fast_flush()
-                    blocks_since_flush = 0
-            n_file += 1
+        # enumerate the store's own blk files (reindex source of truth).
+        # The whole walk is wrapped so an abort (settle_oldest raising
+        # _NativeImportAbort) still settles every in-flight BatchHandle —
+        # an abandoned handle would leak STATS.in_flight and, worse,
+        # strand the ecdsa breaker in HALF_OPEN forever if the dropped
+        # dispatch was its recovery probe (allow() blocks until the probe
+        # reports, and only handle settlement reports).
+        try:
+            n_file = 0
+            while True:
+                path = os.path.join(self.datadir, "blocks",
+                                    f"blk{n_file:05d}.dat")
+                if not os.path.exists(path):
+                    break
+                with open(path, "rb") as f:
+                    data = f.read()
+                pos = 0
+                blocks_since_flush = 0
+                while pos + 8 <= len(data):
+                    if data[pos:pos + 4] != magic:
+                        pos += 1
+                        continue
+                    (size,) = struct.unpack_from("<I", data, pos + 4)
+                    start = pos + 8
+                    if start + size > len(data):
+                        break  # truncated tail record (crash mid-append)
+                    raw = data[start:start + size]
+                    pos_info = (n_file, start, size)
+                    stats["bytes"] += size
+                    if process_raw(raw, pos_info):
+                        # cascade children parked on this block
+                        queue = [sha256d_py(raw[:80])]
+                        while queue:
+                            hh = queue.pop()
+                            for c_raw, c_pos in pending.pop(hh, ()):
+                                if process_raw(c_raw, c_pos):
+                                    queue.append(sha256d_py(c_raw[:80]))
+                    pos = start + size
+                    blocks_since_flush += 1
+                    if (blocks_since_flush >= flush_interval
+                            or eng.mem_bytes() >= dbcache_bytes):
+                        fast_flush()
+                        blocks_since_flush = 0
+                n_file += 1
 
-        fast_flush()
+            fast_flush()
+        finally:
+            while inflight:
+                _h, handle = inflight.pop(0)
+                try:
+                    handle.result()
+                except Exception:  # noqa: BLE001 — abort-path drain
+                    pass
         cs.activate_best_chain()  # safety: settle any side-chain candidates
         cs.flush()
         eng.close()

@@ -1,0 +1,340 @@
+"""Supervised backend dispatch — circuit breakers over every TPU crossing.
+
+Every consensus-relevant accelerator call (ops/sha256, ops/merkle,
+ops/miner, ops/ecdsa_batch) funnels through ``supervised_call``: bounded
+retries with jittered backoff absorb transient device errors; a per-
+subsystem circuit breaker opens after N consecutive hard failures and
+routes traffic to the reference CPU engine; probabilistic half-open probes
+re-test the device and close the breaker on recovery. Validation probes
+(known-answer lanes, witness pairs, hit re-verification) catch poisoned
+device output before it is trusted, and every REJECT-side verdict is
+additionally host-confirmed (ecdsa_batch False lanes, merkle_root
+mismatches/mutations, pow batch failures) — a degraded backend costs
+throughput, never correctness; the accept-side probes are defense-in-
+depth against faulty hardware rather than a proof against an
+adversarially crafted device.
+
+State is surfaced via rpc/control.py's ``gettpuinfo`` (breaker state, trip
+counts, fallback call/item tallies) and reset per test through
+``reset()``/``configure()``. No jax import at module level: validation/
+and the crash-test workers import this without touching the backend.
+
+Env knobs (read at configure time):
+    BCP_BREAKER_THRESHOLD  consecutive failures to open (default 3)
+    BCP_BREAKER_COOLDOWN   seconds open before probes start (default 5)
+    BCP_BREAKER_PROBE      half-open probe probability (default 0.25)
+    BCP_BREAKER_RETRIES    in-call retries before a failure counts (def. 1)
+    BCP_TPU_MERKLE_MIN     leaf count floor for the device Merkle path
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..util.faults import INJECTOR, Backoff, PoisonedOutput, retry_call
+from ..util.log import log_print, log_printf
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+
+@dataclass
+class BreakerConfig:
+    threshold: int = 3       # consecutive failures -> open
+    cooldown: float = 5.0    # seconds open before probes may fire
+    probe: float = 0.25      # half-open probe probability per allow()
+    retries: int = 1         # same-call retries before a failure counts
+    backoff_base: float = 0.02  # first retry delay (jittered, doubling)
+    seed: Optional[int] = None  # probe rng seed (tests)
+
+    @classmethod
+    def from_env(cls) -> "BreakerConfig":
+        g = os.environ.get
+        return cls(
+            threshold=int(g("BCP_BREAKER_THRESHOLD", "3")),
+            cooldown=float(g("BCP_BREAKER_COOLDOWN", "5")),
+            probe=float(g("BCP_BREAKER_PROBE", "0.25")),
+            retries=int(g("BCP_BREAKER_RETRIES", "1")),
+        )
+
+
+class CircuitBreaker:
+    """Per-subsystem failure gate (closed -> open -> half-open -> closed).
+
+    ``allow()`` answers "may this call try the device?"; callers then report
+    record_success()/record_failure(). While OPEN, allow() flips to a
+    HALF_OPEN probe with probability cfg.probe once the cooldown elapsed —
+    probabilistic probing keeps a recovering device from being stampeded by
+    every pending caller at once. Thread-safe: RPC threads and the P2P loop
+    read state while the validation thread dispatches."""
+
+    def __init__(self, name: str, cfg: Optional[BreakerConfig] = None,
+                 clock=time.monotonic):
+        self.name = name
+        self.cfg = cfg if cfg is not None else BreakerConfig.from_env()
+        self._clock = clock
+        self._rng = random.Random(self.cfg.seed)
+        self._lock = threading.Lock()
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.trips = 0            # times the breaker opened
+        self.opened_at = 0.0
+        self.probes = 0           # half-open probes attempted
+        self.recoveries = 0       # probes that closed the breaker
+        self.fallback_calls = 0   # calls routed to the CPU engine
+        self.fallback_items = 0   # items (sigs/hashes/leaves) in those calls
+        self.last_error = ""
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self.state == CLOSED:
+                return True
+            if self.state == OPEN:
+                if (self._clock() - self.opened_at >= self.cfg.cooldown
+                        and self._rng.random() < self.cfg.probe):
+                    self.state = HALF_OPEN
+                    self.probes += 1
+                    return True
+                return False
+            # HALF_OPEN: one probe in flight; everyone else stays on CPU
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self.state == HALF_OPEN:
+                self.recoveries += 1
+                log_printf("breaker %s: half-open probe succeeded — closed",
+                           self.name)
+            self.state = CLOSED
+            self.consecutive_failures = 0
+
+    def record_failure(self, err: Optional[BaseException] = None) -> None:
+        with self._lock:
+            self.consecutive_failures += 1
+            if err is not None:
+                self.last_error = f"{type(err).__name__}: {err}"[:200]
+            if self.state == HALF_OPEN or (
+                self.state == CLOSED
+                and self.consecutive_failures >= self.cfg.threshold
+            ):
+                reopened = self.state == HALF_OPEN
+                self.state = OPEN
+                self.opened_at = self._clock()
+                self.trips += 1
+                log_printf(
+                    "breaker %s: %s after %d consecutive failure(s) (%s)",
+                    self.name, "re-opened" if reopened else "OPEN",
+                    self.consecutive_failures, self.last_error)
+
+    def note_fallback(self, items: int = 1) -> None:
+        with self._lock:
+            self.fallback_calls += 1
+            self.fallback_items += max(0, int(items))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "state": self.state,
+                "consecutive_failures": self.consecutive_failures,
+                "trips": self.trips,
+                "probes": self.probes,
+                "recoveries": self.recoveries,
+                "fallback_calls": self.fallback_calls,
+                "fallback_items": self.fallback_items,
+                "last_error": self.last_error,
+            }
+
+
+_CONFIG = BreakerConfig.from_env()
+_BREAKERS: dict[str, CircuitBreaker] = {}
+_REG_LOCK = threading.Lock()
+
+
+def breaker(name: str) -> CircuitBreaker:
+    with _REG_LOCK:
+        br = _BREAKERS.get(name)
+        if br is None:
+            br = _BREAKERS[name] = CircuitBreaker(name, cfg=_CONFIG)
+        return br
+
+
+def configure(**kwargs) -> BreakerConfig:
+    """Replace the breaker config (tests: threshold/cooldown/probe/seed)
+    and rebuild the registry so it applies to every subsystem."""
+    global _CONFIG
+    base = BreakerConfig.from_env()
+    for k, v in kwargs.items():
+        setattr(base, k, v)
+    _CONFIG = base
+    with _REG_LOCK:
+        _BREAKERS.clear()
+    return base
+
+
+def reset() -> None:
+    """Drop all breaker state and re-read env config (test isolation)."""
+    global _CONFIG
+    _CONFIG = BreakerConfig.from_env()
+    with _REG_LOCK:
+        _BREAKERS.clear()
+
+
+def snapshot() -> dict:
+    """gettpuinfo's ``breakers`` section: every subsystem that has been
+    touched this process, keyed by name."""
+    with _REG_LOCK:
+        return {name: br.snapshot() for name, br in _BREAKERS.items()}
+
+
+def supervised_call(site: str, device_fn: Callable, cpu_fn: Callable,
+                    validate: Optional[Callable] = None,
+                    poison: Optional[Callable] = None,
+                    items: int = 1):
+    """Run one backend-crossing call under supervision.
+
+    device_fn() is attempted (with cfg.retries same-call retries and
+    jittered backoff between them) unless the breaker is open; its output
+    is passed through ``validate`` (a cheap host-side probe returning
+    truthy on sane output) before it is trusted. Any exception or failed
+    validation after the retries counts one breaker failure and the call
+    is served by cpu_fn() instead. ``poison`` is the fault harness's
+    output-corruption hook (applied when BCP_FAULT_MODE=poison-output is
+    armed for this site) — it exists so tests can prove the validation
+    probe actually gates the verdict path.
+
+    Returns (result, used_device)."""
+    br = breaker(site)
+    if br.allow():
+        def attempt():
+            INJECTOR.on_call(site)
+            out = device_fn()
+            if poison is not None and INJECTOR.should_poison(site):
+                out = poison(out)
+            if validate is not None and not validate(out):
+                raise PoisonedOutput(
+                    f"{site}: device output failed validation probe")
+            return out
+
+        try:
+            out = retry_call(
+                attempt, attempts=br.cfg.retries + 1,
+                backoff=Backoff(base=br.cfg.backoff_base, maximum=1.0),
+            )
+            br.record_success()
+            return out, True
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # noqa: BLE001 — breaker boundary
+            br.record_failure(e)
+            log_print("tpu", "%s device call failed (%s) — CPU fallback",
+                      site, e)
+    br.note_fallback(items)
+    return cpu_fn(), False
+
+
+# ---------------------------------------------------------------------------
+# Subsystem front doors used by validation/ and mining/ (lazy device import
+# so CPU-only paths and crash-test workers never touch jax).
+# ---------------------------------------------------------------------------
+
+def _merkle_device_min() -> int:
+    """Leaf-count floor for the device Merkle path: below it the dispatch
+    round trip loses to the host loop (and ordinary regtest blocks stay on
+    the byte-exact CPU reference)."""
+    return int(os.environ.get("BCP_TPU_MERKLE_MIN", "512"))
+
+
+def merkle_root(hashes: list, expected: Optional[bytes] = None) -> tuple:
+    """Supervised Merkle root: device tree-reduction for large leaf sets,
+    reference CPU loop otherwise (and whenever the merkle breaker is
+    open).
+
+    ``expected`` is the caller's claimed root (the block header's). A
+    device result is never the sole basis for a VERDICT CHANGE in either
+    direction:
+
+    - reject side: a device root mismatch or mutated=True is confirmed by
+      a full CPU recompute before it is returned — the witness probe
+      catches gross corruption cheaply, but a single corrupted interior
+      lane could otherwise pass it and make a lying device reject a valid
+      block (forking the node off the honest chain);
+    - accept side: a device mutated=False is only trusted when the leaf
+      set has no duplicates. Equal interior nodes require equal leaf
+      subsequences (absent SHA-256 collisions), so distinct leaves imply
+      no CVE-2012-2459 mutation; any duplicate leaf forces the CPU
+      reference to produce the flag.
+
+    A bad device may cost one CPU recompute, never a verdict."""
+    if len(hashes) >= _merkle_device_min():
+        from ..consensus.merkle import compute_merkle_root
+        from .merkle import compute_merkle_root_tpu_ex
+
+        root, mutated, used_device = compute_merkle_root_tpu_ex(hashes)
+        if used_device and (
+            mutated
+            or (expected is not None and root != expected)
+            or len(set(hashes)) != len(hashes)
+        ):
+            return compute_merkle_root(hashes)
+        return root, mutated
+    from ..consensus.merkle import compute_merkle_root
+
+    return compute_merkle_root(hashes)
+
+
+def block_merkle_root(block) -> tuple:
+    """BlockMerkleRoot through the supervised chooser (chainstate's
+    check_block entry); the header's claimed root gates reject-path
+    CPU confirmation."""
+    return merkle_root([tx.txid for tx in block.vtx],
+                       expected=block.header.hash_merkle_root)
+
+
+def supervised_sweep(inner=None):
+    """Wrap a PoW sweep implementation (ops/miner.sweep_header,
+    ops/sha256_sweep.sweep_header_fast, or the multi-chip shard) in miner
+    supervision: a claimed hit is re-verified on host before it is trusted
+    (2 hashes — free next to a sweep), and failures degrade to the scalar
+    CPU loop, the reference generateBlocks inner loop. Returns a callable
+    with the sweep_header signature."""
+    def sweep(header80: bytes, target: int, start_nonce: int = 0,
+              max_nonces: int = 1 << 32, tile: Optional[int] = None):
+        from ..crypto.hashes import sha256d
+        from .miner import DEFAULT_TILE, sweep_header_cpu
+
+        dev = inner
+        if dev is None:
+            from .miner import sweep_header as dev  # noqa: PLC0415
+
+        eff_tile = DEFAULT_TILE if tile is None else tile
+
+        def device():
+            return dev(header80, target, start_nonce=start_nonce,
+                       max_nonces=max_nonces, tile=eff_tile)
+
+        def cpu():
+            return sweep_header_cpu(header80, target, start_nonce=start_nonce,
+                                    max_nonces=max_nonces)
+
+        def validate(res):
+            nonce, _hashes = res
+            if nonce is None:
+                return True  # a missed hit costs work, never consensus
+            hdr = header80[:76] + int(nonce).to_bytes(4, "little")
+            return int.from_bytes(sha256d(hdr), "little") <= target
+
+        def poison(res):
+            nonce, hashes = res
+            bad = (nonce ^ 1) if nonce is not None else start_nonce
+            return (bad & 0xFFFFFFFF, hashes)
+
+        out, _ = supervised_call("miner", device, cpu,
+                                 validate=validate, poison=poison,
+                                 items=1)
+        return out
+
+    return sweep

@@ -25,11 +25,14 @@ from __future__ import annotations
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 
 from ..crypto import secp256k1 as oracle
+from ..util.faults import INJECTOR, Backoff, PoisonedOutput
+from ..util.log import log_printf
+from . import dispatch
 
 # Pad-to-bucket sizes (SURVEY.md §8.4 dispatch layer). One compiled
 # executable per bucket; persistent across blocks via jit cache.
@@ -61,6 +64,15 @@ class BatchStats:
     # w4 kernel lanes flagged degenerate (adversarially-crafted H == 0
     # collisions) and re-verified on the CPU path — see ops/secp256k1.py
     degenerate_rechecks: int = 0
+    # supervised-dispatch accounting (ops/dispatch breaker layer): sigs
+    # re-verified on the CPU engine because the device path failed or its
+    # known-answer lanes came back wrong. NOTE sigs_padded includes the 2
+    # KAT lanes riding every device batch.
+    fault_fallback_sigs: int = 0
+    kat_failures: int = 0
+    # device-False lanes host-confirmed before they could reject a block
+    # (reject-side verdicts are never the device's alone to make)
+    reject_confirm_sigs: int = 0
     buckets_used: dict = field(default_factory=dict)
 
     def snapshot(self) -> dict:
@@ -272,6 +284,34 @@ def _verify_cpu(records: Sequence) -> np.ndarray:
     )
 
 
+_KAT = None
+
+
+def _kat_records() -> tuple:
+    """Known-answer probe lanes appended to every device batch: one
+    signature that MUST verify and one that MUST NOT (same sig, different
+    message). A device that inverts, zeroes, or fabricates the validity
+    mask gets both polarities wrong-side and the batch is discarded before
+    any verdict can see it (BatchHandle.result's KAT gate). Generated once
+    from the Python-int oracle."""
+    global _KAT
+    if _KAT is None:
+        import hashlib
+
+        from ..script.interpreter import SigCheckRecord
+
+        d = 0x1D3F2A9C5B7E6D4F8A1B2C3D4E5F60718293A4B5C6D7E8F9
+        e = int.from_bytes(
+            hashlib.sha256(b"bcp-supervised-dispatch-kat").digest(), "big"
+        ) % oracle.N
+        r, s = oracle.ecdsa_sign(d, e)
+        pub = oracle.point_mul(d, oracle.G)
+        good = SigCheckRecord(pub, r, s, e)
+        bad = SigCheckRecord(pub, r, s, (e + 1) % oracle.N)
+        _KAT = (good, bad)
+    return _KAT
+
+
 def _device_available() -> bool:
     """True when the JAX backend is worth dispatching to. An accelerator
     always is. When JAX is CPU-only, the XLA form of the verify kernel is
@@ -304,25 +344,65 @@ class BatchHandle:
     the device computation enqueued, and the host keeps interpreting the
     next transactions' scripts while the chip verifies — the CCheckQueue
     master/worker overlap, with XLA's async runtime as the worker pool.
-    `.result()` materializes (blocks) and finalizes stats."""
+    `.result()` materializes (blocks) and finalizes stats.
+
+    Supervision (ops/dispatch): device-path handles carry the records and
+    the ecdsa breaker; a materialization error or a wrong known-answer
+    lane at settle time counts a breaker failure and the verdict is a
+    FRESH CPU re-verification of the real records — never a cached or
+    fabricated mask."""
 
     __slots__ = ("_n", "_bucket", "_device_ok", "_cpu_ok", "_degen",
-                 "_records")
+                 "_records", "_breaker", "_kat", "_recover")
 
     def __init__(self, n, bucket=0, device_ok=None, cpu_ok=None,
-                 degen=None, records=None):
+                 degen=None, records=None, breaker=None, kat=False,
+                 recover=None):
         self._n = n
         self._bucket = bucket
         self._device_ok = device_ok
         self._cpu_ok = cpu_ok
         self._degen = degen
         self._records = records
+        self._breaker = breaker
+        self._kat = kat
+        self._recover = recover  # fast whole-batch CPU verdict (packed)
+
+    def _device_failed(self, err: BaseException) -> np.ndarray:
+        """Settle-time device failure: breaker bookkeeping + CPU re-verify
+        of the real lanes (the verdict that reaches the caller is computed
+        by the reference engine, not recycled device output)."""
+        if self._breaker is not None:
+            self._breaker.record_failure(err)
+            self._breaker.note_fallback(self._n)
+        STATS.cpu_fallback_sigs += self._n
+        STATS.fault_fallback_sigs += self._n
+        log_printf("ecdsa device batch failed at settle (%s: %s) — CPU "
+                   "re-verify of %d sig(s)",
+                   type(err).__name__, str(err)[:120], self._n)
+        if self._recover is not None:
+            # packed batches carry a fast whole-batch CPU path (native
+            # threaded verify over the original blobs)
+            out = self._recover()
+        else:
+            out = _verify_cpu([self._records[i] for i in range(self._n)])
+        self._degen = None
+        self._records = None
+        self._cpu_ok = np.asarray(out, dtype=bool)
+        return self._cpu_ok
 
     def result(self) -> np.ndarray:
         if self._device_ok is None:
             return self._cpu_ok
         t0 = time.monotonic()
-        ok = np.asarray(self._device_ok)  # blocks until the chip finishes
+        try:
+            ok = np.asarray(self._device_ok)  # blocks until the chip finishes
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # device died between enqueue and settle
+            STATS.in_flight = max(0, STATS.in_flight - 1)
+            self._device_ok = None
+            return self._device_failed(e)
         # device_seconds counts only the blocking wait — when the P3
         # overlap is doing its job the host hid the latency and this is
         # near zero; summing dispatch->settle spans would double-count
@@ -330,6 +410,17 @@ class BatchHandle:
         STATS.device_seconds += time.monotonic() - t0
         STATS.in_flight = max(0, STATS.in_flight - 1)
         self._device_ok = None
+        ok = np.asarray(ok, dtype=bool)
+        if INJECTOR.should_poison("ecdsa"):
+            ok = ~ok
+        if self._kat:
+            # known-answer gate: lanes n and n+1 are the good/bad probe
+            # records appended at dispatch; both polarities must be right
+            # before ANY lane of this batch is trusted
+            if not bool(ok[self._n]) or bool(ok[self._n + 1]):
+                STATS.kat_failures += 1
+                return self._device_failed(
+                    PoisonedOutput("ecdsa known-answer lanes wrong"))
         out = ok[: self._n].copy()
         if self._degen is not None:
             # w4 kernel: degenerate lanes (adversarial H == 0 collisions)
@@ -342,7 +433,21 @@ class BatchHandle:
                 redo = _verify_cpu([self._records[i] for i in idxs])
                 out[idxs] = redo
             self._degen = None
-            self._records = None
+        if self._records is not None:
+            # reject-side host confirmation: a device False is never
+            # allowed to reject a block on its own (the KAT lanes can't
+            # see a single corrupted real lane) — same contract as the
+            # pow.py batch check and dispatch.merkle_root. Honest-valid
+            # blocks have zero False lanes, so this is free in the common
+            # case; an invalid-sig block pays one oracle verify per bad
+            # lane, which the pure-CPU reference paid anyway.
+            bad = np.nonzero(~out)[0]
+            if bad.size:
+                STATS.reject_confirm_sigs += int(bad.size)
+                out[bad] = _verify_cpu([self._records[i] for i in bad])
+        if self._breaker is not None:
+            self._breaker.record_success()
+        self._records = None
         self._cpu_ok = out
         return self._cpu_ok
 
@@ -351,48 +456,93 @@ def dispatch_batch(records: Sequence, backend: str = "auto") -> BatchHandle:
     """Enqueue a verify batch without waiting; returns a BatchHandle.
 
     backend: "auto" (device if available and batch >= CPU_FLOOR),
-    "device" (force), "cpu" (force oracle — synchronous)."""
+    "device" (force), "cpu" (force oracle — synchronous).
+
+    The device leg is supervised (ops/dispatch): the ecdsa circuit breaker
+    gates it, bounded retries absorb transient dispatch errors, and a
+    failed dispatch degrades to a fresh CPU verification of the same
+    records — the verdict the caller sees is never dropped or fabricated."""
     if not records:
         return BatchHandle(0, cpu_ok=np.zeros(0, bool))
+    n = len(records)
     use_device = backend == "device" or (
         backend == "auto"
-        and len(records) >= CPU_FLOOR
+        and n >= CPU_FLOOR
         and _device_available()
     )
-    if not use_device:
-        STATS.cpu_fallback_sigs += len(records)
-        return BatchHandle(len(records), cpu_ok=_verify_cpu(records))
+    if use_device:
+        br = dispatch.breaker("ecdsa")
+        if br.allow():
+            handle = _dispatch_device(records, br)
+            if handle is not None:
+                return handle
+            # device leg failed after retries (breaker already charged):
+            # fresh CPU re-verification, counted as fault fallback
+            STATS.fault_fallback_sigs += n
+        else:
+            br.note_fallback(n)
+            STATS.fault_fallback_sigs += n
+    STATS.cpu_fallback_sigs += n
+    return BatchHandle(n, cpu_ok=_verify_cpu(records))
 
+
+def _dispatch_device(records: Sequence, br) -> Optional[BatchHandle]:
+    """One supervised device enqueue attempt (with retries). Returns None
+    when every attempt failed — the caller owns the CPU fallback. Two
+    known-answer lanes (good + bad signature) ride after the real records
+    so BatchHandle.result can detect a lying validity mask."""
     from . import secp256k1 as dev
 
-    device_ok = degen = None
-    if pallas_enabled():
-        bucket = _bucket_for(len(records), pallas=True)
+    wire = list(records) + list(_kat_records())
+    boff = Backoff(base=br.cfg.backoff_base, maximum=1.0)
+    last: Optional[BaseException] = None
+    for attempt in range(br.cfg.retries + 1):
         try:
-            if bucket % 1024 == 0:
-                # single-dispatch byte pipeline: (rows, 8, 128) exact-vreg
-                # tiles over a grid, device-side expansion — the whole
-                # batch is one program/round trip (ops/secp256k1.py)
-                arrays = pack_records_w4_bytes(records, bucket)
-                device_ok, degen = dev.ecdsa_verify_batch_pallas_w4_bytes(
-                    *arrays
-                )
-            else:
-                arrays = pack_records_w4(records, bucket)
-                device_ok, degen = dev.ecdsa_verify_batch_pallas_w4(
-                    *map(np.asarray, arrays)
-                )
-        except Exception as e:
-            _note_pallas_failure(e)
-            device_ok = None
-    if device_ok is None:
-        bucket = _bucket_for(len(records), pallas=False)
-        arrays = pack_records(records, bucket)
-        device_ok = dev.ecdsa_verify_batch_jit(*map(np.asarray, arrays))
-    _note_device_dispatch(len(records), bucket)
-    return BatchHandle(len(records), bucket, device_ok,
-                       degen=degen, records=records if degen is not None
-                       else None)
+            INJECTOR.on_call("ecdsa")
+            device_ok = degen = None
+            if pallas_enabled():
+                bucket = _bucket_for(len(wire), pallas=True)
+                try:
+                    if bucket % 1024 == 0:
+                        # single-dispatch byte pipeline: (rows, 8, 128)
+                        # exact-vreg tiles over a grid, device-side
+                        # expansion — the whole batch is one program/round
+                        # trip (ops/secp256k1.py)
+                        arrays = pack_records_w4_bytes(wire, bucket)
+                        device_ok, degen = \
+                            dev.ecdsa_verify_batch_pallas_w4_bytes(*arrays)
+                    else:
+                        arrays = pack_records_w4(wire, bucket)
+                        device_ok, degen = dev.ecdsa_verify_batch_pallas_w4(
+                            *map(np.asarray, arrays)
+                        )
+                except Exception as e:
+                    _note_pallas_failure(e)
+                    device_ok = None
+            if device_ok is None:
+                bucket = _bucket_for(len(wire), pallas=False)
+                arrays = pack_records(wire, bucket)
+                device_ok = dev.ecdsa_verify_batch_jit(
+                    *map(np.asarray, arrays))
+            _note_device_dispatch(len(records), bucket)
+            return BatchHandle(len(records), bucket, device_ok, degen=degen,
+                               records=wire, breaker=br, kat=True)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except (NameError, AttributeError, UnboundLocalError):
+            # programming errors must not degrade silently to the CPU
+            # engine forever — same invariant as _note_pallas_failure
+            raise
+        except Exception as e:  # noqa: BLE001 — supervised boundary
+            last = e
+            if attempt < br.cfg.retries:
+                time.sleep(boff.next())
+    br.record_failure(last)
+    br.note_fallback(len(records))
+    log_printf("ecdsa device dispatch failed (%s: %s) — CPU fallback for "
+               "%d sig(s)", type(last).__name__, str(last)[:120],
+               len(records))
+    return None
 
 
 _PALLAS_BROKEN = False
@@ -502,7 +652,9 @@ def dispatch_packed(pub: np.ndarray, rs: np.ndarray, msg: np.ndarray,
                     backend: str = "auto") -> BatchHandle:
     """Enqueue a packed verify batch: pub (n,64), rs (n,64), msg (n,32),
     rn (n,32), wrap (n,) — all uint8, big-endian fields, caller-validated
-    ranges (1 <= r,s < N; pubkey on-curve affine)."""
+    ranges (1 <= r,s < N; pubkey on-curve affine). Device leg is breaker-
+    supervised like dispatch_batch (same KAT lanes, same CPU re-verify on
+    failure)."""
     from .. import native
 
     n = len(msg)
@@ -512,54 +664,129 @@ def dispatch_packed(pub: np.ndarray, rs: np.ndarray, msg: np.ndarray,
         backend == "auto" and n >= PACKED_DEVICE_FLOOR and _device_available()
     )
     if not use_device and native.available():
-        STATS.cpu_fallback_sigs += n
-        ok = native.ecdsa_verify_batch_blobs(
-            pub.tobytes(), rs.tobytes(), msg.tobytes(), n)
-        return BatchHandle(n, cpu_ok=np.asarray(ok, bool))
+        return _packed_cpu_handle(pub, rs, msg, n)
     if not (use_device and pallas_enabled()):
         # XLA fallback (pallas broken / no native lib): go through the
         # record-level path — rare, and it keeps one source of truth
         recs = _LazyRecords(pub, rs, msg)
         return dispatch_batch([recs[i] for i in range(n)], backend=backend)
 
+    br = dispatch.breaker("ecdsa")
+    if not br.allow():
+        br.note_fallback(n)
+        STATS.fault_fallback_sigs += n
+        return _packed_cpu_handle(pub, rs, msg, n)
+    handle = _dispatch_packed_device(pub, rs, msg, rn, wrap, n, br)
+    if handle is None:
+        STATS.fault_fallback_sigs += n
+        return _packed_cpu_handle(pub, rs, msg, n)
+    return handle
+
+
+def _packed_cpu_handle(pub, rs, msg, n: int) -> BatchHandle:
+    """CPU verdict for a packed batch (native threaded verify when the
+    library loaded, Python-int oracle otherwise)."""
+    from .. import native
+
+    STATS.cpu_fallback_sigs += n
+    if native.available():
+        ok = native.ecdsa_verify_batch_blobs(
+            pub.tobytes(), rs.tobytes(), msg.tobytes(), n)
+        return BatchHandle(n, cpu_ok=np.asarray(ok, bool))
+    recs = _LazyRecords(pub, rs, msg)
+    return BatchHandle(n, cpu_ok=_verify_cpu([recs[i] for i in range(n)]))
+
+
+def _dispatch_packed_device(pub, rs, msg, rn, wrap, n: int,
+                            br) -> Optional[BatchHandle]:
+    """Supervised packed enqueue (retries + KAT lanes); None when every
+    attempt failed."""
+    from .. import native
     from . import secp256k1 as dev
 
-    bucket = max(1024, _bucket_for(n, pallas=True))
+    # KAT probe lanes appended after the real records (blob layout)
+    kpub, krs, kmsg, krn, kwrap = records_to_blobs(list(_kat_records()))
+    pub2 = np.concatenate([pub, kpub])
+    rs2 = np.concatenate([rs, krs])
+    msg2 = np.concatenate([msg, kmsg])
+    rn2 = np.concatenate([rn, krn])
+    wrap2 = np.concatenate([np.asarray(wrap, np.uint8), kwrap])
+    m = n + 2
+    bucket = max(1024, _bucket_for(m, pallas=True))
 
     def pad(mat: np.ndarray, width: int) -> np.ndarray:
         out = np.zeros((bucket, width), np.uint8)
-        out[:n] = mat
+        out[:m] = mat
         return out
 
-    # u1/u2 via the threaded native modular-inverse leg; Python-int loop
-    # only if the native library is missing
-    if native.available():
-        u1_blob, u2_blob, ok = native.ecdsa_precompute_blobs(
-            rs.tobytes(), msg.tobytes(), n)
-        u1 = np.frombuffer(u1_blob, np.uint8).reshape(n, 32)
-        u2 = np.frombuffer(u2_blob, np.uint8).reshape(n, 32)
-        range_bad = ~np.asarray(ok, bool)
-    else:
-        recs = _LazyRecords(pub, rs, msg)
-        scalars = decompose_scalars([recs[i] for i in range(n)])
-        u1 = np.frombuffer(b"".join(a.to_bytes(32, "big") for a, _ in scalars),
-                           np.uint8).reshape(n, 32)
-        u2 = np.frombuffer(b"".join(b.to_bytes(32, "big") for _, b in scalars),
-                           np.uint8).reshape(n, 32)
-        range_bad = np.zeros(n, bool)
-    q_inf = np.ones(bucket, np.uint8)
-    q_inf[:n] = range_bad.astype(np.uint8)
-    wrap8 = np.zeros(bucket, np.uint8)
-    wrap8[:n] = wrap
-    try:
-        device_ok, degen = dev.ecdsa_verify_batch_pallas_w4_bytes(
-            pad(u1, 32), pad(u2, 32), pad(pub[:, :32], 32),
-            pad(pub[:, 32:], 32), q_inf, pad(rs[:, :32], 32),
-            pad(rn, 32), wrap8)
-    except Exception as e:
-        _note_pallas_failure(e)
-        recs = _LazyRecords(pub, rs, msg)
-        return dispatch_batch([recs[i] for i in range(n)], backend=backend)
-    _note_device_dispatch(n, bucket)
-    return BatchHandle(n, bucket, device_ok, degen=degen,
-                       records=_LazyRecords(pub, rs, msg))
+    boff = Backoff(base=br.cfg.backoff_base, maximum=1.0)
+    last: Optional[BaseException] = None
+    for attempt in range(br.cfg.retries + 1):
+        try:
+            INJECTOR.on_call("ecdsa")
+            # u1/u2 via the threaded native modular-inverse leg;
+            # Python-int loop only if the native library is missing
+            if native.available():
+                u1_blob, u2_blob, ok = native.ecdsa_precompute_blobs(
+                    rs2.tobytes(), msg2.tobytes(), m)
+                u1 = np.frombuffer(u1_blob, np.uint8).reshape(m, 32)
+                u2 = np.frombuffer(u2_blob, np.uint8).reshape(m, 32)
+                range_bad = ~np.asarray(ok, bool)
+            else:
+                recs = _LazyRecords(pub2, rs2, msg2)
+                scalars = decompose_scalars([recs[i] for i in range(m)])
+                u1 = np.frombuffer(
+                    b"".join(a.to_bytes(32, "big") for a, _ in scalars),
+                    np.uint8).reshape(m, 32)
+                u2 = np.frombuffer(
+                    b"".join(b.to_bytes(32, "big") for _, b in scalars),
+                    np.uint8).reshape(m, 32)
+                range_bad = np.zeros(m, bool)
+            q_inf = np.ones(bucket, np.uint8)
+            q_inf[:m] = range_bad.astype(np.uint8)
+            wrap8 = np.zeros(bucket, np.uint8)
+            wrap8[:m] = wrap2
+            try:
+                device_ok, degen = dev.ecdsa_verify_batch_pallas_w4_bytes(
+                    pad(u1, 32), pad(u2, 32), pad(pub2[:, :32], 32),
+                    pad(pub2[:, 32:], 32), q_inf, pad(rs2[:, :32], 32),
+                    pad(rn2, 32), wrap8)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:
+                # pallas bookkeeping scoped to the KERNEL call only — a
+                # failure in the precompute/pack legs above must not
+                # latch _PALLAS_BROKEN (may re-raise programming errors)
+                _note_pallas_failure(e)
+                raise
+            _note_device_dispatch(n, bucket)
+
+            def recover() -> np.ndarray:
+                # settle-time failure on a packed batch: the native
+                # threaded verify over the original blobs beats walking
+                # _LazyRecords through the Python-int oracle by orders of
+                # magnitude at reindex batch sizes
+                if native.available():
+                    return np.asarray(native.ecdsa_verify_batch_blobs(
+                        pub.tobytes(), rs.tobytes(), msg.tobytes(), n),
+                        bool)
+                recs = _LazyRecords(pub, rs, msg)
+                return _verify_cpu([recs[i] for i in range(n)])
+
+            return BatchHandle(n, bucket, device_ok, degen=degen,
+                               records=_LazyRecords(pub2, rs2, msg2),
+                               breaker=br, kat=True, recover=recover)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except (NameError, AttributeError, UnboundLocalError):
+            raise  # programming errors must not degrade silently
+        except Exception as e:  # noqa: BLE001 — supervised boundary
+            last = e
+            if attempt < br.cfg.retries:
+                time.sleep(boff.next())
+    br.record_failure(last)
+    br.note_fallback(n)
+    log_printf("ecdsa packed device dispatch failed (%s: %s) — CPU "
+               "fallback for %d sig(s)", type(last).__name__,
+               str(last)[:120], n)
+    return None

@@ -56,6 +56,7 @@ def _tree_reduce_jit(words, n_levels: int, m):
     """
     level = [words[:, i] for i in range(8)]  # column-major: 8 arrays (B,)
     mutated = jnp.zeros((), dtype=bool)
+    witness = None  # first-level pair-0 hash — the host validation probe
     for k in range(n_levels):
         half = 1 << (n_levels - k - 1)
         pair_idx = jnp.arange(half, dtype=jnp.uint32)
@@ -70,6 +71,11 @@ def _tree_reduce_jit(words, n_levels: int, m):
         right = [jnp.where(dup, l_col, r_col)
                  for l_col, r_col in zip(left, right)]
         hashed = sha256d_64(left + right)
+        if k == 0:
+            # pair 0 of level 1 = sha256d(leaf0 || leaf1): recomputable on
+            # host in 2 hashes, so the caller can prove the device actually
+            # ran SHA rounds (poisoned-output detection, ops/dispatch)
+            witness = jnp.stack([c[0] for c in hashed], axis=-1)
         # the bucket can be taller than the real tree: once the live count
         # reaches 1 the root rides through untouched instead of being
         # self-hashed up the remaining levels
@@ -77,32 +83,74 @@ def _tree_reduce_jit(words, n_levels: int, m):
         level = [jnp.where(done, l_col, h_col)
                  for l_col, h_col in zip(left, hashed)]
         m = jnp.where(done, m, (m + 1) // 2)
-    return jnp.stack(level, axis=-1)[0], mutated
+    return jnp.stack(level, axis=-1)[0], mutated, witness
 
 
 def compute_merkle_root_tpu(hashes: list[bytes]) -> tuple[bytes, bool]:
-    """Drop-in for consensus.merkle.compute_merkle_root on large inputs.
+    """Drop-in for consensus.merkle.compute_merkle_root on large inputs
+    (see compute_merkle_root_tpu_ex for the full contract)."""
+    root, mutated, _used_device = compute_merkle_root_tpu_ex(hashes)
+    return root, mutated
 
-    Returns (root, mutated). The whole log2(n)-level tree runs as a single
+
+def compute_merkle_root_tpu_ex(hashes: list[bytes]) -> tuple:
+    """Supervised device Merkle root: (root, mutated, used_device) —
+    used_device is False whenever the CPU reference produced the result
+    (small input, open breaker, or fallback), letting callers skip their
+    own CPU confirmation.
+
+    The whole log2(n)-level tree runs as a single
     device dispatch (dispatch latency dominated the old per-level loop —
     12 round-trips for 4k txids); compilation is bounded by the number of
     distinct pow2 buckets, not tx counts.
+
+    Supervised (ops/dispatch): the device also returns the level-1 pair-0
+    node, which the host recomputes in 2 hashes — a device that didn't
+    really run the SHA rounds (or a poisoned output) is caught and the
+    call degrades to the CPU reference loop, verdict unchanged.
     """
+    from ..consensus.merkle import compute_merkle_root
+    from ..crypto.hashes import sha256d
+    from . import dispatch
+
     if not hashes:
-        return b"\x00" * 32, False
+        return b"\x00" * 32, False, False
     if len(hashes) == 1:
-        return hashes[0], False
+        return hashes[0], False, False
     n = len(hashes)
-    bucket = max(PAD_LANES, 1 << (n - 1).bit_length())
-    words = _digests_to_words(
-        np.frombuffer(b"".join(hashes), dtype=np.uint8).reshape(-1, 32)
-    )
-    if bucket != n:
-        words = np.concatenate(
-            [words, np.zeros((bucket - n, 8), dtype=np.uint32)], axis=0
+
+    def device():
+        bucket = max(PAD_LANES, 1 << (n - 1).bit_length())
+        words = _digests_to_words(
+            np.frombuffer(b"".join(hashes), dtype=np.uint8).reshape(-1, 32)
         )
-    root_words, mutated = _tree_reduce_jit(
-        jnp.asarray(words), bucket.bit_length() - 1, jnp.uint32(n)
+        if bucket != n:
+            words = np.concatenate(
+                [words, np.zeros((bucket - n, 8), dtype=np.uint32)], axis=0
+            )
+        root_words, mutated, witness = _tree_reduce_jit(
+            jnp.asarray(words), bucket.bit_length() - 1, jnp.uint32(n)
+        )
+        root = np.asarray(root_words, dtype=np.uint32)
+        wit = np.asarray(witness, dtype=np.uint32)
+        return (_words_to_digests(root[None, :])[0].tobytes(), bool(mutated),
+                _words_to_digests(wit[None, :])[0].tobytes())
+
+    def validate(res) -> bool:
+        _root, _mut, witness = res
+        return witness == sha256d(hashes[0] + hashes[1])
+
+    def poison(res):
+        root, mut, witness = res
+        flip = bytes(b ^ 0xFF for b in root)
+        return flip, mut, bytes(b ^ 0xFF for b in witness)
+
+    out, used_device = dispatch.supervised_call(
+        "merkle", device, lambda: compute_merkle_root(hashes),
+        validate=validate, poison=poison, items=n,
     )
-    root = np.asarray(root_words, dtype=np.uint32)
-    return _words_to_digests(root[None, :])[0].tobytes(), bool(mutated)
+    if used_device:
+        root, mutated, _witness = out
+        return root, mutated, True
+    root, mutated = out
+    return root, mutated, False

@@ -82,6 +82,25 @@ def sweep_jit(midstate, tail, target_limbs, start_nonce, n_tiles, tile: int = DE
     return found, nonce, tiles
 
 
+def sweep_header_cpu(header80: bytes, target: int, start_nonce: int = 0,
+                     max_nonces: int = 1 << 32):
+    """Scalar host sweep — the reference generateBlocks inner loop
+    (src/rpc/mining.cpp:~120) verbatim. This is the degraded-mode engine
+    the miner circuit breaker falls back to when the device path is dead
+    (ops/dispatch.supervised_sweep); same contract as sweep_header: first
+    hit in nonce order wins, (nonce | None, hashes_attempted)."""
+    from ..crypto.hashes import sha256d
+
+    assert len(header80) == 80
+    base = header80[:76]
+    for i in range(max_nonces):
+        nonce = (start_nonce + i) & 0xFFFFFFFF
+        h = sha256d(base + nonce.to_bytes(4, "little"))
+        if int.from_bytes(h, "little") <= target:
+            return nonce, i + 1
+    return None, max_nonces
+
+
 def sweep_header(header80: bytes, target: int, start_nonce: int = 0,
                  max_nonces: int = 1 << 32, tile: int = DEFAULT_TILE):
     """Host API: search for a nonce making sha256d(header) <= target.

@@ -299,8 +299,42 @@ def check_headers_pow_jit(words20, target_limbs):
     return jnp.stack(h8, axis=-1), ok
 
 
+def sha256d_headers_cpu(headers: np.ndarray) -> np.ndarray:
+    """Reference CPU engine for the batch header hash — the sha256 circuit
+    breaker's fallback target (ops/dispatch)."""
+    from ..crypto.hashes import sha256d
+
+    return np.frombuffer(
+        b"".join(sha256d(headers[i].tobytes())
+                 for i in range(headers.shape[0])),
+        dtype=np.uint8,
+    ).reshape(-1, 32)
+
+
 def sha256d_headers(headers: np.ndarray) -> np.ndarray:
-    """Convenience host API: (B, 80) uint8 headers -> (B, 32) uint8 digests."""
-    words = jnp.asarray(headers_to_words_np(headers))
-    h = sha256d_headers_jit(words)
-    return digests_to_bytes([np.asarray(h[:, i]) for i in range(8)])
+    """Convenience host API: (B, 80) uint8 headers -> (B, 32) uint8 digests.
+
+    Supervised (ops/dispatch): the device batch is spot-checked against the
+    host hash of lane 0 before it is trusted; failures/poison degrade to
+    the per-header CPU loop without changing a single digest."""
+    from ..crypto.hashes import sha256d
+    from . import dispatch
+
+    if headers.shape[0] == 0:
+        return np.zeros((0, 32), dtype=np.uint8)
+
+    def device() -> np.ndarray:
+        words = jnp.asarray(headers_to_words_np(headers))
+        h = sha256d_headers_jit(words)
+        return digests_to_bytes([np.asarray(h[:, i]) for i in range(8)])
+
+    def validate(digests: np.ndarray) -> bool:
+        return digests[0].tobytes() == sha256d(headers[0].tobytes())
+
+    out, _ = dispatch.supervised_call(
+        "sha256", device, lambda: sha256d_headers_cpu(headers),
+        validate=validate,
+        poison=lambda d: np.bitwise_xor(d, np.uint8(0xFF)),
+        items=int(headers.shape[0]),
+    )
+    return out

@@ -24,7 +24,9 @@ from typing import Optional
 from ..consensus.block import CBlock
 from ..consensus.serialize import hash_to_hex
 from ..consensus.tx import CTransaction
+from ..consensus.pow import check_headers_pow_batch
 from ..mempool.mempool import MempoolError
+from ..util.faults import Backoff
 from ..util.log import log_print, log_printf
 from ..validation.chain import BlockStatus
 from ..validation.chainstate import BlockValidationError
@@ -178,6 +180,12 @@ class CConnman:
         # ThreadOpenConnections target, clamped by the total cap exactly
         # like the reference's min(MAX_OUTBOUND_CONNECTIONS, nMaxConnections)
         self.max_outbound = min(8, self.max_connections)
+        # reconnect pacing (util/faults.Backoff): repeated dial failures
+        # back the open-connections loop off exponentially with jitter
+        # (instead of the old fixed 5 s poll hammering a dead candidate
+        # list); any completed handshake resets it to the base interval
+        self._dial_backoff = Backoff(base=5.0, factor=2.0, maximum=60.0,
+                                     jitter=0.5)
 
     # -- lifecycle ------------------------------------------------------
 
@@ -407,9 +415,11 @@ class CConnman:
         peer.send("feefilter",
                   struct.pack("<Q", self.node.min_relay_fee_rate))
         if peer.outbound:
-            # handshake success: promote in addrman, harvest its peers
+            # handshake success: promote in addrman, harvest its peers,
+            # and reset the dial loop's backoff to its base interval
             host, _, port = peer.addr.rpartition(":")
             self.addrman.good(host, int(port))
+            self._dial_backoff.reset()
             peer.send("getaddr")
         # start headers sync (the reference sends getheaders on verack)
         with self.node.cs_main:
@@ -449,10 +459,26 @@ class CConnman:
                 height += 1
         peer.send("headers", ser_headers(headers))
 
+    # headers batches below this size aren't worth a device dispatch for
+    # the PoW pre-filter (the per-header host check in accept_block_header
+    # covers them anyway)
+    HEADERS_POW_BATCH_MIN = 16
+
     def _msg_headers(self, peer: Peer, payload: bytes) -> None:
         headers = deser_headers(payload)
         if not headers:
             return
+        if len(headers) >= self.HEADERS_POW_BATCH_MIN:
+            # batched context-free PoW over the whole announcement in one
+            # supervised dispatch (consensus/pow.check_headers_pow_batch):
+            # a 2000-header IBD batch with any bad-PoW header is rejected
+            # before per-header context work, and a dead backend degrades
+            # to host hashing with the identical verdict
+            ok = check_headers_pow_batch(
+                [h.serialize() for h in headers], self.node.params.consensus
+            )
+            if not all(ok):
+                raise NetMessageError("invalid header: high-hash")
         want = []
         with self.node.cs_main:
             cs = self.node.chainstate
@@ -688,12 +714,17 @@ class CConnman:
 
     async def _open_connections_loop(self) -> None:
         """ThreadOpenConnections (net.cpp): keep dialing addrman candidates
-        until the outbound target is met."""
+        until the outbound target is met. Paced by the shared jittered
+        exponential backoff: every dial that does not produce a handshake
+        grows the next sleep (to 60 s max), and a completed handshake
+        (_msg_verack) resets it — a dead or unreachable candidate set backs
+        the node off instead of burning a fixed-interval dial loop."""
         while True:
-            await asyncio.sleep(5)
+            await asyncio.sleep(self._dial_backoff.next())
             outbound = [p for p in self.peers.values() if p.outbound]
             if (len(outbound) >= self.max_outbound
                     or len(self.peers) >= self.max_connections):
+                self._dial_backoff.reset()  # healthy: keep the base poll
                 continue
             connected = {p.addr for p in self.peers.values()}
             candidate = self.addrman.select(exclude=connected)

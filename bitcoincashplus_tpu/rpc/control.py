@@ -93,9 +93,13 @@ def validateaddress(node, params):
 @rpc_method("gettpuinfo")
 def gettpuinfo(node, params):
     """TPU observability: ECDSA batch-dispatch stats (ops/ecdsa_batch.STATS),
-    sigcache hit rates, ConnectBlock phase timings (-debug=bench counters),
-    and the active backend/device."""
-    from ..ops import ecdsa_batch
+    supervised-dispatch circuit-breaker state per subsystem (ops/dispatch:
+    state, trip counts, fallback call/item tallies — fallback_items is sigs
+    for ecdsa, hashes for sha256, leaves for merkle), the active
+    fault-injection config (BCP_FAULT_*), sigcache hit rates, ConnectBlock
+    phase timings (-debug=bench counters), and the active backend/device."""
+    from ..ops import dispatch, ecdsa_batch
+    from ..util import faults
 
     stats = ecdsa_batch.STATS.snapshot()
     devices = []
@@ -109,6 +113,8 @@ def gettpuinfo(node, params):
         "backend": node.backend,
         "devices": devices,
         "batch": stats,
+        "breakers": dispatch.snapshot(),
+        "faults": faults.INJECTOR.snapshot(),
         "sigcache": {
             "entries": len(node.sigcache._set),
             "hits": node.sigcache.hits,

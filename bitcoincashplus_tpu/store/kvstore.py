@@ -9,9 +9,34 @@ mitigation) is intentionally dropped — it has no behavioral surface.
 
 from __future__ import annotations
 
+import os
 import sqlite3
 import threading
 from typing import Iterator, Optional
+
+from ..util.faults import maybe_crash
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Durable file publish with fsync-before-rename semantics: write to a
+    sibling .tmp, flush+fsync the data, atomically rename over ``path``,
+    then fsync the directory so the rename itself is durable. A crash at
+    any point leaves either the old file (or no file) or the complete new
+    one — never a torn write. Used by the chainstate commit journal
+    (store/chainstatedb.py). Crash points (util/faults.maybe_crash) let
+    tests kill the process between each step."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    maybe_crash("journal:tmp-written")
+    os.replace(tmp, path)
+    dirfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
 
 
 class KVStore:
@@ -72,6 +97,7 @@ class KVStore:
         with self._write_lock:
             cur = self._db.cursor()
             cur.execute("BEGIN")
+            maybe_crash("kv:begin")
             try:
                 if deletes:
                     cur.executemany("DELETE FROM kv WHERE k = ?",
@@ -82,7 +108,12 @@ class KVStore:
                         "ON CONFLICT(k) DO UPDATE SET v=excluded.v",
                         list(puts.items()),
                     )
+                # a hard kill here leaves an uncommitted WAL transaction
+                # that sqlite discards on reopen — the torn-commit case the
+                # crash-injection tests cover
+                maybe_crash("kv:applied")
                 cur.execute("COMMIT")
+                maybe_crash("kv:committed")
             except BaseException:
                 cur.execute("ROLLBACK")
                 raise

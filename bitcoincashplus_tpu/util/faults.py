@@ -1,0 +1,177 @@
+"""Fault-injection harness, jittered backoff, and crash points.
+
+The accelerator graft puts both consensus-critical hot paths (SHA-256d and
+batch ECDSA) behind a device boundary; hardware-miner practice (AsicBoost,
+arXiv:1604.00575; FPGA miners, arXiv:2212.05033) treats device failure as an
+expected operating mode with host fallback, not an exception. This module is
+the failure-side toolkit shared by the supervised dispatch layer
+(ops/dispatch.py), the crash-safe chainstate commit (store/), and P2P
+reconnect pacing (p2p/connman.py):
+
+  - ``FaultInjector`` — deterministic, env-driven fault injection at every
+    backend-crossing call site, so tests can kill the TPU path anywhere:
+        BCP_FAULT_MODE   off | fail-once | fail-n | fail-always | fail-rate
+                         | latency-spike | poison-output
+        BCP_FAULT_OPS    comma list of sites ("sha256,ecdsa") or "all"
+        BCP_FAULT_N      failure count for fail-n (default 1)
+        BCP_FAULT_RATE   failure probability for fail-rate (default 0.5)
+        BCP_FAULT_SEED   rng seed for fail-rate (default 0 — deterministic)
+        BCP_FAULT_LATENCY_MS  sleep per call for latency-spike (default 50)
+  - ``maybe_crash`` — hard-kill crash points (BCP_FAULT_CRASH=<point>) used
+    by the chainstate-commit journal tests: os._exit, no atexit, no sqlite
+    rollback — a genuine mid-commit death.
+  - ``Backoff`` — jittered exponential backoff (full-jitter variant) used by
+    dispatch retries and the connection manager's dial loop.
+
+Everything here is stdlib-only so every layer can import it without cycles
+(and the crash-test worker subprocess stays jax-free).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from typing import Optional
+
+# The four supervised accelerator subsystems (ops/dispatch.py breakers).
+SITES = ("sha256", "merkle", "miner", "ecdsa")
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately injected device failure (never raised in production
+    unless BCP_FAULT_MODE is armed)."""
+
+
+class PoisonedOutput(RuntimeError):
+    """Device output failed its host-side validation probe (known-answer
+    lane / witness / spot-check) — the output must not be trusted."""
+
+
+class FaultInjector:
+    """Env-configured, per-site deterministic fault injection.
+
+    Call counting is per site so fail-once/fail-n behave identically
+    regardless of which subsystem fires first. ``reload()`` re-reads the
+    environment — tests arm/disarm by setting BCP_FAULT_* and reloading.
+    """
+
+    def __init__(self):
+        self.reload()
+
+    def reload(self) -> None:
+        self.mode = os.environ.get("BCP_FAULT_MODE", "off").strip().lower()
+        ops = os.environ.get("BCP_FAULT_OPS", "all").strip().lower()
+        self.sites = (
+            set(SITES) if ops in ("", "all")
+            else {s.strip() for s in ops.split(",") if s.strip()}
+        )
+        self.fail_n = int(os.environ.get("BCP_FAULT_N", "1"))
+        self.rate = float(os.environ.get("BCP_FAULT_RATE", "0.5"))
+        self.latency_s = (
+            float(os.environ.get("BCP_FAULT_LATENCY_MS", "50")) / 1e3
+        )
+        self._rng = random.Random(int(os.environ.get("BCP_FAULT_SEED", "0")))
+        self.crash_point = os.environ.get("BCP_FAULT_CRASH", "")
+        self.calls: dict[str, int] = {}
+        self.injected: dict[str, int] = {}
+        self.poisoned: dict[str, int] = {}
+
+    # -- call-site hooks ------------------------------------------------
+
+    def armed_for(self, site: str) -> bool:
+        return self.mode != "off" and site in self.sites
+
+    def on_call(self, site: str) -> None:
+        """Invoked by the supervised dispatcher immediately before each
+        device attempt. May sleep (latency-spike) or raise InjectedFault."""
+        if not self.armed_for(site):
+            return
+        n = self.calls.get(site, 0) + 1
+        self.calls[site] = n
+        fail = False
+        if self.mode == "fail-once":
+            fail = n == 1
+        elif self.mode == "fail-n":
+            fail = n <= self.fail_n
+        elif self.mode == "fail-always":
+            fail = True
+        elif self.mode == "fail-rate":
+            fail = self._rng.random() < self.rate
+        elif self.mode == "latency-spike":
+            time.sleep(self.latency_s)
+        if fail:
+            self.injected[site] = self.injected.get(site, 0) + 1
+            raise InjectedFault(
+                f"injected fault at {site} (mode={self.mode}, call #{n})"
+            )
+
+    def should_poison(self, site: str) -> bool:
+        """True when the dispatcher must corrupt this call's device output
+        (the validation probe is then expected to catch it)."""
+        if self.mode != "poison-output" or site not in self.sites:
+            return False
+        self.poisoned[site] = self.poisoned.get(site, 0) + 1
+        return True
+
+    def snapshot(self) -> dict:
+        return {
+            "mode": self.mode,
+            "sites": sorted(self.sites) if self.mode != "off" else [],
+            "calls": dict(self.calls),
+            "injected": dict(self.injected),
+            "poisoned": dict(self.poisoned),
+        }
+
+
+INJECTOR = FaultInjector()
+
+
+def maybe_crash(point: str) -> None:
+    """Hard-kill the process at a named crash point when armed
+    (BCP_FAULT_CRASH=<point>). os._exit skips atexit/finally/sqlite
+    rollback — the honest simulation of a power cut mid-commit."""
+    if INJECTOR.crash_point and INJECTOR.crash_point == point:
+        os._exit(137)
+
+
+class Backoff:
+    """Jittered exponential backoff (full-jitter): delay_k is drawn
+    uniformly from [(1-jitter)*d_k, d_k] with d_k = min(base*factor^k, max).
+    ``reset()`` on success returns to the base delay. An injectable rng
+    keeps tests deterministic."""
+
+    def __init__(self, base: float = 0.5, factor: float = 2.0,
+                 maximum: float = 30.0, jitter: float = 0.5,
+                 rng: Optional[random.Random] = None):
+        self.base = base
+        self.factor = factor
+        self.maximum = maximum
+        self.jitter = jitter
+        self._rng = rng if rng is not None else random.Random()
+        self.attempts = 0
+
+    def next(self) -> float:
+        d = min(self.base * (self.factor ** self.attempts), self.maximum)
+        self.attempts += 1
+        return d * (1.0 - self.jitter * self._rng.random())
+
+    def reset(self) -> None:
+        self.attempts = 0
+
+
+def retry_call(fn, attempts: int = 3, backoff: Optional[Backoff] = None,
+               retry_on: tuple = (Exception,), sleep=time.sleep):
+    """Call ``fn`` up to ``attempts`` times with backoff sleeps between
+    tries; re-raises the last error when every attempt fails."""
+    boff = backoff if backoff is not None else Backoff(base=0.02, maximum=1.0)
+    last: Optional[BaseException] = None
+    for i in range(max(1, attempts)):
+        try:
+            return fn()
+        except retry_on as e:  # noqa: PERF203 — retry loop by design
+            last = e
+            if i + 1 < attempts:
+                sleep(boff.next())
+    assert last is not None
+    raise last

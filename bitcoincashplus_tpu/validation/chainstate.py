@@ -20,7 +20,6 @@ import time as _time
 from typing import Callable, Optional
 
 from ..consensus.block import CBlock, CBlockHeader
-from ..consensus.merkle import block_merkle_root
 from ..consensus.params import ChainParams, get_block_subsidy
 from ..consensus.pow import check_proof_of_work, get_next_work_required
 from ..consensus.serialize import hash_to_hex
@@ -66,6 +65,13 @@ class ChainstateManager:
             from .scriptcheck import BlockScriptVerifier
 
             script_verifier = BlockScriptVerifier(params)
+        # startup replay/rollback: a journaled coins store (CoinsDB with a
+        # journal path) may hold a commit that crashed mid-flight; resolve
+        # it to a whole pre- or post-batch state BEFORE anything reads the
+        # best-block marker (store/chainstatedb.py commit-journal contract)
+        recover = getattr(coins_base, "recover_journal", None)
+        if recover is not None and recover():
+            log_print("db", "chainstate commit journal replayed at startup")
         self.params = params
         self.chain = CChain()
         self.block_index: dict[bytes, CBlockIndex] = {}
@@ -192,7 +198,12 @@ class ChainstateManager:
         self.check_block_header(block.header, check_pow)
 
         if check_merkle:
-            root, mutated = block_merkle_root(block)
+            # supervised chooser (ops/dispatch.block_merkle_root): device
+            # tree-reduction for large blocks under the merkle circuit
+            # breaker, byte-exact CPU reference otherwise/on fallback
+            from ..ops.dispatch import block_merkle_root as _merkle
+
+            root, mutated = _merkle(block)
             if root != block.header.hash_merkle_root:
                 raise BlockValidationError("bad-txnmrklroot", "hashMerkleRoot mismatch")
             if mutated:

@@ -39,3 +39,47 @@ jax.config.update(
     os.path.join(tempfile.gettempdir(), "bcp-jax-test-cache"),
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+
+import pytest  # noqa: E402
+
+
+def pytest_collection_modifyitems(config, items):
+    """Fast signal first: run the unit suite before the functional suite
+    (which spawns real bcpd processes at several minutes per file). Under
+    a bounded CI budget the run then reports the health of hundreds of
+    fast tests before sinking time into node-spawn overhead. Stable sort:
+    order within each group is unchanged."""
+    items.sort(key=lambda item: 1 if "functional" in str(item.fspath) else 0)
+
+
+@pytest.fixture
+def fault_harness(monkeypatch):
+    """Arm the BCP_FAULT_* harness for one test and restore a clean
+    injector + breaker registry afterwards (the fault state is process-
+    global by design — it must never leak across tests).
+
+    The `faults` marker (registered in pyproject.toml) tags the
+    supervised-dispatch fault suite; it is tier-1 fast — injection fires
+    BEFORE any heavy kernel compile, and device stubs stand in for the
+    ECDSA kernel — so it runs by default. Smoke subset alone:
+    ``JAX_PLATFORMS=cpu pytest -m faults -q``.
+
+    Usage: ``inj = fault_harness("fail-always", ops="ecdsa", n=3)``."""
+    from bitcoincashplus_tpu.ops import dispatch
+    from bitcoincashplus_tpu.util import faults
+
+    def arm(mode: str, ops: str = "all", **env):
+        monkeypatch.setenv("BCP_FAULT_MODE", mode)
+        monkeypatch.setenv("BCP_FAULT_OPS", ops)
+        for key, val in env.items():
+            monkeypatch.setenv("BCP_FAULT_" + key.upper(), str(val))
+        faults.INJECTOR.reload()
+        return faults.INJECTOR
+
+    yield arm
+    # monkeypatch's own env restore runs AFTER this generator resumes, so
+    # scrub the fault vars by hand before rebuilding the global state
+    for key in [k for k in os.environ if k.startswith("BCP_FAULT")]:
+        os.environ.pop(key, None)
+    faults.INJECTOR.reload()
+    dispatch.reset()

@@ -1,0 +1,199 @@
+"""Crash-safe chainstate commits: the journal codec, the fsync-before-
+rename publish, startup replay/rollback, and the acceptance matrix — a
+subprocess is HARD-KILLED (os._exit, no sqlite rollback, no atexit) at
+every step inside a journaled coins commit, the store is reopened, and the
+recovered UTXO set must equal exactly the pre- or post-batch state, never
+a torn mix."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import bitcoincashplus_tpu
+from bitcoincashplus_tpu.store.chainstatedb import (
+    CoinsDB,
+    _decode_journal,
+    _encode_journal,
+)
+from bitcoincashplus_tpu.store.kvstore import KVStore, atomic_write_bytes
+
+pytestmark = pytest.mark.faults
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.abspath(bitcoincashplus_tpu.__file__)))
+
+# the committing worker: reopens the seeded store and applies one "block
+# connect" batch (spend A, create B2/C, advance the best-block marker)
+# with BCP_FAULT_CRASH armed by the parent. jax-free import chain — each
+# run is a fast real process death.
+WORKER = f"""
+import sys
+sys.path.insert(0, {REPO!r})
+from bitcoincashplus_tpu.store.kvstore import KVStore
+from bitcoincashplus_tpu.store.chainstatedb import CoinsDB
+path, journal = sys.argv[1], sys.argv[2]
+db = CoinsDB(KVStore(path), journal_path=journal)
+db._commit({{b"Cb2": b"coinB2", b"Cc": b"coinC", b"B": b"\\x22"*32}},
+           [b"Ca"])
+"""
+
+PRE = {b"Ca": b"coinA", b"Cd": b"coinD", b"B": b"\x11" * 32}
+POST = {b"Cd": b"coinD", b"Cb2": b"coinB2", b"Cc": b"coinC",
+        b"B": b"\x22" * 32}
+
+# every crash point inside the commit, with the state the reopened store
+# MUST resolve to: before the journal is durable the batch never happened
+# (rollback); from durability on, recovery replays it (post).
+STEPS = [
+    ("journal:tmp-written", "pre"),
+    ("journal:durable", "post"),
+    ("kv:begin", "post"),
+    ("kv:applied", "post"),     # torn sqlite txn discarded, journal replays
+    ("kv:committed", "post"),
+    ("journal:pre-clear", "post"),
+]
+
+
+def _state_of(path: str) -> dict:
+    kv = KVStore(path)
+    out = dict(kv.iterate())
+    kv.close()
+    return out
+
+
+def _seed(tmp_path):
+    path = str(tmp_path / "chainstate.sqlite")
+    journal = str(tmp_path / "chainstate.journal")
+    kv = KVStore(path)
+    kv.write_batch(dict(PRE), sync=True)
+    kv.close()
+    return path, journal
+
+
+class TestJournalCodec:
+    def test_roundtrip(self):
+        puts = {b"Ca": b"1", b"B": b"\x22" * 32, b"": b""}
+        dels = [b"Cb", b"Cz"]
+        assert _decode_journal(_encode_journal(puts, dels)) == (puts, dels)
+
+    def test_rejects_garbage_and_truncation(self):
+        blob = _encode_journal({b"k": b"v" * 100}, [b"d"])
+        assert _decode_journal(b"") is None
+        assert _decode_journal(b"garbage") is None
+        assert _decode_journal(blob[:-5]) is None          # torn tail
+        assert _decode_journal(b"XXXX" + blob[4:]) is None  # bad magic
+        flipped = bytearray(blob)
+        flipped[20] ^= 0x01
+        assert _decode_journal(bytes(flipped)) is None      # bad checksum
+
+
+class TestAtomicWrite:
+    def test_publish_and_overwrite(self, tmp_path):
+        p = str(tmp_path / "f.bin")
+        atomic_write_bytes(p, b"one")
+        assert open(p, "rb").read() == b"one"
+        atomic_write_bytes(p, b"two")
+        assert open(p, "rb").read() == b"two"
+        assert not os.path.exists(p + ".tmp")
+
+
+class TestRecovery:
+    def test_no_journal_is_noop(self, tmp_path):
+        path, journal = _seed(tmp_path)
+        db = CoinsDB(KVStore(path), journal_path=journal)
+        assert db.recover_journal() is False
+        db.kv.close()
+        assert _state_of(path) == PRE
+
+    def test_torn_journal_rolls_back(self, tmp_path):
+        path, journal = _seed(tmp_path)
+        blob = _encode_journal({b"Cx": b"half"}, [])
+        with open(journal, "wb") as f:
+            f.write(blob[: len(blob) // 2])  # torn mid-write
+        db = CoinsDB(KVStore(path), journal_path=journal)
+        assert db.recover_journal() is False
+        db.kv.close()
+        assert _state_of(path) == PRE
+        assert not os.path.exists(journal)
+
+    def test_stale_tmp_fragment_discarded(self, tmp_path):
+        path, journal = _seed(tmp_path)
+        with open(journal + ".tmp", "wb") as f:
+            f.write(b"partial")
+        db = CoinsDB(KVStore(path), journal_path=journal)
+        assert db.recover_journal() is False
+        db.kv.close()
+        assert not os.path.exists(journal + ".tmp")
+
+    def test_replay_is_idempotent(self, tmp_path):
+        path, journal = _seed(tmp_path)
+        blob = _encode_journal(
+            {b"Cb2": b"coinB2", b"Cc": b"coinC", b"B": b"\x22" * 32},
+            [b"Ca"])
+        # journal present AND batch already fully applied (crash between
+        # commit and journal clear): replay must land on the same state
+        db = CoinsDB(KVStore(path), journal_path=journal)
+        db._commit({b"Cb2": b"coinB2", b"Cc": b"coinC", b"B": b"\x22" * 32},
+                   [b"Ca"])
+        with open(journal, "wb") as f:
+            f.write(blob)
+        assert db.recover_journal() is True
+        db.kv.close()
+        assert _state_of(path) == POST
+
+
+@pytest.mark.parametrize("step,expect", STEPS)
+def test_crash_at_every_journal_step(tmp_path, step, expect):
+    """Kill the committing process at ``step``; the reopened + recovered
+    store holds exactly the expected whole state."""
+    path, journal = _seed(tmp_path)
+    env = dict(os.environ)
+    env["BCP_FAULT_CRASH"] = step
+    proc = subprocess.run(
+        [sys.executable, "-c", WORKER, path, journal],
+        env=env, capture_output=True, timeout=60,
+    )
+    assert proc.returncode == 137, (step, proc.stderr.decode()[-500:])
+    db = CoinsDB(KVStore(path), journal_path=journal)
+    db.recover_journal()
+    db.kv.close()
+    state = _state_of(path)
+    assert state == (PRE if expect == "pre" else POST), (step, state)
+    assert not os.path.exists(journal)  # always cleared after recovery
+
+
+def test_uninjected_commit_completes(tmp_path):
+    path, journal = _seed(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, "-c", WORKER, path, journal],
+        env=dict(os.environ), capture_output=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+    assert _state_of(path) == POST
+    assert not os.path.exists(journal)
+
+
+def test_chainstate_manager_replays_journal_at_startup(tmp_path):
+    """The startup replay path (validation/chainstate.py): a journal left
+    by a crash is applied before the chainstate reads anything."""
+    from bitcoincashplus_tpu.consensus.params import regtest_params
+    from bitcoincashplus_tpu.store.blockstore import MemoryBlockStore
+    from bitcoincashplus_tpu.validation.chainstate import ChainstateManager
+
+    params = regtest_params()
+    path = str(tmp_path / "cs.sqlite")
+    journal = str(tmp_path / "cs.journal")
+    kv = KVStore(path)
+    # pending journal: best-block -> genesis + one coin row
+    with open(journal, "wb") as f:
+        f.write(_encode_journal(
+            {b"B": params.genesis_hash, b"C" + b"\xaa" * 36: b"\x02\x05\x00"},
+            []))
+    db = CoinsDB(kv, journal_path=journal)
+    ChainstateManager(params, db, MemoryBlockStore())
+    assert not os.path.exists(journal)
+    assert kv.get(b"B") == params.genesis_hash
+    assert kv.get(b"C" + b"\xaa" * 36) is not None
+    kv.close()

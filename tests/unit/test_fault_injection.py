@@ -1,0 +1,364 @@
+"""Supervised-dispatch fault injection across the four accelerator entry
+points (ops/sha256, ops/merkle, ops/miner, ops/ecdsa_batch).
+
+For every injected failure mode the assertions are the tentpole's two
+invariants: (a) the verdict/output is IDENTICAL to the pure-CPU reference
+engine — a dead or lying backend can never change consensus — and (b) the
+subsystem's circuit breaker trips on hard failures and recovers through a
+half-open probe once the fault clears.
+
+The ECDSA device kernel is stubbed (oracle-backed fake for the XLA entry)
+so the harness logic — KAT lanes, settle-time detection, CPU re-verify —
+is exercised without the minutes-long kernel compile; everything else runs
+the real jitted paths on the CPU backend. All tests here are tier-1 fast
+and run by default (pytest -m faults for the smoke subset alone).
+"""
+
+import numpy as np
+import pytest
+
+from bitcoincashplus_tpu.consensus.merkle import compute_merkle_root
+from bitcoincashplus_tpu.consensus.params import regtest_params
+from bitcoincashplus_tpu.crypto import secp256k1 as oracle
+from bitcoincashplus_tpu.crypto.hashes import sha256d
+from bitcoincashplus_tpu.ops import dispatch, ecdsa_batch
+from bitcoincashplus_tpu.ops.merkle import compute_merkle_root_tpu
+from bitcoincashplus_tpu.ops.miner import sweep_header_cpu
+from bitcoincashplus_tpu.ops.sha256 import sha256d_headers, sha256d_headers_cpu
+from bitcoincashplus_tpu.script.interpreter import SigCheckRecord
+from bitcoincashplus_tpu.util import faults
+
+pytestmark = pytest.mark.faults
+
+TILE = 1 << 12
+rng = np.random.default_rng(1234)
+
+
+@pytest.fixture(autouse=True)
+def _clean(fault_harness):
+    """Every test starts from a pristine breaker registry (fault_harness
+    from conftest owns teardown)."""
+    dispatch.reset()
+    yield
+
+
+def _open_fast():
+    """Breaker config for fail-always tests: first hard failure opens, no
+    probes until explicitly re-enabled."""
+    dispatch.configure(threshold=1, retries=0, cooldown=1e9, probe=0.0)
+
+
+# ---------------------------------------------------------------------------
+# sha256 — batched header hashing
+# ---------------------------------------------------------------------------
+
+class TestSha256Faults:
+    HDRS = rng.integers(0, 256, (8, 80), dtype=np.uint8)
+
+    def _ref(self):
+        return sha256d_headers_cpu(self.HDRS)
+
+    def test_fail_once_absorbed_by_retry(self, fault_harness):
+        dispatch.configure(retries=1, threshold=2)
+        fault_harness("fail-once", ops="sha256")
+        out = sha256d_headers(self.HDRS)
+        assert np.array_equal(out, self._ref())
+        assert dispatch.breaker("sha256").state == "closed"
+        assert faults.INJECTOR.injected.get("sha256") == 1
+
+    def test_fail_always_trips_then_recovers(self, fault_harness):
+        _open_fast()
+        fault_harness("fail-always", ops="sha256")
+        for _ in range(3):
+            assert np.array_equal(sha256d_headers(self.HDRS), self._ref())
+        snap = dispatch.breaker("sha256").snapshot()
+        assert snap["state"] == "open" and snap["fallback_items"] >= 24
+        # fault clears -> half-open probe closes the breaker
+        fault_harness("off")
+        br = dispatch.breaker("sha256")
+        br.cfg.cooldown, br.cfg.probe = 0.0, 1.0
+        assert np.array_equal(sha256d_headers(self.HDRS), self._ref())
+        assert br.state == "closed" and br.snapshot()["recoveries"] == 1
+
+    def test_poison_output_caught_by_spot_check(self, fault_harness):
+        _open_fast()
+        fault_harness("poison-output", ops="sha256")
+        out = sha256d_headers(self.HDRS)
+        assert np.array_equal(out, self._ref())  # CPU result, not poison
+        assert dispatch.breaker("sha256").state == "open"
+
+
+# ---------------------------------------------------------------------------
+# merkle — device tree reduction
+# ---------------------------------------------------------------------------
+
+class TestMerkleFaults:
+    HASHES = [rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
+              for _ in range(21)]
+
+    def test_fail_once_absorbed_by_retry(self, fault_harness):
+        dispatch.configure(retries=1, threshold=2)
+        fault_harness("fail-once", ops="merkle")
+        assert compute_merkle_root_tpu(self.HASHES) == \
+            compute_merkle_root(self.HASHES)
+        assert dispatch.breaker("merkle").state == "closed"
+
+    def test_fail_always_trips_then_recovers(self, fault_harness):
+        _open_fast()
+        fault_harness("fail-always", ops="merkle")
+        for _ in range(2):
+            assert compute_merkle_root_tpu(self.HASHES) == \
+                compute_merkle_root(self.HASHES)
+        br = dispatch.breaker("merkle")
+        assert br.state == "open" and br.snapshot()["fallback_items"] > 0
+        fault_harness("off")
+        br.cfg.cooldown, br.cfg.probe = 0.0, 1.0
+        assert compute_merkle_root_tpu(self.HASHES) == \
+            compute_merkle_root(self.HASHES)
+        assert br.state == "closed"
+
+    def test_poison_output_caught_by_witness(self, fault_harness):
+        """A corrupted device root is rejected by the level-1 witness
+        recompute and the CPU root reaches the caller."""
+        _open_fast()
+        fault_harness("poison-output", ops="merkle")
+        assert compute_merkle_root_tpu(self.HASHES) == \
+            compute_merkle_root(self.HASHES)
+        assert dispatch.breaker("merkle").state == "open"
+
+    def test_mutation_flag_preserved_through_fallback(self, fault_harness):
+        _open_fast()
+        fault_harness("fail-always", ops="merkle")
+        dup = self.HASHES + self.HASHES[-1:]
+        root, mutated = compute_merkle_root_tpu(dup)
+        ref_root, ref_mut = compute_merkle_root(dup)
+        assert (root, mutated) == (ref_root, ref_mut) and mutated
+
+
+# ---------------------------------------------------------------------------
+# miner — PoW nonce sweep
+# ---------------------------------------------------------------------------
+
+class TestMinerFaults:
+    HEADER = bytes(regtest_params().genesis.header.serialize())
+    EASY = regtest_params().consensus.pow_limit
+
+    def test_fail_once_absorbed_by_retry(self, fault_harness):
+        dispatch.configure(retries=1, threshold=2)
+        fault_harness("fail-once", ops="miner")
+        sweep = dispatch.supervised_sweep()
+        nonce, _ = sweep(self.HEADER, self.EASY, max_nonces=1 << 16,
+                         tile=TILE)
+        ref, _ = sweep_header_cpu(self.HEADER, self.EASY,
+                                  max_nonces=1 << 16)
+        assert nonce == ref
+        assert dispatch.breaker("miner").state == "closed"
+
+    def test_fail_always_degrades_to_scalar_loop(self, fault_harness):
+        _open_fast()
+        fault_harness("fail-always", ops="miner")
+        sweep = dispatch.supervised_sweep()
+        for _ in range(2):
+            nonce, _ = sweep(self.HEADER, self.EASY, max_nonces=1 << 16,
+                             tile=TILE)
+            ref, _ = sweep_header_cpu(self.HEADER, self.EASY,
+                                      max_nonces=1 << 16)
+            assert nonce == ref
+        br = dispatch.breaker("miner")
+        assert br.state == "open"
+        fault_harness("off")
+        br.cfg.cooldown, br.cfg.probe = 0.0, 1.0
+        nonce, _ = sweep(self.HEADER, self.EASY, max_nonces=1 << 16,
+                         tile=TILE)
+        assert nonce == sweep_header_cpu(self.HEADER, self.EASY,
+                                         max_nonces=1 << 16)[0]
+        assert br.state == "closed"
+
+    def test_poison_nonce_rejected_by_host_reverify(self, fault_harness):
+        """Tight target (exactly the window's minimum hash, so only ONE
+        nonce can satisfy it): a poisoned nonce fails the host
+        re-verification and the CPU loop's honest nonce is returned."""
+        hashes = [
+            int.from_bytes(
+                sha256d(self.HEADER[:76] + i.to_bytes(4, "little")),
+                "little")
+            for i in range(512)
+        ]
+        ref = min(range(512), key=hashes.__getitem__)
+        tight = hashes[ref]
+        _open_fast()
+        fault_harness("poison-output", ops="miner")
+        sweep = dispatch.supervised_sweep()
+        nonce, _ = sweep(self.HEADER, tight, max_nonces=1 << 16, tile=TILE)
+        assert nonce == ref
+        assert dispatch.breaker("miner").state == "open"
+
+
+# ---------------------------------------------------------------------------
+# ecdsa — batched signature verification (stubbed device kernel)
+# ---------------------------------------------------------------------------
+
+def _make_records(n_good=3, n_bad=1):
+    recs = []
+    for i in range(n_good):
+        d, e = 0x1000 + i, (0xABCDEF + i) % oracle.N
+        r, s = oracle.ecdsa_sign(d, e)
+        recs.append(SigCheckRecord(oracle.point_mul(d, oracle.G), r, s, e))
+    for i in range(n_bad):
+        d, e = 0x2000 + i, (0x123456 + i) % oracle.N
+        r, s = oracle.ecdsa_sign(d, e)
+        recs.append(SigCheckRecord(oracle.point_mul(d, oracle.G), r, s,
+                                   (e + 1) % oracle.N))
+    return recs
+
+
+@pytest.fixture
+def fake_kernel(monkeypatch):
+    """Stand-in for the XLA verify kernel: evaluates the packed batch's
+    verdicts with the Python-int oracle at dispatch time (so KAT lanes get
+    honest answers) — the dispatch/KAT/fallback plumbing under test is
+    identical to the real kernel's."""
+    import bitcoincashplus_tpu.ops.secp256k1 as dev
+
+    monkeypatch.setenv("BCP_SECP_PALLAS", "0")
+    state: dict = {"mask": None}
+    real_pack = ecdsa_batch.pack_records
+
+    def spy_pack(records, bucket):
+        state["mask"] = [
+            oracle.ecdsa_verify(r.pubkey, r.r, r.s, r.msg_hash)
+            for r in records
+        ]
+        return real_pack(records, bucket)
+
+    def fake_jit(u1b, u2b, qx, qy, q_inf, r0, rn, wrap_ok):
+        out = np.zeros(q_inf.shape[0], bool)
+        out[: len(state["mask"])] = state["mask"]
+        return out
+
+    monkeypatch.setattr(ecdsa_batch, "pack_records", spy_pack)
+    monkeypatch.setattr(dev, "ecdsa_verify_batch_jit", fake_jit)
+    return state
+
+
+class TestEcdsaFaults:
+    EXPECTED = np.array([True, True, True, False])
+
+    def test_fail_once_absorbed_by_retry(self, fault_harness, fake_kernel):
+        dispatch.configure(retries=1, threshold=2)
+        fault_harness("fail-once", ops="ecdsa")
+        recs = _make_records()
+        got = ecdsa_batch.verify_batch(recs, backend="device")
+        assert np.array_equal(got, self.EXPECTED)
+        assert dispatch.breaker("ecdsa").state == "closed"
+
+    def test_fail_always_cpu_reverify_and_recovery(self, fault_harness,
+                                                   fake_kernel):
+        _open_fast()
+        fault_harness("fail-always", ops="ecdsa")
+        recs = _make_records()
+        before = ecdsa_batch.STATS.fault_fallback_sigs
+        for _ in range(3):
+            got = ecdsa_batch.verify_batch(recs, backend="device")
+            assert np.array_equal(got, self.EXPECTED)
+        br = dispatch.breaker("ecdsa")
+        snap = br.snapshot()
+        assert snap["state"] == "open" and snap["fallback_items"] >= 8
+        # every fallback sig is metered (satellite: sigop metering)
+        assert ecdsa_batch.STATS.fault_fallback_sigs - before == 12
+        fault_harness("off")
+        br.cfg.cooldown, br.cfg.probe = 0.0, 1.0
+        got = ecdsa_batch.verify_batch(recs, backend="device")
+        assert np.array_equal(got, self.EXPECTED)
+        assert br.state == "closed" and br.snapshot()["recoveries"] == 1
+
+    def test_poison_mask_caught_by_kat_lanes(self, fault_harness,
+                                             fake_kernel):
+        """An inverted validity mask flips BOTH known-answer lanes wrong-
+        side; the batch is discarded and the verdict is a fresh CPU
+        verification — invalid sigs stay invalid, valid ones valid."""
+        _open_fast()
+        fault_harness("poison-output", ops="ecdsa")
+        recs = _make_records()
+        kat_before = ecdsa_batch.STATS.kat_failures
+        got = ecdsa_batch.verify_batch(recs, backend="device")
+        assert np.array_equal(got, self.EXPECTED)
+        assert ecdsa_batch.STATS.kat_failures == kat_before + 1
+        assert dispatch.breaker("ecdsa").state == "open"
+
+    def test_open_breaker_routes_straight_to_cpu(self, fault_harness,
+                                                 fake_kernel):
+        _open_fast()
+        fault_harness("fail-always", ops="ecdsa")
+        recs = _make_records()
+        ecdsa_batch.verify_batch(recs, backend="device")  # trips it
+        fault_harness("off")  # device would work again, but breaker is open
+        calls_before = faults.INJECTOR.calls.get("ecdsa", 0)
+        got = ecdsa_batch.verify_batch(recs, backend="device")
+        assert np.array_equal(got, self.EXPECTED)
+        assert faults.INJECTOR.calls.get("ecdsa", 0) == calls_before
+
+
+# ---------------------------------------------------------------------------
+# consensus/pow — batched header PoW rides the sha256 breaker
+# ---------------------------------------------------------------------------
+
+class TestHeadersPowBatch:
+    def test_verdict_matches_scalar_check(self):
+        from bitcoincashplus_tpu.consensus.pow import (
+            check_headers_pow_batch,
+            check_proof_of_work,
+        )
+
+        params = regtest_params()
+        good = params.genesis.header.serialize()
+        bad = bytearray(good)
+        bad[0] ^= 0x01  # version flip invalidates the (easy) regtest PoW?
+        # regtest PoW is nearly always satisfied — build a header failing
+        # the target by pointing nBits at an impossible compact target
+        bad2 = bytearray(good)
+        bad2[72:76] = (0x01003456).to_bytes(4, "little")  # tiny target
+        batch = [bytes(good), bytes(bad), bytes(bad2)]
+        got = check_headers_pow_batch(batch, params.consensus)
+        ref = [
+            check_proof_of_work(
+                sha256d(h), int.from_bytes(h[72:76], "little"),
+                params.consensus)
+            for h in batch
+        ]
+        assert got == ref
+
+    def test_dead_backend_same_verdict(self, fault_harness):
+        from bitcoincashplus_tpu.consensus.pow import check_headers_pow_batch
+
+        params = regtest_params()
+        batch = [params.genesis.header.serialize()] * 4
+        ref = check_headers_pow_batch(batch, params.consensus)
+        _open_fast()
+        fault_harness("fail-always", ops="sha256")
+        got = check_headers_pow_batch(batch, params.consensus)
+        assert got == ref
+        assert dispatch.breaker("sha256").state == "open"
+
+
+# ---------------------------------------------------------------------------
+# gettpuinfo surfaces breaker + fault state
+# ---------------------------------------------------------------------------
+
+def test_gettpuinfo_reports_breakers_and_faults(fault_harness):
+    from types import SimpleNamespace
+
+    from bitcoincashplus_tpu.rpc.control import gettpuinfo
+    from bitcoincashplus_tpu.validation.sigcache import SignatureCache
+
+    _open_fast()
+    fault_harness("fail-always", ops="sha256")
+    hdrs = rng.integers(0, 256, (4, 80), dtype=np.uint8)
+    sha256d_headers(hdrs)
+    node = SimpleNamespace(backend="auto", sigcache=SignatureCache(),
+                           chainstate=SimpleNamespace(bench={}))
+    info = gettpuinfo(node, [])
+    assert info["breakers"]["sha256"]["state"] == "open"
+    assert info["breakers"]["sha256"]["fallback_items"] >= 4
+    assert info["faults"]["mode"] == "fail-always"
+    assert "batch" in info and "fault_fallback_sigs" in info["batch"]

@@ -1,0 +1,230 @@
+"""Unit coverage for the failure-side toolkit: jittered backoff, the
+fault injector, the circuit-breaker state machine, and the generic
+supervised_call wrapper (util/faults.py + ops/dispatch.py)."""
+
+import random
+
+import pytest
+
+from bitcoincashplus_tpu.ops import dispatch
+from bitcoincashplus_tpu.util import faults
+from bitcoincashplus_tpu.util.faults import (
+    Backoff,
+    InjectedFault,
+    retry_call,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_dispatch_state():
+    """Breakers and the injector are process-global by design; every test
+    in this file starts and ends with a pristine registry."""
+    dispatch.reset()
+    faults.INJECTOR.reload()
+    yield
+    dispatch.reset()
+    faults.INJECTOR.reload()
+
+
+class TestBackoff:
+    def test_growth_jitter_and_reset(self):
+        b = Backoff(base=1.0, factor=2.0, maximum=8.0, jitter=0.5,
+                    rng=random.Random(7))
+        delays = [b.next() for _ in range(6)]
+        # each delay lies in [(1-jitter)*d_k, d_k] with d_k = min(2^k, 8)
+        for k, d in enumerate(delays):
+            ceiling = min(2.0 ** k, 8.0)
+            assert 0.5 * ceiling <= d <= ceiling
+        # the cap binds: late delays never exceed the max
+        assert max(delays) <= 8.0
+        b.reset()
+        assert b.next() <= 1.0  # back to the base window
+
+    def test_deterministic_with_seeded_rng(self):
+        a = Backoff(base=1.0, rng=random.Random(3))
+        b = Backoff(base=1.0, rng=random.Random(3))
+        assert [a.next() for _ in range(4)] == [b.next() for _ in range(4)]
+
+    def test_retry_call_retries_then_raises(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise ValueError("nope")
+
+        with pytest.raises(ValueError):
+            retry_call(flaky, attempts=3, sleep=lambda _t: None)
+        assert len(calls) == 3
+
+    def test_retry_call_success_after_transient(self):
+        state = {"n": 0}
+
+        def flaky():
+            state["n"] += 1
+            if state["n"] < 2:
+                raise ValueError("transient")
+            return "ok"
+
+        assert retry_call(flaky, attempts=3, sleep=lambda _t: None) == "ok"
+
+
+class TestFaultInjector:
+    def test_off_by_default(self):
+        inj = faults.FaultInjector()
+        inj.on_call("sha256")  # no raise
+        assert not inj.should_poison("sha256")
+
+    def test_fail_once_fires_exactly_once_per_site(self, monkeypatch):
+        monkeypatch.setenv("BCP_FAULT_MODE", "fail-once")
+        monkeypatch.setenv("BCP_FAULT_OPS", "all")
+        inj = faults.FaultInjector()
+        with pytest.raises(InjectedFault):
+            inj.on_call("sha256")
+        inj.on_call("sha256")  # second call passes
+        with pytest.raises(InjectedFault):
+            inj.on_call("merkle")  # independent per-site counter
+        assert inj.injected == {"sha256": 1, "merkle": 1}
+
+    def test_fail_n_and_site_filter(self, monkeypatch):
+        monkeypatch.setenv("BCP_FAULT_MODE", "fail-n")
+        monkeypatch.setenv("BCP_FAULT_N", "2")
+        monkeypatch.setenv("BCP_FAULT_OPS", "ecdsa")
+        inj = faults.FaultInjector()
+        inj.on_call("sha256")  # unlisted site: untouched
+        for _ in range(2):
+            with pytest.raises(InjectedFault):
+                inj.on_call("ecdsa")
+        inj.on_call("ecdsa")  # third call passes
+
+    def test_fail_rate_deterministic_under_seed(self, monkeypatch):
+        monkeypatch.setenv("BCP_FAULT_MODE", "fail-rate")
+        monkeypatch.setenv("BCP_FAULT_RATE", "0.5")
+        monkeypatch.setenv("BCP_FAULT_SEED", "42")
+
+        def run():
+            inj = faults.FaultInjector()
+            out = []
+            for _ in range(16):
+                try:
+                    inj.on_call("miner")
+                    out.append(False)
+                except InjectedFault:
+                    out.append(True)
+            return out
+
+        assert run() == run()
+        assert any(run())
+
+    def test_poison_mode_counts(self, monkeypatch):
+        monkeypatch.setenv("BCP_FAULT_MODE", "poison-output")
+        monkeypatch.setenv("BCP_FAULT_OPS", "merkle")
+        inj = faults.FaultInjector()
+        assert inj.should_poison("merkle")
+        assert not inj.should_poison("sha256")
+        assert inj.snapshot()["poisoned"] == {"merkle": 1}
+
+
+class TestCircuitBreaker:
+    def test_open_after_threshold_and_halfopen_recovery(self):
+        dispatch.configure(threshold=2, cooldown=0.0, probe=1.0, retries=0)
+        br = dispatch.breaker("test")
+        assert br.allow() and br.state == "closed"
+        br.record_failure(RuntimeError("one"))
+        assert br.state == "closed"  # below threshold
+        br.record_failure(RuntimeError("two"))
+        assert br.state == "open" and br.trips == 1
+        # probe=1.0, cooldown=0 -> the next allow() IS the half-open probe
+        assert br.allow() and br.state == "half-open"
+        br.record_success()
+        assert br.state == "closed" and br.recoveries == 1
+
+    def test_halfopen_failure_reopens(self):
+        dispatch.configure(threshold=1, cooldown=0.0, probe=1.0, retries=0)
+        br = dispatch.breaker("test")
+        br.record_failure(RuntimeError("boom"))
+        assert br.state == "open"
+        assert br.allow()  # probe
+        br.record_failure(RuntimeError("still broken"))
+        assert br.state == "open" and br.trips == 2
+
+    def test_open_breaker_blocks_without_probe(self):
+        dispatch.configure(threshold=1, cooldown=1e9, probe=0.0, retries=0)
+        br = dispatch.breaker("test")
+        br.record_failure(RuntimeError("dead"))
+        assert not any(br.allow() for _ in range(10))
+
+    def test_fallback_accounting(self):
+        br = dispatch.breaker("test")
+        br.note_fallback(7)
+        br.note_fallback(3)
+        snap = br.snapshot()
+        assert snap["fallback_calls"] == 2 and snap["fallback_items"] == 10
+
+
+class TestSupervisedCall:
+    def test_device_result_used_when_healthy(self):
+        out, used = dispatch.supervised_call("test", lambda: "dev",
+                                             lambda: "cpu")
+        assert (out, used) == ("dev", True)
+
+    def test_retry_absorbs_transient_failure(self):
+        dispatch.configure(retries=1, threshold=3)
+        state = {"n": 0}
+
+        def flaky():
+            state["n"] += 1
+            if state["n"] == 1:
+                raise RuntimeError("transient")
+            return "dev"
+
+        out, used = dispatch.supervised_call("test", flaky, lambda: "cpu")
+        assert (out, used) == ("dev", True)
+        assert dispatch.breaker("test").state == "closed"
+
+    def test_hard_failure_falls_back_and_charges_breaker(self):
+        dispatch.configure(retries=0, threshold=2, cooldown=1e9, probe=0.0)
+
+        def dead():
+            raise RuntimeError("device gone")
+
+        for _ in range(2):
+            out, used = dispatch.supervised_call("test", dead, lambda: "cpu",
+                                                 items=5)
+            assert (out, used) == ("cpu", False)
+        br = dispatch.breaker("test")
+        assert br.state == "open"
+        # breaker open: device_fn is not even attempted any more
+        out, used = dispatch.supervised_call(
+            "test", lambda: pytest.fail("must not run"), lambda: "cpu")
+        assert (out, used) == ("cpu", False)
+        assert br.snapshot()["fallback_items"] >= 11
+
+    def test_validation_probe_gates_output(self):
+        dispatch.configure(retries=0, threshold=1, cooldown=1e9, probe=0.0)
+        out, used = dispatch.supervised_call(
+            "test", lambda: "corrupt", lambda: "cpu",
+            validate=lambda r: r != "corrupt")
+        assert (out, used) == ("cpu", False)
+        assert dispatch.breaker("test").state == "open"
+
+
+def test_connman_uses_shared_backoff(tmp_path):
+    """The reconnect loop's pacing is the util/faults.Backoff helper, not a
+    fixed sleep (satellite: unified timeout/reconnect handling)."""
+    from types import SimpleNamespace
+
+    from bitcoincashplus_tpu.p2p.connman import CConnman
+
+    node = SimpleNamespace(
+        params=SimpleNamespace(netmagic=b"\xfa\xbf\xb5\xda"),
+        datadir=str(tmp_path),
+        config=SimpleNamespace(get_int=lambda _k, d: d),
+    )
+    cm = CConnman(node)
+    assert isinstance(cm._dial_backoff, Backoff)
+    assert cm._dial_backoff.base == 5.0 and cm._dial_backoff.maximum == 60.0
+    first = cm._dial_backoff.next()
+    later = [cm._dial_backoff.next() for _ in range(6)]
+    assert first <= 5.0 and max(later) > 5.0  # it actually backs off
+    cm._dial_backoff.reset()
+    assert cm._dial_backoff.next() <= 5.0
