@@ -50,6 +50,18 @@ Options:
   -port=<port>           Listen for P2P connections on <port>
   -listen                Accept P2P connections from outside (default: 1 when P2P enabled)
   -connect=<ip:port>     Connect only to the specified node (may be repeated)
+  -banscore=<n>          Ban-score threshold: misbehaving peers are evicted
+                         once their score reaches <n> (default: 100)
+  -blockdownloadtimeout=<n>  Seconds without download progress before a peer
+                         with blocks in flight counts as stalling (default: 60)
+  -maxrecvrate=<n>       Per-peer receive ceiling in bytes/sec averaged over
+                         one supervision tick; 0 = unlimited (default: 4000000)
+  -maxunconnectingheaders=<n>  Charge the non-connecting-headers misbehavior
+                         only every <n>th offense since the peer's last
+                         connecting batch (default: 10)
+  -nettick=<n>           P2P supervision tick interval in seconds (default: 5)
+  -netseed=<n>           Seed for the network rng (orphan eviction); -1 = OS
+                         entropy (default: -1)
   -rpcport=<port>        Listen for JSON-RPC connections on <port>
   -rpcbind=<addr>        Bind RPC to address (default: 127.0.0.1)
   -rpcuser=<user>        Username for JSON-RPC connections (default: cookie auth)

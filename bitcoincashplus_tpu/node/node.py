@@ -196,6 +196,21 @@ class Node:
             expiry_seconds=config.get_int("mempoolexpiry", 336) * 3600,
         )
         self.min_relay_fee_rate = config.get_int("minrelaytxfee", 1000)
+        # P2P adversarial-supervision limits (p2p/connman.py): the
+        # ban-score discharge threshold, the block-download stall timeout,
+        # the supervision tick cadence, the per-peer receive-rate ceiling
+        # (bytes/sec, 0 = unlimited), and the deterministic net rng seed
+        # (-1 = OS entropy; chaos campaigns pin it for replayability)
+        self.net_limits = {
+            "banscore": config.get_int("banscore", 100),
+            "blockdownloadtimeout":
+                config.get_int("blockdownloadtimeout", 60),
+            "nettick": config.get_int("nettick", 5),
+            "maxrecvrate": config.get_int("maxrecvrate", 4_000_000),
+            "netseed": config.get_int("netseed", -1),
+            "maxunconnectingheaders":
+                config.get_int("maxunconnectingheaders", 10),
+        }
         # -limitancestorcount/-limitancestorsize (kB)/-limitdescendantcount/
         # -limitdescendantsize (kB): ATMP chain limits (validation.h defaults)
         self.ancestor_limits = {

@@ -35,7 +35,21 @@ HEADER_SIZE = 24
 
 
 class NetMessageError(Exception):
-    """Malformed wire data — the peer gets disconnected (Misbehaving)."""
+    """Malformed wire data. Raising this always ends the connection; the
+    ``score`` is what gets recorded on the sender's ban-score ledger for
+    the event (connman.CConnman.misbehaving). The default of 100 matches
+    the ledger's default threshold, so an un-annotated raise records an
+    immediate discharge — the historical behavior. score=0 marks a benign
+    protocol disconnect (self-connect, duplicate version): the connection
+    still ends but nothing reaches the ledger or the attack counters. A
+    raise with a lower positive score would disconnect WITHOUT recording
+    a discharge; truly graduated (accumulating) offenses must instead
+    charge via misbehaving() and return, since a per-connection ledger
+    resets on reconnect."""
+
+    def __init__(self, message: str, score: int = 100):
+        super().__init__(message)
+        self.score = score
 
 
 @dataclass

@@ -97,7 +97,10 @@ def gettpuinfo(node, params):
     state, trip counts, fallback call/item tallies — fallback_items is sigs
     for ecdsa, hashes for sha256, leaves for merkle), the active
     fault-injection config (BCP_FAULT_*), sigcache hit rates, ConnectBlock
-    phase timings (-debug=bench counters), and the active backend/device."""
+    phase timings (-debug=bench counters), the active backend/device, and —
+    when P2P is running — the peer-supervision ledger (``net``: misbehavior
+    charges, discharge reasons, stall re-requests, flood charges, orphan
+    pool accounting, banlist size)."""
     from ..ops import dispatch, ecdsa_batch
     from ..util import faults
 
@@ -121,6 +124,8 @@ def gettpuinfo(node, params):
             "misses": node.sigcache.misses,
         },
         "connectblock": dict(node.chainstate.bench),
+        "net": (node.connman.net_snapshot()
+                if getattr(node, "connman", None) is not None else {}),
     }
 
 

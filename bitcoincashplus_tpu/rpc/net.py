@@ -20,9 +20,17 @@ def getconnectioncount(node, params):
 
 @rpc_method("getpeerinfo")
 def getpeerinfo(node, params):
+    """getpeerinfo — per-peer connection stats plus this framework's
+    DoS-supervision state: ``banscore`` (misbehavior ledger total),
+    ``charges`` (reason -> accumulated score), ``inflight`` (blocks
+    getdata'd and not yet received), ``stalling`` (download-timeout flag),
+    ``recvrate`` (bytes/sec over the last supervision tick) and
+    ``floodstrikes`` (receive-ceiling violations)."""
     if node.connman is None:
         return []
-    return [peer.info() for peer in node.connman.peers.values()]
+    # snapshot: the event loop evicts peers concurrently (discharges,
+    # stall/flood evictions) and a mid-iteration pop would RuntimeError
+    return [peer.info() for peer in list(node.connman.peers.values())]
 
 
 @rpc_method("getnettotals")

@@ -9,6 +9,7 @@ mitigation) is intentionally dropped — it has no behavioral surface.
 
 from __future__ import annotations
 
+import json
 import os
 import sqlite3
 import threading
@@ -37,6 +38,26 @@ def atomic_write_bytes(path: str, data: bytes) -> None:
         os.fsync(dirfd)
     finally:
         os.close(dirfd)
+
+
+def atomic_write_json(path: str, obj) -> None:
+    """JSON flavor of :func:`atomic_write_bytes` — the durable publish used
+    by the small operational sidecar files (banlist.json, like the
+    reference's banman.cpp DumpBanlist)."""
+    atomic_write_bytes(
+        path, json.dumps(obj, sort_keys=True, indent=1).encode()
+    )
+
+
+def read_json(path: str, default=None):
+    """Load a JSON sidecar written by :func:`atomic_write_json`; a missing
+    or corrupt file yields ``default`` (startup must never die on an
+    operational sidecar — the reference logs and recreates banlist.dat)."""
+    try:
+        with open(path, "rb") as f:
+            return json.loads(f.read().decode())
+    except (OSError, ValueError):
+        return default
 
 
 class KVStore:

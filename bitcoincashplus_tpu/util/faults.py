@@ -22,6 +22,16 @@ reconnect pacing (p2p/connman.py):
     rollback — a genuine mid-commit death.
   - ``Backoff`` — jittered exponential backoff (full-jitter variant) used by
     dispatch retries and the connection manager's dial loop.
+  - ``ChaosSchedule`` — deterministic, seeded planner of adversarial network
+    actions (flood bursts, non-connecting headers, stalls, scripted
+    disconnects) driving the functional ``ChaosPeer`` harness and the
+    ``net`` injection site below.
+
+Network fault site: ``BCP_FAULT_OPS=net`` arms the injector at the P2P
+message-dispatch boundary (p2p/connman.py) — ``fail-rate`` then models
+message loss, ``latency-spike`` a slow link. The ``net`` site is only armed
+when named explicitly; ``BCP_FAULT_OPS=all`` still means the accelerator
+subsystems only, so existing dead-backend drills are unchanged.
 
 Everything here is stdlib-only so every layer can import it without cycles
 (and the crash-test worker subprocess stays jax-free).
@@ -36,6 +46,10 @@ from typing import Optional
 
 # The four supervised accelerator subsystems (ops/dispatch.py breakers).
 SITES = ("sha256", "merkle", "miner", "ecdsa")
+
+# The P2P message-dispatch injection site (explicit opt-in only — never
+# part of the "all" set, see module docstring).
+NET_SITE = "net"
 
 
 class InjectedFault(RuntimeError):
@@ -106,6 +120,16 @@ class FaultInjector:
                 f"injected fault at {site} (mode={self.mode}, call #{n})"
             )
 
+    def latency(self, site: str) -> float:
+        """Latency-spike support for callers on an event loop: returns the
+        sleep they must apply themselves (``await asyncio.sleep(...)``)
+        instead of letting :meth:`on_call`'s blocking ``time.sleep`` stall
+        the whole loop. Zero when the site isn't armed for latency-spike.
+        Calls served this way are not tallied in ``calls``."""
+        if self.armed_for(site) and self.mode == "latency-spike":
+            return self.latency_s
+        return 0.0
+
     def should_poison(self, site: str) -> bool:
         """True when the dispatcher must corrupt this call's device output
         (the validation probe is then expected to catch it)."""
@@ -158,6 +182,56 @@ class Backoff:
 
     def reset(self) -> None:
         self.attempts = 0
+
+
+# The per-round action vocabulary drawn by a scheduled chaos peer
+# (tests/functional/framework.ChaosPeer's "garbage" behavior; the flood
+# and stall behaviors are continuous rather than action-scheduled).
+CHAOS_ACTIONS = (
+    "garbage-headers",  # valid-PoW headers on an unknown parent
+    "ghost",            # stop talking, keep the socket open
+    "reconnect",        # scripted disconnect + fresh session
+)
+
+
+class ChaosSchedule:
+    """Deterministic, seeded adversarial-action planner.
+
+    One instance per chaos peer: every draw (next action, pause length,
+    burst size, random bytes/hashes) comes from a single seeded rng, so a
+    campaign is replayable from its seed alone — the property the
+    randomized differential tests in this repo already rely on. The
+    schedule records its history for post-mortem assertions."""
+
+    def __init__(self, seed: int, actions: tuple = CHAOS_ACTIONS,
+                 min_pause: float = 0.05, max_pause: float = 0.4):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self.actions = tuple(actions)
+        self.min_pause = min_pause
+        self.max_pause = max_pause
+        self.history: list[str] = []
+
+    def next_action(self) -> str:
+        action = self._rng.choice(self.actions)
+        self.history.append(action)
+        return action
+
+    def pause(self) -> float:
+        span = self.max_pause - self.min_pause
+        return self.min_pause + span * self._rng.random()
+
+    def burst_size(self, lo: int = 4, hi: int = 32) -> int:
+        return self._rng.randint(lo, hi)
+
+    def randbytes(self, n: int) -> bytes:
+        return self._rng.randbytes(n)
+
+    def randhash(self) -> bytes:
+        return self._rng.randbytes(32)
+
+    def randint(self, lo: int, hi: int) -> int:
+        return self._rng.randint(lo, hi)
 
 
 def retry_call(fn, attempts: int = 3, backoff: Optional[Backoff] = None,

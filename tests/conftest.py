@@ -45,11 +45,18 @@ import pytest  # noqa: E402
 
 def pytest_collection_modifyitems(config, items):
     """Fast signal first: run the unit suite before the functional suite
-    (which spawns real bcpd processes at several minutes per file). Under
-    a bounded CI budget the run then reports the health of hundreds of
-    fast tests before sinking time into node-spawn overhead. Stable sort:
-    order within each group is unchanged."""
-    items.sort(key=lambda item: 1 if "functional" in str(item.fspath) else 0)
+    (which spawns real bcpd processes at several minutes per file), and
+    the adversarial chaos campaigns after the rest of the functional
+    suite — under a bounded CI budget the newest, heaviest campaigns are
+    the first thing a timeout cuts, never the established coverage.
+    Stable sort: order within each group is unchanged."""
+
+    def group(item) -> int:
+        if "functional" not in str(item.fspath):
+            return 0
+        return 2 if item.get_closest_marker("adversarial") else 1
+
+    items.sort(key=group)
 
 
 @pytest.fixture

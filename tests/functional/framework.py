@@ -15,9 +15,11 @@ from __future__ import annotations
 import os
 import shutil
 import socket
+import struct
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -60,7 +62,36 @@ class TestNode:
             self.args(extra), env=env, cwd=REPO_ROOT,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         )
+        # bcpd prints its "bcpd started" marker only AFTER the P2P
+        # listener is bound (RPC comes up first) — waiting for it closes
+        # the race where wait_for_rpc returns while p2p_port is not yet
+        # accepting and a raw-socket test gets ECONNREFUSED. debug output
+        # goes to debug.log, so the marker is the only stdout traffic.
+        self._wait_for_started_marker(timeout)
         self.wait_for_rpc(timeout)
+
+    def _wait_for_started_marker(self, timeout: float) -> None:
+        import select
+
+        # raw os.read on the fd, never the BufferedReader: readline would
+        # pull everything into the userspace buffer where select can't
+        # see it, and could block past the deadline on a partial line
+        fd = self.process.stdout.fileno()
+        deadline = time.time() + timeout
+        buf = b""
+        while time.time() < deadline:
+            if self.process.poll() is not None:
+                out, err = self.process.communicate()
+                raise RuntimeError(
+                    f"node{self.index} died at startup:\n{err.decode()[-2000:]}"
+                )
+            ready, _, _ = select.select([fd], [], [], 0.25)
+            if not ready:
+                continue
+            buf += os.read(fd, 4096)
+            if b"bcpd started" in buf:
+                return
+        raise TimeoutError(f"node{self.index} never printed startup marker")
 
     def wait_for_rpc(self, timeout: float = 120.0) -> None:
         from bitcoincashplus_tpu.rpc.client import RPCClient
@@ -128,6 +159,243 @@ class FunctionalFramework:
             except Exception:
                 pass
         shutil.rmtree(self.base_dir, ignore_errors=True)
+
+
+# -- chaos peers (adversarial mininodes) -------------------------------
+
+
+def default_chaos_rounds() -> int:
+    """Campaign length for chaos behaviors. BCP_CHAOS_ROUNDS tunes it:
+    the tier-1 default stays short; the `slow`-marked long campaign and
+    soak runs export a bigger value."""
+    return max(1, int(os.environ.get("BCP_CHAOS_ROUNDS", "4")))
+
+
+class ChaosPeer(threading.Thread):
+    """A mininode gone rogue: raw-socket peer that handshakes like a real
+    node, then runs one scripted adversarial behavior against the target,
+    driven by a deterministic util/faults.ChaosSchedule so every campaign
+    is replayable from its seed.
+
+    Behaviors:
+      - ``flood``   — valid-framing junk messages at line rate (trips the
+                      per-peer receive-rate ceiling)
+      - ``stall``   — announce real headers (supplied by the test), accept
+                      the resulting getdata, never answer it (trips the
+                      block-download stall detector)
+      - ``garbage`` — replay valid-PoW headers on unknown parents, go
+                      silent, and disconnect/reconnect at scripted points
+                      (accumulates graduated non-connecting-headers
+                      charges)
+
+    The thread records ``evicted`` (the node closed the connection) and
+    ``rounds_done`` for assertions; ``stop()`` ends the campaign."""
+
+    def __init__(self, p2p_port: int, behavior: str, seed: int = 0,
+                 headers: list[bytes] | None = None,
+                 rounds: int | None = None, flood_payload: int = 262_144):
+        super().__init__(daemon=True, name=f"chaos-{behavior}-{seed}")
+        from bitcoincashplus_tpu.consensus.params import regtest_params
+        from bitcoincashplus_tpu.util.faults import ChaosSchedule
+
+        assert behavior in ("flood", "stall", "garbage"), behavior
+        self.magic = regtest_params().netmagic
+        self.port = p2p_port
+        self.behavior = behavior
+        self.schedule = ChaosSchedule(seed)
+        self.headers = list(headers or [])  # raw 80-byte header blobs
+        self.rounds = rounds if rounds is not None else default_chaos_rounds()
+        self.flood_payload = flood_payload
+        self.evicted = False
+        self.rounds_done = 0
+        self.error: BaseException | None = None
+        self._halt = threading.Event()
+        self.sock: socket.socket | None = None
+
+    # -- lifecycle ------------------------------------------------------
+
+    def stop(self) -> None:
+        self._halt.set()
+        s, self.sock = self.sock, None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def run(self) -> None:
+        try:
+            self._connect_handshake()
+            getattr(self, f"_run_{self.behavior}")()
+        except socket.timeout as e:
+            # a timeout is NOT an eviction — the connection is still up;
+            # surface it so tests can't pass spuriously
+            self.error = e
+        except (ConnectionError, OSError):
+            # the node hung up on us — the eviction the tests assert on —
+            # unless we closed the socket ourselves via stop()
+            if not self._halt.is_set():
+                self.evicted = True
+        except BaseException as e:  # surfaced by the owning test
+            self.error = e
+        finally:
+            self.stop()
+
+    # -- plumbing -------------------------------------------------------
+
+    def _send(self, command: str, payload: bytes = b"") -> None:
+        from bitcoincashplus_tpu.p2p.protocol import pack_message
+
+        sock = self.sock  # local ref: stop() may null the attribute
+        if self._halt.is_set() or sock is None:
+            raise ConnectionError("stopped")
+        # a generous send timeout: _drain leaves 0.2 s on the socket, and
+        # a flood burst against a slow reader must not read as a timeout
+        sock.settimeout(10.0)
+        sock.sendall(pack_message(self.magic, command, payload))
+
+    def _drain(self, duration: float) -> None:
+        """Read and discard node traffic for ``duration`` seconds; an EOF
+        means we were evicted."""
+        sock = self.sock
+        if sock is None:
+            raise ConnectionError("stopped")
+        deadline = time.time() + duration
+        sock.settimeout(0.2)
+        while time.time() < deadline and not self._halt.is_set():
+            try:
+                data = sock.recv(65536)
+            except socket.timeout:
+                continue
+            if not data:
+                raise ConnectionError("evicted")
+
+    def _connect_handshake(self) -> None:
+        from bitcoincashplus_tpu.p2p.protocol import VersionPayload
+
+        self.sock = socket.create_connection(("127.0.0.1", self.port),
+                                             timeout=10)
+        self._send("version", VersionPayload(
+            user_agent=f"/chaos-{self.behavior}:0/").serialize())
+        # wait for the node's verack, discarding handshake chatter
+        deadline = time.time() + 10
+        while True:
+            if time.time() >= deadline:
+                # routes to self.error (socket.timeout is TimeoutError on
+                # 3.10+), never to a spurious `evicted`
+                raise socket.timeout("no verack within deadline")
+            header, _payload = self._read_msg()
+            if header[4:16].rstrip(b"\x00") == b"verack":
+                break
+        self._send("verack")
+
+    def _read_msg(self) -> tuple[bytes, bytes]:
+        header = self._recv_exact(24)
+        (length,) = struct.unpack_from("<I", header, 16)
+        return header, self._recv_exact(length)
+
+    def _recv_exact(self, n: int) -> bytes:
+        sock = self.sock
+        if sock is None:
+            raise ConnectionError("stopped")
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("closed")
+            buf += chunk
+        return buf
+
+    # -- behaviors ------------------------------------------------------
+
+    def _run_flood(self) -> None:
+        """Shovel valid-framing junk ("xchaos" is unknown and ignored, but
+        every byte counts against the receive ceiling) until evicted."""
+        while not self._halt.is_set():
+            for _ in range(self.schedule.burst_size(4, 12)):
+                self._send("xchaos",
+                           self.schedule.randbytes(self.flood_payload))
+            self.rounds_done += 1
+            self._drain(0.05)
+
+    def _run_stall(self) -> None:
+        """Announce the supplied (real) headers, then accept the node's
+        getdata and withhold every block forever."""
+        payload = _ser_raw_headers(self.headers)
+        self._send("headers", payload)
+        while not self._halt.is_set():
+            self._drain(0.5)  # read getdata/pings, answer nothing
+            self.rounds_done += 1
+
+    def _run_garbage(self) -> None:
+        """Replay garbage on a schedule: valid-PoW headers on unknown
+        parents (graduated charge), silent stretches, and scripted
+        disconnect/reconnect points."""
+        for _ in range(self.rounds):
+            if self._halt.is_set():
+                return
+            action = self.schedule.next_action()
+            if action == "garbage-headers":
+                batch = [
+                    _mine_noise_header(self.schedule)
+                    for _ in range(self.schedule.randint(1, 4))
+                ]
+                self._send("headers", _ser_raw_headers(batch))
+                self._drain(self.schedule.pause())
+            elif action == "ghost":
+                self._drain(self.schedule.pause())
+            else:  # scripted disconnect + fresh session
+                sock = self.sock  # local ref: stop() may null it
+                if sock is None:
+                    raise ConnectionError("stopped")
+                sock.close()
+                time.sleep(self.schedule.pause())
+                self._connect_handshake()
+            self.rounds_done += 1
+
+
+def _ser_raw_headers(headers80: list[bytes]) -> bytes:
+    """headers payload from raw 80-byte blobs (count + header + 0 txs)."""
+    from bitcoincashplus_tpu.consensus.serialize import ser_compact_size
+
+    return (ser_compact_size(len(headers80))
+            + b"".join(h + b"\x00" for h in headers80))
+
+
+def _mine_noise_header(schedule, bits: int = 0x207FFFFF) -> bytes:
+    """A valid-PoW regtest header on a random (unknown) parent — passes
+    the context-free PoW check, then fails connection with
+    prev-blk-not-found (the graduated misbehavior charge)."""
+    from bitcoincashplus_tpu.consensus.block import NONCE_OFFSET, CBlockHeader
+    from bitcoincashplus_tpu.consensus.pow import compact_to_target
+    from bitcoincashplus_tpu.crypto.hashes import sha256d
+
+    target, _ = compact_to_target(bits)
+    base = CBlockHeader(
+        version=0x20000000,
+        hash_prev_block=schedule.randhash(),
+        hash_merkle_root=schedule.randhash(),
+        time=int(time.time()),
+        bits=bits,
+        nonce=0,
+    ).serialize()
+    nonce = 0
+    while True:  # regtest target: ~2 attempts expected
+        raw = base[:NONCE_OFFSET] + struct.pack("<I", nonce)
+        if int.from_bytes(sha256d(raw), "little") <= target:
+            return raw
+        nonce += 1
+
+
+def raw_headers_for(node: TestNode, count: int) -> list[bytes]:
+    """The first ``count`` post-genesis headers of ``node``'s active chain
+    as raw 80-byte blobs (fed to a stalling ChaosPeer as its
+    announcement)."""
+    out = []
+    for height in range(1, count + 1):
+        raw_block = node.rpc.getblock(node.rpc.getblockhash(height), 0)
+        out.append(bytes.fromhex(raw_block)[:80])
+    return out
 
 
 # -- sync barriers (test_framework/util.py) ----------------------------

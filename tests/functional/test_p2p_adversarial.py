@@ -1,0 +1,254 @@
+"""Adversarial P2P campaigns against a live node: chaos peers (flooder /
+staller / garbage-replayer) driven by deterministic seeds, the ban-score
+ledger and stall-eviction machinery they exercise, and banlist
+persistence across restarts.
+
+Reference behaviors: src/net_processing.cpp Misbehaving + block-download
+stall handling, src/banman.cpp banlist persistence; the chaos harness is
+this framework's own (tests/functional/framework.ChaosPeer +
+util/faults.ChaosSchedule).
+
+Campaign length is env-tunable: BCP_CHAOS_ROUNDS (default 4) bounds each
+chaos behavior's scripted rounds; the long soak variant is marked `slow`.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from bitcoincashplus_tpu.wallet.keys import CKey
+from bitcoincashplus_tpu.consensus.params import regtest_params
+
+from .framework import (
+    ChaosPeer,
+    FunctionalFramework,
+    connect_nodes,
+    default_chaos_rounds,
+    raw_headers_for,
+    sync_blocks,
+    wait_until,
+)
+
+pytestmark = [pytest.mark.functional, pytest.mark.adversarial]
+
+KEY = CKey(0xFADE)
+ADDR = KEY.p2pkh_address(regtest_params())
+
+# a victim tuned for fast supervision so campaigns finish in seconds:
+# 1 s tick, 3 s download timeout, ~300 kB/s receive ceiling, pinned seed
+VICTIM_ARGS = [
+    "-nettick=1",
+    "-blockdownloadtimeout=3",
+    "-maxrecvrate=300000",
+    "-netseed=7",
+]
+
+
+def _chainstate_dict(datadir: str) -> dict[bytes, bytes]:
+    from bitcoincashplus_tpu.store.kvstore import KVStore
+
+    kv = KVStore(os.path.join(datadir, "chainstate.sqlite"))
+    out = dict(kv.iterate())
+    kv.close()
+    return out
+
+
+def _stop_peers(*peers: ChaosPeer) -> None:
+    for p in peers:
+        p.stop()
+    for p in peers:
+        p.join(10)
+        if p.error is not None:
+            raise p.error
+
+
+def test_stall_eviction_and_rerequest():
+    """A peer that announces real headers and then withholds every block
+    is charged (visible in getpeerinfo while still connected), its
+    in-flight blocks are re-requested from the honest peer, sync
+    completes, and the staller is evicted without operator action."""
+    with FunctionalFramework(num_nodes=2,
+                             extra_args=[[], VICTIM_ARGS]) as f:
+        honest, victim = f.nodes
+        honest.rpc.generatetoaddress(8, ADDR)
+        headers = raw_headers_for(honest, 8)
+
+        staller = ChaosPeer(victim.p2p_port, "stall", seed=11,
+                            headers=headers)
+        staller.start()
+        # the victim asks the staller for all 8 announced blocks
+        wait_until(lambda: any(p["inflight"] > 0
+                               for p in victim.rpc.getpeerinfo()),
+                   timeout=15)
+
+        # honest peer joins; the blocks are already reserved against the
+        # staller, so only the stall detector can move them over
+        connect_nodes(victim, honest)
+
+        # the ledger charge is observable before the eviction: the staller
+        # shows stalling=true with half the threshold on its banscore
+        def _charged():
+            return any(
+                p["stalling"] and p["banscore"] >= 50
+                and p["charges"].get("stalled-block")
+                for p in victim.rpc.getpeerinfo()
+            )
+        wait_until(_charged, timeout=20, sleep=0.1)
+
+        # re-request from the honest peer completes the sync
+        wait_until(lambda: victim.rpc.getblockcount() == 8, timeout=30)
+        assert victim.rpc.getbestblockhash() == honest.rpc.getbestblockhash()
+
+        # and the staller is gone, charged off the ledger
+        wait_until(lambda: staller.evicted, timeout=30)
+        net = victim.rpc.gettpuinfo()["net"]
+        # how the withheld blocks moved off the staller is timing-
+        # dependent (stall re-request to an announcer, parked handoff, or
+        # a fresh headers-path request after the honest peer's own
+        # announcement) — the deterministic observables are that the
+        # stall machinery fired and sync completed anyway (asserted
+        # above), so only assert the eviction counters here
+        assert net["evicted_stallers"] >= 1
+        assert net["discharge_reasons"].get("stalled-block", 0) >= 1
+        _stop_peers(staller)
+
+
+def test_chaos_sync_chainstate_identical():
+    """Acceptance chaos e2e: a victim fed by one honest node plus three
+    chaos peers (flooder, staller, garbage-replayer) syncs to the honest
+    tip with a chainstate byte-identical to a control node synced from
+    the honest peer alone, evicting the flooder and staller on its own."""
+    with FunctionalFramework(
+        num_nodes=3, extra_args=[[], [], VICTIM_ARGS]
+    ) as f:
+        honest, control, victim = f.nodes
+        honest.rpc.generatetoaddress(12, ADDR)
+        headers = raw_headers_for(honest, 12)
+
+        # the staller announces first so the victim reserves the blocks
+        # against it (the honest peer then only gets them via the stall
+        # detector's re-request)
+        staller = ChaosPeer(victim.p2p_port, "stall", seed=22,
+                            headers=headers)
+        staller.start()
+        wait_until(lambda: any(p["inflight"] > 0
+                               for p in victim.rpc.getpeerinfo()),
+                   timeout=15)
+
+        flooder = ChaosPeer(victim.p2p_port, "flood", seed=21)
+        garbage = ChaosPeer(victim.p2p_port, "garbage", seed=23,
+                            rounds=default_chaos_rounds())
+        flooder.start()
+        garbage.start()
+        connect_nodes(victim, honest)
+        connect_nodes(control, honest)
+
+        # both reach the honest tip despite the hostile peers
+        sync_blocks([honest, victim, control], timeout=90)
+        assert victim.rpc.getblockcount() == 12
+
+        # the flooder trips the receive ceiling, the staller the download
+        # timeout — both evicted without any operator RPC
+        wait_until(lambda: flooder.evicted, timeout=30)
+        wait_until(lambda: staller.evicted, timeout=30)
+        net = victim.rpc.gettpuinfo()["net"]
+        assert net["discharge_reasons"].get("recv-flood", 0) >= 1
+        assert net["discharge_reasons"].get("stalled-block", 0) >= 1
+        assert net["discharged_peers"] >= 2
+        _stop_peers(flooder, staller, garbage)
+
+        # chainstates must match byte-for-byte after an orderly flush
+        victim_dir, control_dir = victim.datadir, control.datadir
+        victim.stop()
+        control.stop()
+        assert _chainstate_dict(victim_dir) == _chainstate_dict(control_dir)
+
+
+def test_garbage_headers_accumulate_graduated_charges():
+    """Non-connecting (but valid-PoW) headers draw the graduated charge,
+    not an instant disconnect: the replayer stays connected with a rising
+    banscore until the threshold discharges it."""
+    # 3 charged batches = eviction; every non-connecting batch charges
+    # (the production default of every-10th, with the counter resetting on
+    # connecting batches and the ledger on the replayer's scripted
+    # reconnects, would make graduated accumulation take minutes here)
+    victim_args = VICTIM_ARGS + ["-banscore=30", "-maxunconnectingheaders=1"]
+    with FunctionalFramework(num_nodes=1, extra_args=[victim_args]) as f:
+        victim = f.nodes[0]
+        garbage = ChaosPeer(victim.p2p_port, "garbage", seed=31, rounds=999)
+        garbage.start()
+
+        def _charged():
+            return any(
+                p["charges"].get("non-connecting-headers", 0) >= 10
+                for p in victim.rpc.getpeerinfo()
+            )
+        wait_until(_charged, timeout=30, sleep=0.1)
+        # the replayer keeps going; the ledger eventually discharges it
+        wait_until(
+            lambda: victim.rpc.gettpuinfo()["net"]["discharge_reasons"]
+            .get("non-connecting-headers", 0) >= 1,
+            timeout=60,
+        )
+        garbage.stop()
+        garbage.join(10)
+        # node is healthy and still serving
+        victim.rpc.generatetoaddress(1, ADDR)
+        assert victim.rpc.getblockcount() == 1
+
+
+def test_banlist_survives_restart():
+    """setban writes through to banlist.json; the ban outlives a restart
+    (banman.cpp DumpBanlist/LoadBanlist) and clearbanned erases it
+    durably."""
+    with FunctionalFramework(num_nodes=1) as f:
+        node = f.nodes[0]
+        node.rpc.setban("203.0.113.77", "add", 86400)
+        assert any(e["address"] == "203.0.113.77"
+                   for e in node.rpc.listbanned())
+        banlist = os.path.join(node.datadir, "banlist.json")
+        assert os.path.exists(banlist)
+
+        node.stop()
+        node.start()
+        entries = node.rpc.listbanned()
+        assert any(e["address"] == "203.0.113.77" for e in entries)
+        assert all(e["banned_until"] > time.time() for e in entries)
+
+        node.rpc.clearbanned()
+        node.stop()
+        node.start()
+        assert node.rpc.listbanned() == []
+
+
+@pytest.mark.slow
+def test_chaos_long_campaign():
+    """Long soak: several chaos generations against one victim. Scripted
+    by seed, length scaled by BCP_CHAOS_ROUNDS; the victim must keep
+    serving RPC and accepting honest blocks throughout."""
+    rounds = default_chaos_rounds() * 10
+    with FunctionalFramework(num_nodes=2,
+                             extra_args=[[], VICTIM_ARGS]) as f:
+        honest, victim = f.nodes
+        honest.rpc.generatetoaddress(5, ADDR)
+        connect_nodes(victim, honest)
+        sync_blocks([honest, victim], timeout=60)
+
+        for generation in range(3):
+            flooder = ChaosPeer(victim.p2p_port, "flood",
+                                seed=100 + generation)
+            garbage = ChaosPeer(victim.p2p_port, "garbage",
+                                seed=200 + generation, rounds=rounds)
+            flooder.start()
+            garbage.start()
+            wait_until(lambda: flooder.evicted, timeout=60)
+            honest.rpc.generatetoaddress(1, ADDR)
+            sync_blocks([honest, victim], timeout=60)
+            _stop_peers(flooder, garbage)
+
+        net = victim.rpc.gettpuinfo()["net"]
+        assert net["discharge_reasons"].get("recv-flood", 0) >= 3
+        assert victim.rpc.getblockcount() == 8

@@ -1,0 +1,813 @@
+"""Fake-clock coverage for CConnman's supervision tick (_tick) and the
+ban-score ledger: inactivity/ping cadence, receive-rate ceilings, block-
+download stall detection with re-request + eviction, the bounded seeded-
+random orphan pool with per-peer attribution, and banlist persistence.
+
+No sockets, no event loop: peers get fake transports and _tick is driven
+directly with an advanced ``now`` — the path TIMEOUT_INTERVAL previously
+only exercised implicitly through a live node."""
+
+from __future__ import annotations
+
+import struct
+import time
+
+import pytest
+
+from bitcoincashplus_tpu.p2p import connman as cm_mod
+from bitcoincashplus_tpu.p2p.connman import (
+    CHARGE_RECV_FLOOD,
+    MAX_ORPHAN_BYTES,
+    MAX_ORPHAN_TX,
+    ORPHAN_EXPIRE_TIME,
+    PING_INTERVAL,
+    TIMEOUT_INTERVAL,
+    CConnman,
+    Peer,
+)
+from bitcoincashplus_tpu.p2p.protocol import HEADER_SIZE, VersionPayload
+from bitcoincashplus_tpu.store.kvstore import atomic_write_json
+
+
+class FakeWriter:
+    def __init__(self):
+        self.closed = False
+        self.sent = b""
+
+    def get_extra_info(self, name):
+        return ("127.0.0.1", 48444)
+
+    def write(self, data):
+        self.sent += data
+
+    def close(self):
+        self.closed = True
+
+    def commands(self) -> list[str]:
+        """Parse the framed commands written so far."""
+        out, buf = [], self.sent
+        while len(buf) >= HEADER_SIZE:
+            cmd = buf[4:16].rstrip(b"\x00").decode()
+            (length,) = struct.unpack_from("<I", buf, 16)
+            out.append(cmd)
+            buf = buf[HEADER_SIZE + length:]
+        return out
+
+
+class StubConfig:
+    def __init__(self, **kv):
+        self.kv = kv
+
+    def get_int(self, name, default=0):
+        return self.kv.get(name, default)
+
+
+class StubNode:
+    def __init__(self, datadir, **limits):
+        class _P:
+            netmagic = b"\xfa\xbf\xb5\xda"
+
+        self.params = _P()
+        self.datadir = str(datadir)
+        self.config = StubConfig()
+        self.net_limits = {
+            "banscore": 100,
+            "blockdownloadtimeout": 10,
+            "nettick": 5,
+            "maxrecvrate": 1000,
+            "netseed": 42,
+            **limits,
+        }
+
+
+class StubTx:
+    def __init__(self, n: int, size: int = 200):
+        self.txid = n.to_bytes(32, "little")
+        self.txid_hex = self.txid[::-1].hex()
+        self._raw = b"\x00" * size
+        self.vin = ()
+
+    def serialize(self) -> bytes:
+        return self._raw
+
+
+def make_connman(tmp_path, **limits) -> CConnman:
+    return CConnman(StubNode(tmp_path, **limits))
+
+
+def make_peer(cm: CConnman, handshaked: bool = True) -> Peer:
+    peer = Peer(cm, None, FakeWriter(), outbound=False)
+    if handshaked:
+        peer.version = VersionPayload()
+        peer.got_verack = True
+    cm.peers[peer.id] = peer
+    return peer
+
+
+class TestInactivityAndPing:
+    def test_inactivity_timeout_drops_peer(self, tmp_path):
+        cm = make_connman(tmp_path)
+        peer = make_peer(cm)
+        peer.last_recv = peer.connected_at
+        cm._tick(peer.connected_at + TIMEOUT_INTERVAL + 1)
+        assert peer.writer.closed
+
+    def test_quiet_but_within_interval_is_kept(self, tmp_path):
+        cm = make_connman(tmp_path)
+        peer = make_peer(cm)
+        peer.last_recv = peer.connected_at
+        cm._tick(peer.connected_at + TIMEOUT_INTERVAL - 1)
+        assert not peer.writer.closed
+
+    def test_ping_cadence_follows_wall_clock_not_tick_rate(self, tmp_path):
+        cm = make_connman(tmp_path)
+        peer = make_peer(cm)
+        t0 = peer.connected_at
+        # many fast ticks before PING_INTERVAL elapses: no ping
+        for dt in (1, 5, 30, PING_INTERVAL - 1):
+            cm._tick(t0 + dt)
+        assert "ping" not in peer.writer.commands()
+        cm._tick(t0 + PING_INTERVAL + 1)
+        assert peer.writer.commands().count("ping") == 1
+        # immediately after, the cadence gate holds
+        cm._tick(t0 + PING_INTERVAL + 2)
+        assert peer.writer.commands().count("ping") == 1
+        cm._tick(t0 + 2 * PING_INTERVAL + 2)
+        assert peer.writer.commands().count("ping") == 2
+
+    def test_unhandshaked_peer_is_never_pinged(self, tmp_path):
+        cm = make_connman(tmp_path)
+        peer = make_peer(cm, handshaked=False)
+        cm._tick(peer.connected_at + PING_INTERVAL + 1)
+        assert "ping" not in peer.writer.commands()
+
+
+class TestRecvRateCeiling:
+    def test_flood_charges_accumulate_to_eviction(self, tmp_path):
+        # ceiling: 1000 B/s over a 5 s tick window = 5000 bytes/tick
+        cm = make_connman(tmp_path)
+        peer = make_peer(cm)
+        now = peer.connected_at
+        for i in range(1, 4):
+            peer.recv_window = 1_000_000
+            cm._tick(now + i)
+            assert peer.ban_score == CHARGE_RECV_FLOOD * i
+            assert peer.flood_strikes == i
+            assert not peer.discharged
+            assert peer.recv_window == 0  # window closed each tick
+        peer.recv_window = 1_000_000
+        cm._tick(now + 4)
+        assert peer.discharged and peer.writer.closed
+        assert cm.net_stats["flood_charges"] == 4
+        assert cm.net_stats["discharged_peers"] == 1
+        assert cm.discharge_reasons == {"recv-flood": 1}
+
+    def test_rate_below_ceiling_is_free(self, tmp_path):
+        cm = make_connman(tmp_path)
+        peer = make_peer(cm)
+        peer.recv_window = 4_000  # under 5000/tick
+        cm._tick(peer.connected_at + 1)
+        assert peer.ban_score == 0
+        assert peer.recv_rate == pytest.approx(800.0)
+
+    def test_zero_ceiling_disables_the_check(self, tmp_path):
+        cm = make_connman(tmp_path, maxrecvrate=0)
+        peer = make_peer(cm)
+        peer.recv_window = 10_000_000
+        cm._tick(peer.connected_at + 1)
+        assert peer.ban_score == 0
+
+    def test_solicited_block_bytes_are_exempt(self, tmp_path):
+        """An honest peer serving our own getdata at wire speed must not
+        be flood-charged: delivered in-flight blocks credit their wire
+        bytes back out of the window. Unsolicited replays don't."""
+        cm = make_connman(tmp_path)
+        peer = make_peer(cm)
+        h = b"\x07" * 32
+        cm._request_blocks(peer, [h], now=peer.connected_at)
+        peer.recv_window = 2_000_000
+        cm._note_block_arrival(peer, h, wire_bytes=2_000_000)
+        assert peer.recv_window == 0
+        cm._tick(peer.connected_at + 1)
+        assert peer.ban_score == 0
+        # the same bytes from a block nobody asked for still count
+        peer.recv_window = 2_000_000
+        cm._note_block_arrival(peer, b"\x08" * 32, wire_bytes=2_000_000)
+        assert peer.recv_window == 2_000_000
+        cm._tick(peer.connected_at + 2)
+        assert peer.ban_score == CHARGE_RECV_FLOOD
+
+    def test_rate_normalizes_by_actual_elapsed_time(self, tmp_path):
+        """A delayed tick draining a backlog must divide by the real
+        elapsed time, not the nominal cadence."""
+        cm = make_connman(tmp_path)  # ceiling 1000 B/s
+        peer = make_peer(cm)
+        t0 = peer.connected_at
+        cm._tick(t0 + 1)
+        # 10 s of silence, then 9000 buffered bytes drain: 900 B/s, legal
+        peer.recv_window = 9_000
+        cm._tick(t0 + 11)
+        assert peer.recv_rate == pytest.approx(900.0)
+        assert peer.ban_score == 0
+
+
+def announce(cm: CConnman, peer: Peer, *hashes: bytes) -> None:
+    """Record ``peer`` as an announcer of the hashes, the way a headers
+    batch or cmpctblock does — re-requests route only to announcers."""
+    for h in hashes:
+        cm._block_sources.setdefault(h, set()).add(peer.id)
+
+
+class TestStallDetection:
+    H1, H2 = b"\x01" * 32, b"\x02" * 32
+
+    def test_stall_charges_rerequests_then_evicts(self, tmp_path):
+        cm = make_connman(tmp_path)  # blockdownloadtimeout=10
+        staller = make_peer(cm)
+        other = make_peer(cm)
+        announce(cm, other, self.H1, self.H2)
+        t0 = time.time()
+        cm._request_blocks(staller, [self.H1, self.H2], now=t0)
+        assert "getdata" in staller.writer.commands()
+        assert staller.inflight == {self.H1, self.H2}
+
+        # within the timeout: nothing happens
+        cm._tick(t0 + 9)
+        assert not staller.stalling and staller.ban_score == 0
+
+        # first timeout: charged half the threshold, marked stalling, and
+        # the blocks move to the other peer in one getdata
+        cm._tick(t0 + 11)
+        assert staller.stalling
+        assert staller.ban_score == 50
+        assert staller.charges == {"stalled-block": 50}
+        assert not staller.discharged  # the charge is observable pre-evict
+        assert staller.inflight == set()
+        assert other.inflight == {self.H1, self.H2}
+        assert cm._requested_blocks == {self.H1: other.id, self.H2: other.id}
+        assert "getdata" in other.writer.commands()
+        assert cm.net_stats["stall_rerequests"] == 2
+
+        # second timeout without redemption: discharged and evicted
+        cm._tick(t0 + 22)
+        assert staller.discharged and staller.writer.closed
+        assert cm.net_stats["evicted_stallers"] == 1
+        assert cm.discharge_reasons == {"stalled-block": 1}
+
+    def test_block_arrival_redeems_a_stalling_peer(self, tmp_path):
+        cm = make_connman(tmp_path)
+        peer = make_peer(cm)
+        t0 = time.time()
+        cm._request_blocks(peer, [self.H1], now=t0)
+        cm._tick(t0 + 11)
+        assert peer.stalling and peer.ban_score == 50
+        cm._note_block_arrival(peer, self.H1)
+        assert not peer.stalling
+        # redemption rolls the provisional charge back off the ledger —
+        # an honest slow link must not be one episode from eviction
+        assert peer.ban_score == 0
+        assert "stalled-block" not in peer.charges
+        cm._tick(t0 + 22)
+        assert not peer.discharged and not peer.writer.closed
+        # a second slow episode charges afresh, it does NOT discharge
+        cm._request_blocks(peer, [self.H2], now=t0 + 22)
+        cm._tick(t0 + 34)
+        assert peer.stalling and peer.ban_score == 50
+        assert not peer.discharged
+
+    def test_no_fallback_parks_blocks_then_first_peer_gets_them(self, tmp_path):
+        cm = make_connman(tmp_path)
+        staller = make_peer(cm)
+        t0 = time.time()
+        cm._request_blocks(staller, [self.H1], now=t0)
+        cm._tick(t0 + 11)  # stall with no other peer: parked
+        assert self.H1 in cm._unrequested
+        assert self.H1 not in cm._requested_blocks
+        late = make_peer(cm)
+        announce(cm, late, self.H1)  # the newcomer announced it too
+        cm._tick(t0 + 12)
+        assert cm._unrequested == set()
+        assert late.inflight == {self.H1}
+        assert "getdata" in late.writer.commands()
+
+    def test_unsolicited_duplicates_do_not_defeat_the_stall_detector(
+            self, tmp_path):
+        """A withholding peer feeding blocks we never asked it for (e.g.
+        replaying genesis) must not count as download progress: the stall
+        still fires and its reserved blocks still move on."""
+        cm = make_connman(tmp_path)
+        staller = make_peer(cm)
+        other = make_peer(cm)
+        announce(cm, other, self.H1)
+        t0 = time.time()
+        cm._request_blocks(staller, [self.H1], now=t0 - 11)
+        # unsolicited noise right before the tick — not an owed block
+        cm._note_block_arrival(staller, b"\xee" * 32)
+        cm._tick(t0)
+        assert staller.stalling and staller.ban_score == 50
+        assert other.inflight == {self.H1}
+        # more noise can't redeem it either; eviction proceeds
+        cm._note_block_arrival(staller, b"\xdd" * 32)
+        assert staller.stalling
+        cm._tick(t0 + 11)
+        assert staller.discharged
+
+    def test_late_delivery_clears_the_reassigned_owner(self, tmp_path):
+        """A slow-but-honest peer delivering AFTER its block moved to
+        another peer must not leave the new owner with a phantom
+        in-flight entry (which would falsely stall and evict it)."""
+        cm = make_connman(tmp_path)
+        slow = make_peer(cm)
+        other = make_peer(cm)
+        announce(cm, other, self.H1)
+        t0 = time.time()
+        cm._request_blocks(slow, [self.H1], now=t0)
+        cm._tick(t0 + 11)  # slow stalls; H1 reassigned to other
+        assert other.inflight == {self.H1}
+        cm._note_block_arrival(slow, self.H1)  # the laggard delivers
+        assert other.inflight == set()
+        cm._tick(t0 + 25)
+        assert not other.stalling and not other.discharged
+
+    def test_trickled_requests_do_not_refresh_the_stall_clock(self, tmp_path):
+        """A withholding peer that keeps announcing one new header per
+        timeout window earns a fresh getdata each time — the SENDS must
+        not count as download progress, or its growing in-flight set
+        never trips the stall detector (header-trickle hostage attack)."""
+        cm = make_connman(tmp_path)  # blockdownloadtimeout=10
+        peer = make_peer(cm)
+        t0 = time.time()
+        cm._request_blocks(peer, [self.H1], now=t0)
+        cm._request_blocks(peer, [self.H2], now=t0 + 8)  # trickle
+        cm._request_blocks(peer, [b"\x03" * 32], now=t0 + 10.5)
+        cm._tick(t0 + 11)  # H1 is 11s old with zero arrivals: stalled
+        assert peer.stalling
+        assert peer.ban_score == 50
+
+    def test_progress_refreshes_the_stall_clock(self, tmp_path):
+        cm = make_connman(tmp_path)
+        peer = make_peer(cm)
+        t0 = time.time()
+        # requested 15 s ago — would stall at t0+5 with no progress...
+        cm._request_blocks(peer, [self.H1, self.H2], now=t0 - 15)
+        # ...but a block arriving now restarts the clock for the rest
+        cm._note_block_arrival(peer, self.H1)
+        cm._tick(t0 + 5)
+        assert not peer.stalling
+
+    def test_non_announcers_are_never_handed_a_stallers_blocks(
+            self, tmp_path):
+        """Re-requests route only to peers that announced the block: an
+        attacker's undeliverable announcement must not migrate onto an
+        honest peer (who could not serve it and would be stall-charged
+        and cascade-evicted for the attacker's lie). With no announcer
+        left the download is forgotten entirely."""
+        cm = make_connman(tmp_path)
+        attacker = make_peer(cm)
+        honest = make_peer(cm)  # never announced H1
+        t0 = time.time()
+        cm._request_blocks(attacker, [self.H1], now=t0)
+        cm._tick(t0 + 11)  # attacker stalls
+        assert attacker.stalling
+        # the hash is parked (attacker is still the only live announcer),
+        # never assigned to the honest non-announcer
+        assert honest.inflight == set()
+        assert self.H1 in cm._unrequested
+        assert honest.ban_score == 0
+        # attacker disconnects: no announcer left -> download dropped
+        del cm.peers[attacker.id]
+        cm._tick(t0 + 12)
+        assert self.H1 not in cm._unrequested
+        assert self.H1 not in cm._block_sources
+        assert honest.inflight == set()
+
+    def test_stalling_announcer_cannot_rereserve_blocks(self, tmp_path):
+        """A peer already marked stalling that announces fresh headers
+        must not get the getdata (re-reserving hashes against itself
+        would buy an extra timeout of sync delay per stall-reannounce
+        cycle): the hashes park for a healthy announcer instead."""
+        import threading
+
+        from bitcoincashplus_tpu.consensus.block import CBlockHeader
+        from bitcoincashplus_tpu.consensus.serialize import ser_compact_size
+
+        cm = make_connman(tmp_path)
+        peer = make_peer(cm)
+        peer.stalling = True
+        cm.node.cs_main = threading.RLock()
+        hdr = CBlockHeader(version=0x20000000, hash_prev_block=b"\x11" * 32,
+                           hash_merkle_root=b"\x22" * 32, time=1,
+                           bits=0x207FFFFF, nonce=0)
+        wanted = hdr.get_hash()
+
+        class _Idx:
+            status = 0
+            hash = wanted
+
+        class _CS:
+            block_index = {}
+
+            @staticmethod
+            def accept_block_header(header):
+                return _Idx()
+
+        cm.node.chainstate = _CS()
+        payload = ser_compact_size(1) + hdr.serialize() + b"\x00"
+        cm._msg_headers(peer, payload)
+        assert peer.inflight == set()
+        assert wanted not in cm._requested_blocks
+        assert wanted in cm._unrequested
+        # ...but it IS recorded as an announcer (fair game once redeemed)
+        assert peer.id in cm._block_sources[wanted]
+
+    def test_partially_connecting_batch_does_not_reset_the_counter(
+            self, tmp_path):
+        """Prepending one known header (e.g. genesis) to every garbage
+        batch must not evade the graduated non-connecting-headers charge:
+        only a batch that connects end to end redeems the counter."""
+        import threading
+
+        from bitcoincashplus_tpu.consensus.block import CBlockHeader
+        from bitcoincashplus_tpu.consensus.serialize import ser_compact_size
+        from bitcoincashplus_tpu.validation.chainstate import (
+            BlockValidationError,
+        )
+
+        cm = make_connman(tmp_path)
+        peer = make_peer(cm)
+        cm.node.cs_main = threading.RLock()
+
+        known = CBlockHeader(version=0x20000000,
+                             hash_prev_block=b"\x11" * 32,
+                             hash_merkle_root=b"\x22" * 32, time=1,
+                             bits=0x207FFFFF, nonce=0)
+        garbage = CBlockHeader(version=0x20000000,
+                               hash_prev_block=b"\x99" * 32,
+                               hash_merkle_root=b"\x22" * 32, time=1,
+                               bits=0x207FFFFF, nonce=1)
+
+        class _Idx:
+            status = cm_mod.BlockStatus.HAVE_DATA
+            hash = known.get_hash()
+
+        class _Chain:
+            @staticmethod
+            def get_locator(*a):
+                return []
+
+        class _CS:
+            chain = _Chain()
+            block_index = {known.get_hash(): _Idx}
+
+            @staticmethod
+            def accept_block_header(header):
+                if header.get_hash() == known.get_hash():
+                    return _Idx()  # the known prefix accepts cleanly
+                raise BlockValidationError("prev-blk-not-found", "x")
+
+        cm.node.chainstate = _CS()
+        batch = (ser_compact_size(2) + known.serialize() + b"\x00"
+                 + garbage.serialize() + b"\x00")
+        for i in range(1, cm.max_unconnecting + 1):
+            cm._msg_headers(peer, batch)
+            assert peer.unconnecting_headers == i  # never reset mid-batch
+        assert peer.charges.get("non-connecting-headers") == \
+            cm_mod.CHARGE_NONCONNECTING_HEADERS
+
+        # the cross-batch variant: alternating a garbage batch with a
+        # REPLAY of known headers must not reset the counter either —
+        # only a batch that teaches a new connecting header redeems
+        peer2 = make_peer(cm)
+        garbage_batch = ser_compact_size(1) + garbage.serialize() + b"\x00"
+        known_batch = ser_compact_size(1) + known.serialize() + b"\x00"
+        for i in range(1, cm.max_unconnecting + 1):
+            cm._msg_headers(peer2, garbage_batch)
+            cm._msg_headers(peer2, known_batch)  # replay, not redemption
+            assert peer2.unconnecting_headers == i
+        assert peer2.charges.get("non-connecting-headers") == \
+            cm_mod.CHARGE_NONCONNECTING_HEADERS
+
+    def test_blocktxn_stale_hash_not_tracked_for_non_announcer(
+            self, tmp_path):
+        """The blocktxn stale-reply path must not register an
+        attacker-chosen hash in the download tracker (nobody can ever
+        deliver it); only a hash the peer actually announced is
+        re-fetched in full."""
+        cm = make_connman(tmp_path)
+        peer = make_peer(cm)
+        garbage = b"\x66" * 32
+        # simulate the guard condition directly: not an announced hash
+        assert peer.id not in cm._block_sources.get(garbage, ())
+        # announced hashes pass the same gate
+        announce(cm, peer, self.H1)
+        assert peer.id in cm._block_sources.get(self.H1, ())
+
+
+class TestMisbehavingLedger:
+    def test_graduated_charges_reach_threshold_once(self, tmp_path):
+        cm = make_connman(tmp_path)
+        peer = make_peer(cm)
+        for _ in range(9):
+            cm.misbehaving(peer, 10, "non-connecting-headers")
+        assert peer.ban_score == 90 and not peer.discharged
+        cm.misbehaving(peer, 10, "non-connecting-headers")
+        assert peer.discharged and peer.writer.closed
+        # further charges don't double-count the discharge
+        cm.misbehaving(peer, 10, "non-connecting-headers")
+        assert cm.net_stats["discharged_peers"] == 1
+        assert cm.net_stats["misbehavior_charges"] == 11
+        assert peer.charges == {"non-connecting-headers": 110}
+
+    def test_custom_threshold(self, tmp_path):
+        cm = make_connman(tmp_path, banscore=30)
+        peer = make_peer(cm)
+        cm.misbehaving(peer, 25, "recv-flood")
+        assert not peer.discharged
+        cm.misbehaving(peer, 5, "recv-flood")
+        assert peer.discharged
+
+    def test_reason_keys_are_bounded(self, tmp_path):
+        """Reason strings can embed attacker-chosen values; the ledger
+        dicts cap key length and distinct-key count (overflow buckets to
+        'other') so a reconnecting attacker can't grow them unboundedly."""
+        cm = make_connman(tmp_path, banscore=10_000_000)
+        peer = make_peer(cm)
+        for i in range(200):
+            cm.misbehaving(peer, 1, f"oversized payload {i} " + "x" * 100)
+        assert len(peer.charges) <= CConnman.MAX_REASON_KEYS + 1
+        assert all(len(k) <= CConnman.MAX_REASON_LEN for k in peer.charges)
+        assert peer.charges["other"] > 0
+        assert sum(peer.charges.values()) == 200  # nothing lost
+
+    def test_info_exposes_the_ledger(self, tmp_path):
+        cm = make_connman(tmp_path)
+        peer = make_peer(cm)
+        cm.misbehaving(peer, 10, "invalid-tx")
+        info = peer.info()
+        assert info["banscore"] == 10
+        assert info["charges"] == {"invalid-tx": 10}
+        assert info["inflight"] == 0 and info["stalling"] is False
+
+
+class TestOrphanPool:
+    def test_count_cap_with_seeded_random_eviction(self, tmp_path):
+        cm = make_connman(tmp_path)
+        for i in range(MAX_ORPHAN_TX + 20):
+            cm._add_orphan(None, StubTx(i, size=100))
+        assert len(cm._orphans) == MAX_ORPHAN_TX
+        assert cm.net_stats["orphans_evicted"] == 20
+        # deterministic: the same seed evicts the same victims
+        cm2 = make_connman(tmp_path)
+        for i in range(MAX_ORPHAN_TX + 20):
+            cm2._add_orphan(None, StubTx(i, size=100))
+        assert set(cm._orphans) == set(cm2._orphans)
+
+    def test_byte_budget_binds_before_the_count_cap(self, tmp_path):
+        cm = make_connman(tmp_path)
+        big = MAX_ORPHAN_BYTES // 6
+        for i in range(10):
+            cm._add_orphan(None, StubTx(i, size=big))
+        assert cm._orphan_bytes <= MAX_ORPHAN_BYTES
+        assert len(cm._orphans) < 10
+
+    def test_oversized_orphan_is_dropped_outright(self, tmp_path):
+        cm = make_connman(tmp_path)
+        cm._add_orphan(None, StubTx(1, size=150_000))
+        assert cm._orphans == {} and cm._orphan_bytes == 0
+
+    def test_per_peer_attribution_erase(self, tmp_path):
+        cm = make_connman(tmp_path)
+        a, b = make_peer(cm), make_peer(cm)
+        for i in range(4):
+            cm._add_orphan(a, StubTx(i))
+        for i in range(4, 6):
+            cm._add_orphan(b, StubTx(i))
+        cm._erase_orphans_for(a.id)
+        assert len(cm._orphans) == 2
+        assert all(e[1] == b.id for e in cm._orphans.values())
+        assert cm._orphan_bytes == sum(e[2] for e in cm._orphans.values())
+
+    def test_expiry_in_tick(self, tmp_path):
+        cm = make_connman(tmp_path)
+        cm._add_orphan(None, StubTx(1))
+        cm._add_orphan(None, StubTx(2))
+        txid = StubTx(1).txid
+        tx, pid, size, _added = cm._orphans[txid]
+        cm._orphans[txid] = (tx, pid, size,
+                             time.time() - ORPHAN_EXPIRE_TIME - 1)
+        cm._tick(time.time())
+        assert txid not in cm._orphans
+        assert len(cm._orphans) == 1
+
+
+class TestBanlistPersistence:
+    def test_write_through_and_reload(self, tmp_path):
+        cm = make_connman(tmp_path)
+        cm.ban("203.0.113.7", 3600)
+        assert (tmp_path / "banlist.json").exists()
+        cm2 = make_connman(tmp_path)
+        assert cm2.is_banned("203.0.113.7")
+        assert cm2.unban("203.0.113.7")
+        cm3 = make_connman(tmp_path)
+        assert not cm3.is_banned("203.0.113.7")
+
+    def test_expired_entries_are_pruned_on_load(self, tmp_path):
+        atomic_write_json(str(tmp_path / "banlist.json"), {
+            "version": 1,
+            "banned": {"198.51.100.1": time.time() - 10,
+                       "198.51.100.2": time.time() + 3600},
+        })
+        cm = make_connman(tmp_path)
+        assert not cm.is_banned("198.51.100.1")
+        assert cm.is_banned("198.51.100.2")
+
+    def test_corrupt_banlist_is_ignored(self, tmp_path):
+        (tmp_path / "banlist.json").write_bytes(b"{not json")
+        cm = make_connman(tmp_path)
+        assert cm.banned() == {}
+
+    def test_structurally_malformed_banlist_is_ignored(self, tmp_path):
+        # valid JSON, wrong shape: a list where the dict should be, and a
+        # non-numeric expiry — startup must start clean, not die
+        for blob in (b'{"banned": ["1.2.3.4"]}',
+                     b'{"banned": {"1.2.3.4": "soon"}}',
+                     b'{"banned": 7}'):
+            (tmp_path / "banlist.json").write_bytes(blob)
+            cm = make_connman(tmp_path)
+            assert cm.banned() == {}
+
+    def test_clear_banned_writes_through(self, tmp_path):
+        cm = make_connman(tmp_path)
+        cm.ban("203.0.113.9", 3600)
+        cm.clear_banned()
+        cm2 = make_connman(tmp_path)
+        assert cm2.banned() == {}
+
+
+class TestChargePolicy:
+    """Reject reasons that must (and must not) reach the misbehavior
+    ledger: policy and clock-subjective rejections are never charged."""
+
+    @staticmethod
+    def _accept_with_reject(cm, peer, reason):
+        from bitcoincashplus_tpu.mempool.mempool import MempoolError
+
+        def _reject(tx, now=None, fee_estimate=True):
+            raise MempoolError(reason)
+
+        cm.node.accept_to_mempool = _reject
+        cm._accept_tx(peer, StubTx(1))
+
+    @pytest.mark.parametrize("reason", sorted(cm_mod.POLICY_BAD_TXNS) + [
+        "non-final", "txn-already-in-mempool", "mempool-min-fee-not-met",
+        "dust",
+        # script failures are ambiguous (mempool verifies with STANDARD
+        # flags, a superset of consensus): never charged
+        "mandatory-script-verify-flag-failed",
+    ])
+    def test_policy_rejects_charge_nothing(self, tmp_path, reason):
+        cm = make_connman(tmp_path)
+        peer = make_peer(cm)
+        self._accept_with_reject(cm, peer, reason)
+        assert peer.ban_score == 0
+        assert not peer.writer.closed
+
+    @pytest.mark.parametrize("reason", [
+        "bad-txns-vin-empty", "bad-txns-in-belowout", "coinbase",
+    ])
+    def test_consensus_rejects_are_charged(self, tmp_path, reason):
+        cm = make_connman(tmp_path)
+        peer = make_peer(cm)
+        self._accept_with_reject(cm, peer, reason)
+        assert peer.ban_score == cm_mod.CHARGE_INVALID_TX
+        assert peer.charges == {"invalid-tx": cm_mod.CHARGE_INVALID_TX}
+
+    def test_time_too_new_header_neither_charges_nor_disconnects(
+            self, tmp_path):
+        """A headers announcement our skewed clock rejects as
+        time-too-new is dropped without charge and without ending the
+        connection — the block path has the same exemption."""
+        import threading
+
+        from bitcoincashplus_tpu.consensus.block import CBlockHeader
+        from bitcoincashplus_tpu.consensus.serialize import ser_compact_size
+        from bitcoincashplus_tpu.validation.chainstate import (
+            BlockValidationError,
+        )
+
+        cm = make_connman(tmp_path)
+        peer = make_peer(cm)
+        cm.node.cs_main = threading.RLock()
+
+        class _CS:
+            block_index = {}
+
+            @staticmethod
+            def accept_block_header(header):
+                raise BlockValidationError(
+                    "time-too-new", "block timestamp too far in the future")
+
+        cm.node.chainstate = _CS()
+        hdr = CBlockHeader(version=0x20000000, hash_prev_block=b"\x11" * 32,
+                           hash_merkle_root=b"\x22" * 32, time=2**31,
+                           bits=0x207FFFFF, nonce=0)
+        payload = ser_compact_size(1) + hdr.serialize() + b"\x00"
+        cm._msg_headers(peer, payload)  # must not raise NetMessageError
+        assert peer.ban_score == 0
+        assert not peer.writer.closed
+        assert "getdata" not in peer.writer.commands()
+
+    def test_time_too_new_cmpctblock_neither_charges_nor_disconnects(
+            self, tmp_path):
+        """Compact blocks are the default tip-relay mode — the
+        clock-subjective exemption must cover that path too."""
+        import threading
+
+        from bitcoincashplus_tpu.consensus.block import CBlockHeader
+        from bitcoincashplus_tpu.p2p.compact import HeaderAndShortIDs
+        from bitcoincashplus_tpu.validation.chainstate import (
+            BlockValidationError,
+        )
+
+        cm = make_connman(tmp_path)
+        peer = make_peer(cm)
+        cm.node.cs_main = threading.RLock()
+
+        class _CS:
+            block_index = {}
+
+            @staticmethod
+            def accept_block_header(header):
+                raise BlockValidationError(
+                    "time-too-new", "block timestamp too far in the future")
+
+        cm.node.chainstate = _CS()
+        hdr = CBlockHeader(version=0x20000000, hash_prev_block=b"\x11" * 32,
+                           hash_merkle_root=b"\x22" * 32, time=2**31,
+                           bits=0x207FFFFF, nonce=0)
+        payload = HeaderAndShortIDs(hdr, nonce=7, shortids=[],
+                                    prefilled=[]).serialize()
+        cm._msg_cmpctblock(peer, payload)  # must not raise
+        assert peer.ban_score == 0
+        assert not peer.writer.closed
+
+    def test_poisoned_delivery_reparks_a_still_wanted_block(self, tmp_path):
+        """A garbage 'block' whose header hash matches a wanted download
+        must not untrack it permanently: the deliverer is discharged and
+        the hash is parked for re-request from a healthy peer. A hash
+        whose index is marked FAILED stays dead."""
+        import threading
+
+        from bitcoincashplus_tpu.validation.chain import BlockStatus
+        from bitcoincashplus_tpu.validation.chainstate import (
+            BlockValidationError,
+        )
+
+        cm = make_connman(tmp_path)
+        evil = make_peer(cm)
+        cm.node.cs_main = threading.RLock()
+        h = b"\x55" * 32
+
+        class _Idx:
+            status = 0  # header accepted, no data, not failed
+
+        class _CS:
+            block_index = {h: _Idx()}
+
+            @staticmethod
+            def process_new_block(block):
+                raise BlockValidationError(
+                    "bad-txnmrklroot", "hashMerkleRoot mismatch")
+
+        cm.node.chainstate = _CS()
+
+        class _Blk:
+            vtx = ()
+
+            @staticmethod
+            def get_hash():
+                return h
+
+        cm._process_block_obj(evil, _Blk())
+        assert evil.discharged  # invalid-block = immediate discharge
+        assert h in cm._unrequested  # ...but the download survives
+        # a FAILED index is not re-parked (genuinely invalid block)
+        cm._unrequested.clear()
+        _Idx.status = BlockStatus.FAILED_VALID
+        evil2 = make_peer(cm)
+        cm._process_block_obj(evil2, _Blk())
+        assert h not in cm._unrequested
+
+
+class TestNetSnapshot:
+    def test_snapshot_shape(self, tmp_path):
+        cm = make_connman(tmp_path)
+        snap = cm.net_snapshot()
+        assert snap["ban_threshold"] == 100
+        assert snap["orphans"] == {"count": 0, "bytes": 0}
+        assert snap["discharge_reasons"] == {}
+        assert snap["requested_blocks"] == 0
+        for key in ("misbehavior_charges", "discharged_peers",
+                    "stall_rerequests", "evicted_stallers", "flood_charges",
+                    "orphans_evicted", "banned"):
+            assert snap[key] == 0

@@ -228,3 +228,60 @@ def test_connman_uses_shared_backoff(tmp_path):
     assert first <= 5.0 and max(later) > 5.0  # it actually backs off
     cm._dial_backoff.reset()
     assert cm._dial_backoff.next() <= 5.0
+
+
+class TestNetFaultSite:
+    """The 'net' injection site (p2p message dispatch) is explicit opt-in:
+    BCP_FAULT_OPS=all still means the accelerator subsystems only, so the
+    dead-backend drills never silently start dropping P2P traffic."""
+
+    def test_all_does_not_arm_net(self, fault_harness):
+        inj = fault_harness("fail-always", ops="all")
+        assert not inj.armed_for(faults.NET_SITE)
+        for site in faults.SITES:
+            assert inj.armed_for(site)
+
+    def test_explicit_net_arms_and_fires(self, fault_harness):
+        inj = fault_harness("fail-always", ops="net")
+        assert inj.armed_for(faults.NET_SITE)
+        with pytest.raises(InjectedFault):
+            inj.on_call(faults.NET_SITE)
+        assert inj.injected[faults.NET_SITE] == 1
+        # the accelerator sites stay dark
+        assert not inj.armed_for("ecdsa")
+
+    def test_latency_helper_for_event_loop_callers(self, fault_harness):
+        """latency() hands the sleep to async callers instead of blocking
+        inside on_call; it is zero for any other mode/site."""
+        inj = fault_harness("latency-spike", ops="net", latency_ms=80)
+        assert inj.latency(faults.NET_SITE) == pytest.approx(0.08)
+        assert inj.latency("ecdsa") == 0.0
+        inj = fault_harness("fail-always", ops="net")
+        assert inj.latency(faults.NET_SITE) == 0.0
+
+
+class TestChaosSchedule:
+    def test_deterministic_from_seed(self):
+        a = faults.ChaosSchedule(seed=1234)
+        b = faults.ChaosSchedule(seed=1234)
+        assert [a.next_action() for _ in range(32)] == \
+               [b.next_action() for _ in range(32)]
+        assert a.randbytes(64) == b.randbytes(64)
+        assert a.randhash() == b.randhash()
+        assert [a.pause() for _ in range(8)] == [b.pause() for _ in range(8)]
+        assert a.burst_size() == b.burst_size()
+        assert a.history == b.history
+
+    def test_different_seeds_diverge(self):
+        a = faults.ChaosSchedule(seed=1)
+        b = faults.ChaosSchedule(seed=2)
+        assert [a.next_action() for _ in range(64)] != \
+               [b.next_action() for _ in range(64)]
+
+    def test_draw_bounds(self):
+        s = faults.ChaosSchedule(seed=7, min_pause=0.1, max_pause=0.2)
+        for _ in range(64):
+            assert 0.1 <= s.pause() <= 0.2
+            assert 4 <= s.burst_size(4, 32) <= 32
+            assert s.next_action() in faults.CHAOS_ACTIONS
+        assert len(s.randhash()) == 32
