@@ -155,7 +155,17 @@ class Node:
         # exactly the pre- or post-block state, never a torn mix
         self.coins_db = CoinsDB(self._coins_kv, journal_path=journal_path)
 
-        self.sigcache = SignatureCache()
+        # -maxsigcachesize=<MiB>: byte budget for the signature cache
+        # (src/init.cpp DEFAULT_MAX_SIG_CACHE_SIZE). The entry cap is
+        # derived FROM the byte budget so the knob governs alone — a fixed
+        # entry default would silently bind first above ~17 MiB
+        from ..validation.sigcache import ENTRY_COST_BYTES
+
+        sc_bytes = max(1, config.get_int("maxsigcachesize", 32)) * 1024 * 1024
+        self.sigcache = SignatureCache(
+            max_entries=max(1024, sc_bytes // ENTRY_COST_BYTES),
+            max_bytes=sc_bytes,
+        )
         self.versionbits_cache = VersionBitsCache()
         backend = config.tpu_backend
         self.backend = backend
@@ -165,6 +175,12 @@ class Node:
             self.params, self.coins_db, self.block_store,
             script_verifier=verifier, index_db=self.index_db,
         )
+        # -pipelinedepth=<n>: settle-horizon depth for the Python IBD
+        # engine — up to n blocks speculatively connected while their
+        # signature batches are in flight (1 = serial; see README
+        # "Pipelined validation & the settle horizon")
+        self.pipeline_depth = max(1, config.get_int("pipelinedepth", 4))
+        self.chainstate.pipeline_depth = self.pipeline_depth
         loaded = self.chainstate.load_block_index()
         if loaded:
             log_printf("block index loaded: tip height %d",
@@ -345,9 +361,12 @@ class Node:
                 self.auto_prune()
         # -blocknotify=<cmd>: run the shell hook with %s = new block hash
         # (init.cpp BlockNotifyCallback); fire-and-forget, never blocks
-        # validation, only on the active tip like the reference
+        # validation, only on the active tip like the reference. Settled
+        # tip, not chain.tip(): during a pipelined import this callback
+        # fires at settle time while newer SPECULATIVE blocks sit ahead on
+        # the in-memory chain — idx IS the externalizable tip then.
         cmd = self.config.get("blocknotify")
-        if cmd and self.chainstate.tip() is idx:
+        if cmd and self.chainstate.settled_tip() is idx:
             import subprocess
 
             from ..consensus.serialize import hash_to_hex as _h2h
@@ -378,8 +397,8 @@ class Node:
         the block, hashtx/rawtx per transaction."""
         if not self.zmq_publishers:  # torn down mid-shutdown
             return
-        if self.chainstate.tip() is not idx:
-            return  # only active-tip connects notify, like the reference
+        if self.chainstate.settled_tip() is not idx:
+            return  # only settled-tip connects notify (see -blocknotify)
         self._zmq_publish("hashblock", idx.hash[::-1])  # RPC byte order
         self._zmq_publish("rawblock", block.serialize())
         for tx in block.vtx:
@@ -570,6 +589,7 @@ class Node:
             self.params, self.coins_db, self.block_store,
             script_verifier=verifier, index_db=self.index_db,
         )
+        self.chainstate.pipeline_depth = self.pipeline_depth
         self.chainstate.load_block_index()
 
     def _import_block_files_native(self) -> int:
@@ -978,17 +998,25 @@ class Node:
         return n_imported
 
     def _import_block_files_python(self, paths: Optional[list[str]] = None) -> int:
-        """The Python-engine import loop (reference implementation)."""
+        """The Python-engine import loop (reference implementation) — and
+        the pipelined IBD driver: with -pipelinedepth > 1 each linear
+        extension goes through ChainstateManager.process_new_block_pipelined,
+        which overlaps the host script scan, the device signature settle,
+        and the chainstate commit across up to ``pipelinedepth`` in-flight
+        blocks (backpressure settles the oldest). The horizon is drained
+        before the final flush, so the on-disk state a crash could observe
+        is always a settled prefix of the import."""
         import struct
 
         magic = self.params.netmagic
         n_imported = 0
         pending: dict[bytes, list[CBlock]] = {}  # prev_hash -> blocks
+        cs = self.chainstate
 
         def try_process(block: CBlock) -> bool:
             nonlocal n_imported
             try:
-                self.chainstate.process_new_block(block)
+                cs.process_new_block_pipelined(block)
             except BlockValidationError as e:
                 if e.reason == "prev-blk-not-found":
                     pending.setdefault(block.header.hash_prev_block, []).append(block)
@@ -1003,7 +1031,7 @@ class Node:
                 h = queue.pop()
                 for child in pending.pop(h, ()):
                     try:
-                        self.chainstate.process_new_block(child)
+                        cs.process_new_block_pipelined(child)
                     except BlockValidationError:
                         continue
                     n_imported += 1
@@ -1053,6 +1081,9 @@ class Node:
                                          (n_file, start, size))
                 try_process(block)
                 pos = start + size
+        # drain the settle horizon (flush() would too, but be explicit:
+        # import ends with every block settled or unwound) then persist
+        self.chainstate.settle_horizon()
         self.chainstate.flush()
         return n_imported
 

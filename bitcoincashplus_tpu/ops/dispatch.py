@@ -135,6 +135,15 @@ class CircuitBreaker:
             self.fallback_calls += 1
             self.fallback_items += max(0, int(items))
 
+    def healthy(self) -> bool:
+        """Read-only probe: is the device path currently trusted? Unlike
+        allow() this never mutates state (no half-open transition), so
+        planners — e.g. the ecdsa cross-block lane packer deciding whether
+        aggregating for full device buckets is worth the latency — can
+        consult it per item without stealing recovery probes."""
+        with self._lock:
+            return self.state == CLOSED
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -234,6 +243,92 @@ def supervised_call(site: str, device_fn: Callable, cpu_fn: Callable,
                       site, e)
     br.note_fallback(items)
     return cpu_fn(), False
+
+
+class SupervisedHandle:
+    """An enqueued device computation under breaker supervision — the async
+    counterpart of supervised_call, for any site that wants to overlap
+    host work with device settle (SURVEY.md §3.2 P3). The ECDSA pipeline
+    itself rides its specialized equivalent (ecdsa_batch.BatchHandle,
+    which adds KAT lanes and reject-side host confirmation); this is the
+    GENERIC form for the other subsystems' future async crossings.
+
+    The enqueue runs eagerly (breaker-gated, fault-injected); validation
+    probes, breaker accounting, and the CPU fallback all run at result()
+    time, so an unresolved handle can ride in a pipeline for many host
+    steps without losing supervision. result() is memoized and safe to
+    call from multiple consumers (the first settle pays; the rest read)."""
+
+    __slots__ = ("_site", "_pending", "_cpu_fn", "_validate", "_poison",
+                 "_items", "_result", "_done", "used_device")
+
+    def __init__(self, site, pending, cpu_fn, validate, poison, items,
+                 used_device):
+        self._site = site
+        self._pending = pending      # zero-arg materializer, or None
+        self._cpu_fn = cpu_fn
+        self._validate = validate
+        self._poison = poison
+        self._items = items
+        self._result = None
+        self._done = pending is None
+        self.used_device = used_device
+        if self._done:
+            self._result = cpu_fn()  # CPU path is synchronous anyway
+
+    def result(self):
+        if self._done:
+            return self._result
+        br = breaker(self._site)
+        try:
+            out = self._pending()
+            if self._poison is not None and INJECTOR.should_poison(self._site):
+                out = self._poison(out)
+            if self._validate is not None and not self._validate(out):
+                raise PoisonedOutput(
+                    f"{self._site}: device output failed validation probe")
+            br.record_success()
+            self._result = out
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # noqa: BLE001 — breaker boundary
+            br.record_failure(e)
+            br.note_fallback(self._items)
+            log_print("tpu", "%s async settle failed (%s) — CPU fallback",
+                      self._site, e)
+            self._result = self._cpu_fn()
+            self.used_device = False
+        self._pending = None
+        self._done = True
+        return self._result
+
+
+def supervised_enqueue(site: str, enqueue_fn: Callable, cpu_fn: Callable,
+                       validate: Optional[Callable] = None,
+                       poison: Optional[Callable] = None,
+                       items: int = 1) -> SupervisedHandle:
+    """Async supervised dispatch: enqueue_fn() must START the device work
+    and return a zero-arg materializer that blocks until it settles (JAX
+    async dispatch returns array futures, so `lambda: np.asarray(dev_out)`
+    is the usual shape). A breaker-open site, or an enqueue_fn that raises,
+    degrades to a handle whose result() is cpu_fn() — the caller's pipeline
+    shape is preserved either way."""
+    br = breaker(site)
+    if br.allow():
+        try:
+            INJECTOR.on_call(site)
+            pending = enqueue_fn()
+            return SupervisedHandle(site, pending, cpu_fn, validate, poison,
+                                    items, used_device=True)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # noqa: BLE001 — breaker boundary
+            br.record_failure(e)
+            log_print("tpu", "%s async enqueue failed (%s) — CPU fallback",
+                      site, e)
+    br.note_fallback(items)
+    return SupervisedHandle(site, None, cpu_fn, validate, poison, items,
+                            used_device=False)
 
 
 # ---------------------------------------------------------------------------

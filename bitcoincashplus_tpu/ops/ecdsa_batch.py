@@ -590,6 +590,225 @@ def verify_batch(records: Sequence, backend: str = "auto") -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Cross-block lane packer — the pipelined IBD engine's aggregation layer.
+#
+# A single mainnet-shaped block rarely fills a padded bucket, so per-block
+# dispatch pays padding (and, on a tunneled chip, a whole round trip) for
+# partially-filled lanes. The packer aggregates deferred records from
+# MULTIPLE in-flight blocks (the ChainstateManager settle horizon) and
+# dispatches only full buckets; each contributing block gets its own
+# SigBatchFuture whose lanes map back into the shared BatchHandles, so
+# failure attribution and settle order stay per-block. Supervision is
+# unchanged: every underlying dispatch is the breaker/KAT-gated
+# dispatch_batch, and BatchHandle.result() is memoized, so many futures
+# can share one handle safely.
+# ---------------------------------------------------------------------------
+
+
+class SigBatchFuture:
+    """One block's slice of the cross-block packed dispatches. result()
+    returns a bool verdict per record in submission order; it forces a
+    packer flush if any of this block's records are still undispatched
+    (settling the horizon's oldest block must never deadlock on lanes
+    parked behind it)."""
+
+    __slots__ = ("_packer", "_segments", "_queued", "_result")
+
+    def __init__(self, packer):
+        self._packer = packer
+        self._segments = []  # (handle-wrapper, start, end), dispatch order
+        self._queued = 0     # records still in the packer's pending buffer
+        self._result = None
+
+    def result(self) -> np.ndarray:
+        if self._result is None:
+            if self._queued:
+                self._packer.flush_for(self)
+            parts = [self._packer._settle(h)[s:e]
+                     for h, s, e in self._segments]
+            self._result = (np.concatenate(parts) if parts
+                            else np.zeros(0, bool))
+            self._segments = []
+        return self._result
+
+    def drain(self) -> None:
+        """Abort-path settle: records still parked in the packer's pending
+        buffer are DISCARDED (verifying doomed lanes — up to a whole
+        horizon's worth on an unwind — would be pure waste), while
+        already-dispatched segments are materialized so STATS.in_flight
+        and a breaker probe riding one of them never strand. Verdicts are
+        ignored."""
+        try:
+            if self._queued:
+                self._packer.discard(self)
+            for pd, _s, _e in self._segments:
+                try:
+                    self._packer._settle(pd)
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception:  # noqa: BLE001 — abort-path drain
+                    pass
+        finally:
+            self._segments = []
+            if self._result is None:
+                self._result = np.zeros(0, bool)
+
+
+class _PackedDispatch:
+    """A shared BatchHandle plus the overlap-metering timestamps."""
+
+    __slots__ = ("handle", "t_enqueue", "settled")
+
+    def __init__(self, handle, t_enqueue):
+        self.handle = handle
+        self.t_enqueue = t_enqueue
+        self.settled = False
+
+
+class LanePacker:
+    """Aggregate SigCheckRecords across blocks into full padded buckets.
+
+    ``lanes`` is the dispatch size; the default (2046) fills the 2048
+    bucket exactly once the supervised dispatch appends its 2 known-answer
+    lanes. When the ecdsa breaker is not healthy the packer stops
+    aggregating (target 0): every add flushes immediately, because with
+    the device path open all lanes go to the CPU engine and aggregation
+    would only add settle latency."""
+
+    def __init__(self, backend: str = "auto", lanes: int = 2046):
+        self.backend = backend
+        self.lanes = lanes
+        self._pending: list = []           # records awaiting dispatch
+        self._pending_futs: list = []      # (future, count) per add(), order
+        self.stats = {
+            "dispatches": 0, "lanes_real": 0, "lanes_padded": 0,
+            "lanes_discarded": 0, "blocks": 0,
+            "inflight_s": 0.0, "blocked_s": 0.0,
+        }
+
+    def _target_lanes(self) -> int:
+        if self.backend == "cpu":
+            return self.lanes  # no padding concept, but batching still wins
+        if not dispatch.breaker("ecdsa").healthy():
+            return 0  # device path distrusted: no point holding lanes back
+        return self.lanes
+
+    def add(self, records: Sequence) -> SigBatchFuture:
+        """Enqueue one block's fresh (sigcache-missed) records; returns the
+        block's future. Dispatches fire whenever a full bucket is banked."""
+        fut = SigBatchFuture(self)
+        fut._queued = len(records)
+        if records:
+            self._pending.extend(records)
+            self._pending_futs.append((fut, len(records)))
+        target = self._target_lanes()
+        if target <= 0:
+            self.flush()  # device distrusted: don't hold lanes back
+        else:
+            while len(self._pending) >= target:
+                self._dispatch(target)
+        return fut
+
+    def flush(self) -> None:
+        """Dispatch everything still pending (sub-bucket tail included)."""
+        while self._pending:
+            self._dispatch(min(len(self._pending), max(self.lanes, 1)))
+
+    def discard(self, fut: SigBatchFuture) -> None:
+        """Drop ``fut``'s still-undispatched records from the pending
+        buffer (abort path — see SigBatchFuture.drain)."""
+        if fut._queued <= 0:
+            return
+        off = 0
+        for i, (f, count) in enumerate(self._pending_futs):
+            if f is fut:
+                del self._pending[off:off + count]
+                self._pending_futs.pop(i)
+                self.stats["lanes_discarded"] += count
+                fut._queued = 0
+                return
+            off += count
+
+    def flush_for(self, fut: SigBatchFuture) -> None:
+        """Dispatch only the pending PREFIX up to (and including) ``fut``'s
+        records — settling the horizon's oldest block must not also ship
+        younger blocks' sub-bucket tails, which can keep aggregating
+        toward full buckets (lanes queue FIFO, so the prefix is exactly
+        what fut needs)."""
+        while fut._queued > 0 and self._pending:
+            self._dispatch(min(len(self._pending), max(self.lanes, 1)))
+
+    def _dispatch(self, n: int) -> None:
+        batch = self._pending[:n]
+        del self._pending[:n]
+        try:
+            handle = dispatch_batch(batch, backend=self.backend)
+        except (KeyboardInterrupt, SystemExit,
+                NameError, AttributeError, UnboundLocalError):
+            raise  # programming errors must surface, not degrade
+        except Exception:
+            # same last-line-of-defense contract as the per-block verifier:
+            # a supervision-layer crash must not drop the batch
+            STATS.fault_fallback_sigs += len(batch)
+            handle = dispatch_batch(batch, backend="cpu")
+        pd = _PackedDispatch(handle, time.monotonic())
+        st = self.stats
+        st["dispatches"] += 1
+        st["lanes_real"] += len(batch)
+        # padding booked from the handle's ACTUAL bucket (0 = the dispatch
+        # took the CPU lane, which has no padding concept); the 2 KAT lanes
+        # ride every device batch and are excluded from the fill metric
+        bucket = getattr(handle, "_bucket", 0)
+        if bucket:
+            st["lanes_padded"] += max(0, bucket - len(batch) - 2)
+        # carve the dispatched records back into per-block segments
+        pos = 0
+        consumed = []
+        for i, (fut, count) in enumerate(self._pending_futs):
+            take = min(count, n - pos)
+            if take <= 0:
+                break
+            fut._segments.append((pd, pos, pos + take))
+            fut._queued -= take
+            pos += take
+            if take == count:
+                consumed.append(i)
+                st["blocks"] += 1
+            else:
+                self._pending_futs[i] = (fut, count - take)
+        for i in reversed(consumed):
+            self._pending_futs.pop(i)
+
+    def _settle(self, pd: _PackedDispatch) -> np.ndarray:
+        """Settle a shared dispatch (first consumer pays the blocking wait
+        and the overlap metering; BatchHandle memoizes for the rest)."""
+        if pd.settled:
+            return pd.handle.result()
+        t0 = time.monotonic()
+        out = pd.handle.result()
+        now = time.monotonic()
+        pd.settled = True
+        self.stats["blocked_s"] += now - t0
+        self.stats["inflight_s"] += now - pd.t_enqueue
+        return out
+
+    def snapshot(self) -> dict:
+        st = dict(self.stats)
+        total = st["lanes_real"] + st["lanes_padded"]
+        st["lane_fill_pct"] = round(100.0 * st["lanes_real"] / total, 2) \
+            if total else 100.0
+        # fraction of dispatched-batch lifetime the host spent NOT blocked
+        # on settle — >0 means the pipeline actually hid device latency
+        # (on a synchronous CPU backend the verify cost lands at enqueue,
+        # inside the scan leg, and this reads as fully hidden)
+        st["overlap_fraction"] = round(
+            1.0 - st["blocked_s"] / st["inflight_s"], 4) \
+            if st["inflight_s"] > 0 else 0.0
+        st["pending_lanes"] = len(self._pending)
+        return st
+
+
+# ---------------------------------------------------------------------------
 # Blob-level dispatch — the native connect engine's sigscan
 # (native/connect.cpp) emits (pub64, r||s, msg, rn, wrap) byte blobs; this
 # entry feeds them straight into the w4-bytes device program (or the native

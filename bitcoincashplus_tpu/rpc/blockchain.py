@@ -126,19 +126,24 @@ def _softforks(node, tip):
 
 @rpc_method("getbestblockhash")
 def getbestblockhash(node, params):
-    return hash_to_hex(node.chainstate.tip().hash)
+    # settled_tip, not chain.tip(): a block inside the pipelined-IBD settle
+    # horizon (signature batch still in flight) is never externalized
+    return hash_to_hex(node.chainstate.settled_tip().hash)
 
 
 @rpc_method("getblockcount")
 def getblockcount(node, params):
-    return node.chainstate.tip().height
+    # settled height: must agree with getbestblockhash under an open
+    # settle horizon (a speculative block may still unwind)
+    return node.chainstate.settled_tip().height
 
 
 @rpc_method("getblockhash")
 def getblockhash(node, params):
     require_params(params, 1, 1, "getblockhash height")
-    idx = node.chainstate.chain[int(params[0])]
-    if idx is None:
+    height = int(params[0])
+    idx = node.chainstate.chain[height]
+    if idx is None or height > node.chainstate.settled_tip().height:
         raise RPCError(RPC_INVALID_PARAMETER, "Block height out of range")
     return hash_to_hex(idx.hash)
 

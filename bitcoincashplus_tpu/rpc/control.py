@@ -96,11 +96,14 @@ def gettpuinfo(node, params):
     supervised-dispatch circuit-breaker state per subsystem (ops/dispatch:
     state, trip counts, fallback call/item tallies — fallback_items is sigs
     for ecdsa, hashes for sha256, leaves for merkle), the active
-    fault-injection config (BCP_FAULT_*), sigcache hit rates, ConnectBlock
-    phase timings (-debug=bench counters), the active backend/device, and —
-    when P2P is running — the peer-supervision ledger (``net``: misbehavior
-    charges, discharge reasons, stall re-requests, flood charges, orphan
-    pool accounting, banlist size)."""
+    fault-injection config (BCP_FAULT_*), sigcache hit/insert/eviction
+    rates, ConnectBlock phase timings (-debug=bench counters), the
+    pipelined-IBD settle horizon (``pipeline``: depth/occupancy, per-leg
+    times, unwind count, cross-block lane fill and overlap fraction), the
+    BIP30 pre-scan fast-path counters (``bip30``), the active
+    backend/device, and — when P2P is running — the peer-supervision
+    ledger (``net``: misbehavior charges, discharge reasons, stall
+    re-requests, flood charges, orphan pool accounting, banlist size)."""
     from ..ops import dispatch, ecdsa_batch
     from ..util import faults
 
@@ -118,12 +121,13 @@ def gettpuinfo(node, params):
         "batch": stats,
         "breakers": dispatch.snapshot(),
         "faults": faults.INJECTOR.snapshot(),
-        "sigcache": {
-            "entries": len(node.sigcache._set),
-            "hits": node.sigcache.hits,
-            "misses": node.sigcache.misses,
-        },
+        "sigcache": node.sigcache.snapshot(),
         "connectblock": dict(node.chainstate.bench),
+        # getattr-guarded: harness stubs pass a bare chainstate namespace
+        "pipeline": (node.chainstate.pipeline_snapshot()
+                     if hasattr(node.chainstate, "pipeline_snapshot")
+                     else {}),
+        "bip30": dict(getattr(node.chainstate, "bip30_stats", {})),
         "net": (node.connman.net_snapshot()
                 if getattr(node, "connman", None) is not None else {}),
     }

@@ -163,6 +163,11 @@ class CoinsDB(CoinsView):
         raw = self.kv.get(_coin_key(outpoint))
         return Coin.deserialize(raw) if raw is not None else None
 
+    def have_coin(self, outpoint: COutPoint) -> bool:
+        """Existence probe without value fetch/deserialize — the BIP30
+        pre-scan's per-output fast path (CoinsCache.have_coin)."""
+        return self.kv.exists(_coin_key(outpoint))
+
     def best_block(self) -> bytes:
         return self.kv.get(_BEST) or _NULL_HASH
 

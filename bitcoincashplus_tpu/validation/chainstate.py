@@ -100,6 +100,31 @@ class ChainstateManager:
             "check_ms": 0.0, "connect_ms": 0.0, "verify_ms": 0.0,
             "flush_ms": 0.0, "index_ms": 0.0, "blocks": 0,
         }
+        # Pipelined IBD (the settle horizon): blocks are speculatively
+        # connected — each into its own CoinsCache layer over the settled
+        # cache — while their signature batches are still in flight on the
+        # device; externalization (coins merge, undo write, index row,
+        # tip/connect listeners) happens at settle time, oldest first, and
+        # a settle failure drops every speculative layer (full unwind to
+        # the pre-block coin set). depth <= 1 = serial engine. The node
+        # runtime wires -pipelinedepth here; the Python IBD import loop is
+        # the driver (node.py).
+        self.pipeline_depth = 1
+        self._horizon: list[dict] = []
+        self._packer = None  # ops/ecdsa_batch.LanePacker, built lazily
+        self._settling = False  # reentrancy guard (flush <-> settle hooks)
+        self.pipeline_stats = {
+            "settled_blocks": 0, "unwinds": 0, "unwound_blocks": 0,
+            "max_depth": 0, "scan_ms": 0.0, "settle_wait_ms": 0.0,
+            "commit_ms": 0.0,
+        }
+        # BIP30 pre-scan accounting: probes resolved from cache layers vs
+        # the store, and whole scans skipped above the last checkpoint
+        # (Core's BIP34-era exemption)
+        self.bip30_stats = {
+            "lookups": 0, "cache_resolved": 0,
+            "skipped_scans": 0, "skipped_lookups": 0,
+        }
         self._init_genesis()
 
     # ------------------------------------------------------------------
@@ -385,16 +410,39 @@ class ChainstateManager:
         return undo
 
     def _connect_block_inner(self, block: CBlock, idx: CBlockIndex,
-                             check_scripts: bool) -> BlockUndo:
+                             check_scripts: bool,
+                             sig_jobs: Optional[list] = None) -> BlockUndo:
         height = idx.height
         consensus = self.params.consensus
 
-        # BIP30: no overwriting of existing unspent coins
-        for tx in block.vtx:
-            txid = tx.txid
-            for i in range(len(tx.vout)):
-                if self.coins.get_coin(COutPoint(txid, i)) is not None:
-                    raise BlockValidationError("bad-txns-BIP30", "tried to overwrite transaction")
+        # BIP30: no overwriting of existing unspent coins. Core's BIP34-era
+        # exemption: above the last active-chain checkpoint (with BIP34
+        # active) duplicate txids are impossible — coinbases commit to
+        # their height — so the per-output scan is skipped outright. When
+        # the scan does run, each probe resolves from cache layers when an
+        # entry (live or tombstone) is resident, and otherwise pays only a
+        # store EXISTENCE query — never a Coin fetch/deserialize, and never
+        # a read-through entry polluting the -dbcache working set.
+        b30 = self.bip30_stats
+        last_cp = self._last_checkpoint_height()
+        if (last_cp > 0 and height > last_cp
+                and height >= consensus.bip34_height):
+            b30["skipped_scans"] += 1
+            b30["skipped_lookups"] += sum(len(tx.vout) for tx in block.vtx)
+        else:
+            for tx in block.vtx:
+                txid = tx.txid
+                for i in range(len(tx.vout)):
+                    op = COutPoint(txid, i)
+                    b30["lookups"] += 1
+                    exists = self.coins.have_coin_cached(op)
+                    if exists is None:
+                        exists = self.coins.have_coin(op)
+                    else:
+                        b30["cache_resolved"] += 1
+                    if exists:
+                        raise BlockValidationError(
+                            "bad-txns-BIP30", "tried to overwrite transaction")
 
         undo = BlockUndo([])
         fees = 0
@@ -441,8 +489,17 @@ class ChainstateManager:
         if check_scripts and self.script_verifier is not None:
             # Deferred batch verification — the CCheckQueue replacement:
             # one call, one TPU dispatch (SURVEY.md §4.2 graft point).
+            # With a sig_jobs sink (the pipelined engine) only the SCAN
+            # stage runs here — records ship into the cross-block lane
+            # packer and the settle stage happens at the horizon.
             tv = _time.perf_counter()
-            self.script_verifier(block, idx, spent_per_tx)
+            scan = getattr(self.script_verifier, "scan", None)
+            if sig_jobs is not None and scan is not None:
+                sig_jobs.append(
+                    scan(block, idx, spent_per_tx, packer=self._sig_packer())
+                )
+            else:
+                self.script_verifier(block, idx, spent_per_tx)
             self.bench["verify_ms"] += (_time.perf_counter() - tv) * 1e3
 
         self.coins.set_best_block(idx.hash)
@@ -493,6 +550,15 @@ class ChainstateManager:
         comparison is CBlockIndexWorkComparator's (work, then earlier
         sequence wins) so preciousblock's negative sequence ids can win an
         equal-work tie; a later-received equal-work block still loses."""
+        # settle-horizon barrier, enforced HERE and not just at the
+        # pipelined entry point: serial activation walks and edits
+        # self.coins directly, which is only the settled prefix while
+        # speculative layers are open — any caller reaching this with an
+        # open horizon (today none can; P2P/RPC start after the import
+        # drains it) must first settle or the reorg engine would read a
+        # coin set missing the speculative edits. No-op when empty or
+        # when called back from within a settle.
+        self.settle_horizon()
         while True:
             tip = self.chain.tip()
             target = self._find_most_work_chain()
@@ -649,6 +715,196 @@ class ChainstateManager:
         self.activate_best_chain()
         return True
 
+    # ------------------------------------------------------------------
+    # pipelined connect — the IBD settle horizon (overlaps the host scan,
+    # the device signature settle, and the chainstate commit)
+    # ------------------------------------------------------------------
+
+    def settled_tip(self) -> Optional[CBlockIndex]:
+        """The newest block whose signature batch has SETTLED — the only
+        tip the outside world may observe (RPC getbestblockhash, P2P
+        announcements, index flush). Equals chain.tip() whenever no
+        speculative horizon is open."""
+        if self._horizon:
+            return self._horizon[0]["idx"].prev
+        return self.chain.tip()
+
+    def _sig_packer(self):
+        """The session's cross-block lane packer (ops/ecdsa_batch): fresh
+        sigcheck records from every in-flight block aggregate into full
+        padded device buckets instead of per-block partial dispatches."""
+        if self._packer is None:
+            from ..ops.ecdsa_batch import LanePacker
+
+            self._packer = LanePacker(
+                backend=getattr(self.script_verifier, "backend", "auto"))
+        return self._packer
+
+    def process_new_block_pipelined(self, block: CBlock) -> bool:
+        """ProcessNewBlock for the IBD pipeline driver (node.py import
+        loop). A linear tip extension is speculatively connected — UTXO
+        edits into a fresh CoinsCache layer, undo retained, signature
+        batch left in flight — while up to pipeline_depth older blocks'
+        batches settle behind it (backpressure settles the oldest first).
+        Anything else (reorg candidate, invalid ancestry, depth<=1)
+        settles the whole horizon and takes the serial path. Same
+        raise/return contract as process_new_block."""
+        if self.pipeline_depth <= 1:
+            return self.process_new_block(block)
+        idx = self.accept_block(block)
+        # backpressure: bound the horizon BEFORE connecting another block
+        while len(self._horizon) >= self.pipeline_depth:
+            if not self._settle_oldest():
+                break  # unwound — idx's ancestry may now be invalid
+        if (idx.prev is self.chain.tip()
+                and not (idx.status & BlockStatus.FAILED_MASK)
+                and self._find_most_work_chain() is idx):
+            if self._connect_tip_speculative(idx, block):
+                return True
+            # scan-stage reject: fall through to the serial engine's
+            # next-best-candidate retry, exactly like a failed ConnectTip
+        self.settle_horizon()
+        self.activate_best_chain()
+        return True
+
+    def _connect_tip_speculative(self, idx: CBlockIndex,
+                                 block: CBlock) -> bool:
+        """ConnectTip minus externalization: edits land in a NEW CoinsCache
+        layer stacked on the previous speculative layer (or the settled
+        cache), the script verifier runs its SCAN stage only, and the
+        block's undo write, index row, validity raise, and listeners are
+        all withheld until settle. On a scan-stage failure the layer is
+        dropped and the block marked invalid — the serial _connect_tip
+        verdict, just earlier."""
+        t0 = _time.perf_counter()
+        check_scripts = self.script_checks_needed(idx)
+        base = self._horizon[-1]["layer"] if self._horizon else self.coins
+        layer = CoinsCache(base)
+        jobs: list = []
+        coins_save, self.coins = self.coins, layer
+        try:
+            undo = self._connect_block_inner(block, idx, check_scripts,
+                                             sig_jobs=jobs)
+        except BlockValidationError:
+            for j in jobs:
+                j.drain()
+            self._mark_invalid(idx)
+            return False
+        finally:
+            self.coins = coins_save
+        self.chain.set_tip(idx)
+        # prune like the serial engine does after every activation step —
+        # without this, every imported block stays a candidate and the
+        # per-block _find_most_work_chain scan turns a long linear IBD
+        # quadratic (the candidate set must stay ~empty in steady state)
+        self._prune_candidates()
+        self._horizon.append({
+            "idx": idx, "block": block, "undo": undo, "layer": layer,
+            "job": jobs[0] if jobs else None,
+            "scripts": bool(check_scripts and self.script_verifier),
+        })
+        ps = self.pipeline_stats
+        ps["max_depth"] = max(ps["max_depth"], len(self._horizon))
+        ps["scan_ms"] += (_time.perf_counter() - t0) * 1e3
+        return True
+
+    def _settle_oldest(self) -> bool:
+        """Settle the horizon's oldest block: wait for its signature batch,
+        then externalize (coins merged into the settled cache, undo + index
+        row written, VALID_SCRIPTS raised, connect/tip listeners fired).
+        Returns False when the batch failed — the whole horizon is unwound
+        and the failing block marked invalid."""
+        ent = self._horizon[0]
+        idx = ent["idx"]
+        settling_save, self._settling = self._settling, True
+        try:
+            t0 = _time.perf_counter()
+            if ent["job"] is not None:
+                try:
+                    ent["job"].settle()
+                except BlockValidationError as e:
+                    self._unwind_horizon(e)
+                    return False
+            t1 = _time.perf_counter()
+            self._horizon.pop(0)
+            ent["layer"].flush()  # into the settled cache (self.coins)
+            if self._horizon:
+                # re-base the next layer onto the settled cache — its old
+                # base is the (now empty) layer we just flushed
+                self._horizon[0]["layer"].base = self.coins
+            self.block_store.put_undo(idx.hash, ent["undo"].serialize())
+            idx.status |= BlockStatus.HAVE_UNDO
+            idx.raise_validity(
+                BlockStatus.VALID_SCRIPTS if ent["scripts"]
+                else BlockStatus.VALID_CHAIN
+            )
+            self._dirty_index.add(idx)
+            ps = self.pipeline_stats
+            ps["settled_blocks"] += 1
+            ps["settle_wait_ms"] += (t1 - t0) * 1e3
+            self.bench["blocks"] += 1
+            for cb in self.on_block_connected:
+                cb(ent["block"], idx)
+            for cb in self.on_tip_changed:
+                cb(idx)
+            ps["commit_ms"] += (_time.perf_counter() - t1) * 1e3
+            return True
+        finally:
+            self._settling = settling_save
+
+    def _unwind_horizon(self, err: BlockValidationError) -> None:
+        """A settle failure at the horizon's oldest block: drop EVERY
+        speculative layer (the later blocks are its descendants), drain
+        their in-flight batches, mark the failing block invalid, and roll
+        the in-memory tip back to the last settled block. The settled
+        cache was never touched by the dropped layers, so the UTXO set is
+        byte-identical to the pre-failing-block state by construction."""
+        entries, self._horizon = self._horizon, []
+        failed = entries[0]["idx"]
+        for ent in entries:
+            if ent["job"] is not None:
+                ent["job"].drain()
+        self.chain.set_tip(failed.prev)
+        self._mark_invalid(failed)
+        # the tip ROLLED BACK: candidates pruned while it was ahead may be
+        # viable again — re-seed from scratch, the invalidate_block recipe
+        for other in self.block_index.values():
+            self._try_add_candidate(other)
+        ps = self.pipeline_stats
+        ps["unwinds"] += 1
+        ps["unwound_blocks"] += len(entries)
+        log_print(
+            "bench",
+            "settle horizon unwound: %d speculative block(s) dropped, "
+            "%s invalid (%s)",
+            len(entries), hash_to_hex(failed.hash)[:16], err.reason,
+        )
+
+    def settle_horizon(self) -> None:
+        """Settle every speculative block, oldest first — the barrier
+        before any serial-path activation, reorg, external flush, or
+        shutdown. Reentrancy-safe: a connect listener that triggers
+        flush() mid-settle does not recurse. Like the serial engine, a
+        failing block is marked invalid without raising."""
+        if self._settling:
+            return
+        while self._horizon:
+            if not self._settle_oldest():
+                break
+
+    def pipeline_snapshot(self) -> dict:
+        """gettpuinfo's ``pipeline`` section: horizon depth/occupancy,
+        per-leg cumulative times, unwind accounting, and the cross-block
+        lane packer's fill/overlap metrics."""
+        ps = dict(self.pipeline_stats)
+        ps["depth"] = self.pipeline_depth
+        ps["in_horizon"] = len(self._horizon)
+        packer = self._packer.snapshot() if self._packer is not None else {}
+        ps["packer"] = packer
+        ps["lane_fill_pct"] = packer.get("lane_fill_pct")
+        ps["overlap_fraction"] = packer.get("overlap_fraction", 0.0)
+        return ps
+
     def precious_block(self, idx: CBlockIndex) -> None:
         """PreciousBlock (src/validation.cpp:~2900): treat the block as if
         it had been received before every competitor — a decreasing
@@ -693,6 +949,12 @@ class ChainstateManager:
         cache + best-block marker in one transaction. A crash between (2) and
         (3) leaves index entries ahead of the chainstate; on restart those
         blocks are re-activated from their on-disk data."""
+        # settle-horizon barrier: nothing speculative may reach disk. The
+        # coins cache only ever holds settled edits (speculative blocks
+        # live in their own layers), so this is about completeness — a
+        # flush called mid-settle (via a connect listener) skips the
+        # barrier and persists the settled prefix, which is always safe.
+        self.settle_horizon()
         t0 = _time.perf_counter()
         self.block_store.flush()
         self.flush_index()
@@ -702,8 +964,14 @@ class ChainstateManager:
     def flush_index(self) -> None:
         """Step (2) of the flush contract alone: batch-write dirty block
         index entries. The native fast-import path orders its own coins
-        batch after this (node.py _fast_flush)."""
+        batch after this (node.py _fast_flush). Rows for blocks still
+        inside the settle horizon are withheld — an index flush is a tip
+        externalization, and nothing past the horizon is externalized
+        until its signature batch settles (they re-dirty at settle)."""
         if self.index_db is not None and self._dirty_index:
+            hold = {ent["idx"] for ent in self._horizon}
+            flushable = [idx for idx in self._dirty_index
+                         if idx not in hold]
             positions = getattr(self.block_store, "positions", {})
             undo_positions = getattr(self.block_store, "undo_positions", {})
             entries = [
@@ -716,10 +984,11 @@ class ChainstateManager:
                     positions.get(idx.hash),
                     undo_positions.get(idx.hash),
                 )
-                for idx in self._dirty_index
+                for idx in flushable
             ]
-            self.index_db.put_index_batch(entries)
-            self._dirty_index.clear()
+            if entries:
+                self.index_db.put_index_batch(entries)
+            self._dirty_index.difference_update(flushable)
 
     # -- queries used by RPC / mining --
 

@@ -117,6 +117,27 @@ class CoinsCache(CoinsView):
     def have_coin_in_cache(self, outpoint) -> bool:
         return self.cache.get(outpoint) is not None
 
+    def have_coin(self, outpoint) -> bool:
+        """HaveCoin without materializing: a cache-resident entry (live or
+        tombstone) answers immediately; otherwise the base is asked for
+        EXISTENCE only — no Coin deserialization, no read-through entry
+        polluting this layer (the BIP30 scan probes every output of every
+        tx, and caching those misses-by-construction would bloat the
+        -dbcache working set for nothing)."""
+        if outpoint in self.cache:
+            return self.cache[outpoint] is not None
+        return self.base.have_coin(outpoint)
+
+    def have_coin_cached(self, outpoint) -> Optional[bool]:
+        """Resolve have_coin from in-memory cache layers ALONE: True/False
+        when some layer holds the entry (live or tombstone), None when the
+        bottom store would have to be consulted. The BIP30 fast path uses
+        this to count store probes actually saved."""
+        if outpoint in self.cache:
+            return self.cache[outpoint] is not None
+        probe = getattr(self.base, "have_coin_cached", None)
+        return probe(outpoint) if probe is not None else None
+
     def best_block(self) -> bytes:
         if self._best is None:
             self._best = self.base.best_block()

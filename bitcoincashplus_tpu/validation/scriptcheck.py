@@ -153,12 +153,96 @@ class _InlineCountingChecker(TransactionSignatureChecker):
         return super().check_sig(sig, pubkey, script_code, flags, defer_ok)
 
 
+class BlockSigJob:
+    """The settle-stage handle for one block's deferred signature checks
+    (the pipelined IBD engine's unit of in-flight work, ISSUE 4).
+
+    Produced by BlockScriptVerifier.scan(); carries the block's deferred
+    SigCheckRecords, their (tx, input) attribution, and the in-flight
+    dispatches (BatchHandles on the serial path, SigBatchFutures when a
+    cross-block LanePacker aggregated the lanes). settle() blocks until
+    every dispatch reports, raises BlockValidationError with (tx, input)
+    attribution on the first bad lane, and inserts the fresh sigcache
+    keys only on full success — identical verdict semantics to the old
+    synchronous __call__."""
+
+    __slots__ = ("verifier", "block", "records", "rec_attr", "pending",
+                 "settled")
+
+    def __init__(self, verifier, block):
+        self.verifier = verifier
+        self.block = block
+        self.records: list[SigCheckRecord] = []
+        self.rec_attr: list[tuple[int, int]] = []  # (tx_index, input_index)
+        # in-flight chunks: (record_indices, sigcache_keys, handle/future)
+        self.pending: list[tuple[list[int], list, object]] = []
+        self.settled = False
+
+    def settle(self) -> None:
+        """Block until every in-flight chunk reports; raise on failure."""
+        from .chainstate import BlockValidationError
+
+        if self.settled:
+            return
+        try:
+            while self.pending:
+                fresh, keys, handle = self.pending.pop(0)
+                try:
+                    ok = handle.result()
+                except (KeyboardInterrupt, SystemExit,
+                        NameError, AttributeError, UnboundLocalError):
+                    raise  # programming errors must surface, not degrade
+                except Exception:
+                    # settle-time failure the handle could not self-heal:
+                    # the verdict is a fresh forced-CPU verification of
+                    # this chunk's records — never a cached phantom
+                    ecdsa_batch.STATS.fault_fallback_sigs += len(fresh)
+                    ok = ecdsa_batch.dispatch_batch(
+                        [self.records[k] for k in fresh], backend="cpu"
+                    ).result()
+                for lane, k in enumerate(fresh):
+                    if not ok[lane]:
+                        t, i = self.rec_attr[k]
+                        tx = self.block.vtx[t]
+                        raise BlockValidationError(
+                            "blk-bad-inputs",
+                            "signature verification failed "
+                            f"tx {tx.txid_hex} input {i}",
+                        )
+                for key in keys:
+                    self.verifier.sigcache.add(key)
+        finally:
+            if self.pending:
+                self.drain()
+            self.settled = True
+
+    def drain(self) -> None:
+        """Abort-path settle: materialize every remaining handle so
+        STATS.in_flight (and a breaker half-open probe riding one of them)
+        never strands; verdicts are ignored."""
+        while self.pending:
+            _fresh, _keys, handle = self.pending.pop(0)
+            drain = getattr(handle, "drain", None)  # SigBatchFuture: also
+            try:                                    # discards parked lanes
+                drain() if drain is not None else handle.result()
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception:  # noqa: BLE001 — abort-path drain
+                pass
+        self.settled = True
+
+
 class BlockScriptVerifier:
     """The ChainstateManager ``script_verifier`` hook (chainstate.py).
 
     Call contract: (block, idx, spent_per_tx) — spent_per_tx[i] is the
     list of spent Coins for block.vtx[i+1]'s inputs, input order. Raises
     BlockValidationError (via chainstate's exception type) on any failure.
+
+    Pipelined callers split the call into scan() (host script
+    interpretation, sigcache probe, dispatch/enqueue) and
+    BlockSigJob.settle() (device settlement) so the settle horizon can
+    keep connecting blocks while earlier batches are in flight.
     """
 
     def __init__(self, params: ChainParams, backend: str = "auto",
@@ -179,6 +263,16 @@ class BlockScriptVerifier:
         self.chunk = chunk
 
     def __call__(self, block, idx, spent_per_tx) -> None:
+        self.scan(block, idx, spent_per_tx).settle()
+
+    def scan(self, block, idx, spent_per_tx, packer=None) -> BlockSigJob:
+        """The SCAN stage: host script interpretation over every input,
+        deferring OP_CHECKSIG into SigCheckRecords, probing the sigcache,
+        and shipping fresh records — to ecdsa_batch.dispatch_batch chunks
+        directly (serial path), or into the shared cross-block ``packer``
+        (pipelined path), which banks them for full-bucket dispatches and
+        hands back per-block futures. Raises BlockValidationError on any
+        script failure; signature verdicts arrive at job.settle()."""
         from .chainstate import BlockValidationError
 
         flags = block_script_flags(
@@ -186,10 +280,9 @@ class BlockScriptVerifier:
         )
         defer = bool(flags & SCRIPT_VERIFY_NULLFAIL)
 
-        records: list[SigCheckRecord] = []
-        rec_attr: list[tuple[int, int]] = []  # (tx_index, input_index)
-        # in-flight chunks: (record_indices, keys, BatchHandle)
-        pending: list[tuple[list[int], list, object]] = []
+        job = BlockSigJob(self, block)
+        records = job.records
+        rec_attr = job.rec_attr
         dispatched = 0
 
         def dispatch_from(start: int) -> int:
@@ -214,17 +307,21 @@ class BlockScriptVerifier:
             )
             if fresh:
                 batch = [records[k] for k in fresh]
-                try:
-                    handle = ecdsa_batch.dispatch_batch(
-                        batch, backend=self.backend
-                    )
-                except (KeyboardInterrupt, SystemExit,
-                        NameError, AttributeError, UnboundLocalError):
-                    raise  # programming errors must surface, not degrade
-                except Exception:
-                    ecdsa_batch.STATS.fault_fallback_sigs += len(batch)
-                    handle = ecdsa_batch.dispatch_batch(batch, backend="cpu")
-                pending.append(
+                if packer is not None:
+                    handle = packer.add(batch)
+                else:
+                    try:
+                        handle = ecdsa_batch.dispatch_batch(
+                            batch, backend=self.backend
+                        )
+                    except (KeyboardInterrupt, SystemExit,
+                            NameError, AttributeError, UnboundLocalError):
+                        raise  # programming errors surface, not degrade
+                    except Exception:
+                        ecdsa_batch.STATS.fault_fallback_sigs += len(batch)
+                        handle = ecdsa_batch.dispatch_batch(batch,
+                                                            backend="cpu")
+                job.pending.append(
                     (fresh, [keys[k - start] for k in fresh], handle)
                 )
             return len(records)
@@ -278,41 +375,11 @@ class BlockScriptVerifier:
                     dispatched = dispatch_from(dispatched)
 
             if dispatched < len(records):
-                dispatched = dispatch_from(dispatched)
-
-            # settle every in-flight chunk (in dispatch order)
-            while pending:
-                fresh, keys, handle = pending.pop(0)
-                try:
-                    ok = handle.result()
-                except (KeyboardInterrupt, SystemExit,
-                        NameError, AttributeError, UnboundLocalError):
-                    raise  # programming errors must surface, not degrade
-                except Exception:
-                    # settle-time failure the handle could not self-heal:
-                    # the verdict is a fresh forced-CPU verification of
-                    # this chunk's records — never a cached phantom
-                    ecdsa_batch.STATS.fault_fallback_sigs += len(fresh)
-                    ok = ecdsa_batch.dispatch_batch(
-                        [records[k] for k in fresh], backend="cpu"
-                    ).result()
-                for lane, k in enumerate(fresh):
-                    if not ok[lane]:
-                        t, i = rec_attr[k]
-                        tx = block.vtx[t]
-                        raise BlockValidationError(
-                            "blk-bad-inputs",
-                            "signature verification failed "
-                            f"tx {tx.txid_hex} input {i}",
-                        )
-                for key in keys:
-                    self.sigcache.add(key)
-        finally:
-            # a script failure or bad chunk aborts the block mid-flight:
-            # drain the remaining handles so STATS.in_flight doesn't leak
-            # phantom dispatches into gettpuinfo
-            for _fresh, _keys, handle in pending:
-                try:
-                    handle.result()
-                except Exception:
-                    pass
+                dispatch_from(dispatched)
+        except BaseException:
+            # a script failure aborts the block mid-scan: drain the handles
+            # already in flight so STATS.in_flight doesn't leak phantom
+            # dispatches into gettpuinfo
+            job.drain()
+            raise
+        return job

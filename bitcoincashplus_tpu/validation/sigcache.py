@@ -6,21 +6,35 @@ acceptance skip re-verification in ConnectBlock. Keyed identically;
 consulted BEFORE building the TPU batch (SURVEY.md §3.1 sigcache row),
 so steady-state block connects dispatch only never-seen signatures.
 
-Bounded FIFO eviction via an ordered dict (the reference uses randomized
-eviction / a cuckoo table; FIFO preserves the same contract — presence
-implies validity — without the tuning surface)."""
+Bounded LRU-ish eviction via an ordered dict: a probe hit refreshes the
+entry (move-to-end), eviction pops the stalest. The reference uses
+randomized eviction / a cuckoo table; the LRU discipline preserves the
+same contract — presence implies validity — while keeping the hot
+mempool->block working set resident under IBD churn. Capped both in
+entries and in estimated bytes (-maxsigcachesize), whichever binds
+first; hit/miss/insert/eviction counters feed gettpuinfo.sigcache.
+"""
 
 from __future__ import annotations
 
 from collections import OrderedDict
+from typing import Optional
+
+# Estimated resident cost per entry: the 129-byte key's bytes object
+# (~162 B via sys.getsizeof) plus the OrderedDict slot/link overhead.
+ENTRY_COST_BYTES = 280
 
 
 class SignatureCache:
-    def __init__(self, max_entries: int = 1 << 16):
+    def __init__(self, max_entries: int = 1 << 16,
+                 max_bytes: Optional[int] = None):
         self.max_entries = max_entries
+        self.max_bytes = max_bytes  # None = entry cap only
         self._set: OrderedDict[bytes, None] = OrderedDict()
         self.hits = 0
         self.misses = 0
+        self.inserts = 0
+        self.evictions = 0
 
     @staticmethod
     def entry_key(msg_hash: int, r: int, s: int, pubkey: tuple) -> bytes:
@@ -35,14 +49,43 @@ class SignatureCache:
     def contains(self, key: bytes) -> bool:
         if key in self._set:
             self.hits += 1
+            self._set.move_to_end(key)  # LRU refresh
             return True
         self.misses += 1
         return False
 
+    def _over_budget(self) -> bool:
+        if len(self._set) > self.max_entries:
+            return True
+        return (self.max_bytes is not None
+                and len(self._set) * ENTRY_COST_BYTES > self.max_bytes)
+
     def add(self, key: bytes) -> None:
+        if key not in self._set:
+            self.inserts += 1
         self._set[key] = None
-        while len(self._set) > self.max_entries:
-            self._set.popitem(last=False)
+        self._set.move_to_end(key)
+        while self._set and self._over_budget():
+            self._set.popitem(last=False)  # stalest first
+            self.evictions += 1
+
+    def estimated_bytes(self) -> int:
+        return len(self._set) * ENTRY_COST_BYTES
+
+    def snapshot(self) -> dict:
+        """gettpuinfo.sigcache section."""
+        probes = self.hits + self.misses
+        return {
+            "entries": len(self._set),
+            "bytes": self.estimated_bytes(),
+            "max_entries": self.max_entries,
+            "max_bytes": self.max_bytes,
+            "hits": self.hits,
+            "misses": self.misses,
+            "inserts": self.inserts,
+            "evictions": self.evictions,
+            "hit_rate": round(self.hits / probes, 4) if probes else 0.0,
+        }
 
     def __len__(self) -> int:
         return len(self._set)

@@ -49,12 +49,15 @@ def pytest_collection_modifyitems(config, items):
     the adversarial chaos campaigns after the rest of the functional
     suite — under a bounded CI budget the newest, heaviest campaigns are
     the first thing a timeout cuts, never the established coverage.
-    Stable sort: order within each group is unchanged."""
+    The ``pipeline`` suite (pipelined-IBD differentials/unwind, tier-1,
+    JAX_PLATFORMS=cpu) runs after the plain unit suite and before the
+    functional/adversarial groups. Stable sort: order within each group
+    is unchanged."""
 
     def group(item) -> int:
         if "functional" not in str(item.fspath):
-            return 0
-        return 2 if item.get_closest_marker("adversarial") else 1
+            return 1 if item.get_closest_marker("pipeline") else 0
+        return 3 if item.get_closest_marker("adversarial") else 2
 
     items.sort(key=group)
 

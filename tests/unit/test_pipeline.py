@@ -1,0 +1,506 @@
+"""Pipelined IBD validation engine — settle horizon, cross-block lane
+packer, late-settle unwind, and the BIP30/sigcache satellites (ISSUE 4).
+
+The load-bearing guarantees under test:
+  - pipelined and serial engines produce byte-identical coin sets and
+    identical per-block verdicts on the same block sequence (both
+    feeding orders);
+  - a block whose signature batch fails AFTER K descendants were
+    speculatively connected unwinds to the byte-identical pre-block
+    coin set, and nothing past the horizon is externalized early;
+  - the cross-block lane packer attributes a bad lane to the right
+    block even when blocks share (or split across) device dispatches.
+
+Marker: ``pipeline`` — conftest orders these after the plain unit suite
+and before the functional/adversarial campaigns; everything here runs
+under JAX_PLATFORMS=cpu in tier-1 (backend="cpu" end to end).
+"""
+
+import functools
+import hashlib
+
+import numpy as np
+import pytest
+
+from bitcoincashplus_tpu.consensus.params import regtest_params
+from bitcoincashplus_tpu.consensus.tx import COutPoint, CTransaction, CTxIn, CTxOut
+from bitcoincashplus_tpu.mining.generate import generate_blocks
+from bitcoincashplus_tpu.ops import dispatch, ecdsa_batch
+from bitcoincashplus_tpu.store.blockstore import MemoryBlockStore
+from bitcoincashplus_tpu.validation.chain import BlockStatus
+from bitcoincashplus_tpu.validation.chainstate import (
+    BlockValidationError,
+    ChainstateManager,
+)
+from bitcoincashplus_tpu.validation.coins import MemoryCoinsView
+from bitcoincashplus_tpu.validation.scriptcheck import BlockScriptVerifier
+from bitcoincashplus_tpu.validation.sigcache import SignatureCache
+from bitcoincashplus_tpu.wallet.keys import CKey
+from bitcoincashplus_tpu.wallet.signing import sign_transaction
+
+from test_validation import TILE, _hand_mine
+
+pytestmark = pytest.mark.pipeline
+
+KEY = CKey(0xDEADBEEFCAFE)
+SPK = KEY.p2pkh_script()
+
+
+def _make_cs(depth: int = 1, start_time: int = 1_600_000_000):
+    import dataclasses
+
+    # regtest_params() is lru_cached — give each chainstate its OWN
+    # checkpoints dict so per-test checkpoint edits can't leak globally
+    params = regtest_params()
+    params = dataclasses.replace(
+        params, checkpoints=dict(params.checkpoints))
+    t = [start_time]
+
+    def fake_time():
+        t[0] += 60
+        return t[0]
+
+    verifier = BlockScriptVerifier(params, backend="cpu")
+    cs = ChainstateManager(
+        params, MemoryCoinsView(), MemoryBlockStore(),
+        script_verifier=verifier, get_time=fake_time,
+    )
+    cs.pipeline_depth = depth
+    return cs
+
+
+def _signed_spend(op, value, key=KEY, out_spk=SPK, fee=10_000):
+    tx = CTransaction(vin=(CTxIn(op),), vout=(CTxOut(value - fee, out_spk),))
+    return sign_transaction(
+        tx, [(SPK, value)],
+        lambda i: key if i in (key.pubkey_hash, key.pubkey) else None,
+        enable_forkid=True,
+    )
+
+
+def _coin_digest(cs) -> str:
+    """Byte digest of the SETTLED coin set (cache flushed into the memory
+    base, rows key-sorted)."""
+    cs.coins.flush()
+    base = cs.coins.base
+    h = hashlib.sha256()
+    for op, coin in sorted(base._coins.items(),
+                           key=lambda kv: (kv[0].hash, kv[0].n)):
+        h.update(op.hash)
+        h.update(op.n.to_bytes(4, "little"))
+        h.update(coin.serialize())
+    h.update(cs.coins.best_block())
+    return h.hexdigest()
+
+
+def _tampered(spend, op):
+    """Flip one byte inside the DER s-value: encodings stay valid (the
+    host scan passes and DEFERS the record), the math fails — the verdict
+    can only arrive at signature settle."""
+    ss = bytearray(spend.vin[0].script_sig)
+    ss[40] ^= 0x01
+    return CTransaction(spend.version, (CTxIn(op, bytes(ss)),),
+                        spend.vout, spend.locktime)
+
+
+RUNWAY = 104
+
+
+@functools.lru_cache(maxsize=None)
+def _runway_blocks():
+    """Mine the 104-block coinbase runway ONCE per test session; replayers
+    get the blocks plus the miner's final clock value (their fake clocks
+    start there so time-too-new can never fire on replay)."""
+    src = _make_cs()
+    generate_blocks(src, SPK, RUNWAY, tile=TILE)
+    blocks = tuple(src.get_block(src.chain[h].hash)
+                   for h in range(1, RUNWAY + 1))
+    return blocks, src.get_time()
+
+
+def _with_runway(depth: int = 1):
+    """A chainstate with the shared runway replayed onto it — identical
+    tip/coin state across instances, no re-mining."""
+    blocks, t_base = _runway_blocks()
+    cs = _make_cs(depth, start_time=t_base)
+    for b in blocks:
+        cs.process_new_block(b)
+    return cs
+
+
+@functools.lru_cache(maxsize=None)
+def _build_sequence(n_good_pre=2, bad=False, n_children=2):
+    """A replayable block sequence on a throwaway source chain (runway +
+    sequence): n_good_pre valid signed spends, optionally one
+    bad-signature block B (tampered s-value — passes the host scan, fails
+    at signature settle), then n_children empty blocks built ON B.
+    Cached: blocks are treated read-only by every consumer."""
+    src = _with_runway()
+    runway = tuple(src.get_block(src.chain[h].hash)
+                   for h in range(1, src.tip().height + 1))
+    seq = []
+
+    def extend(txs):
+        tip = src.tip()
+        blk = _hand_mine(tip.hash, tip.height + 1, src.get_time() + 10,
+                         tip.bits, txs)
+        # grow the source chain WITHOUT script checks so invalid-sig blocks
+        # can be built upon (children must reference B as their parent)
+        sv, src.script_verifier = src.script_verifier, None
+        try:
+            src.process_new_block(blk)
+        finally:
+            src.script_verifier = sv
+        seq.append(blk)
+        return blk
+
+    spendables = [(COutPoint(runway[h].vtx[0].txid, 0),
+                   runway[h].vtx[0].vout[0].value)
+                  for h in range(0, 4)]
+    for k in range(n_good_pre):
+        extend((_signed_spend(*spendables[k]),))
+    if bad:
+        op, value = spendables[n_good_pre]
+        extend((_tampered(_signed_spend(op, value), op),))
+        for _ in range(n_children):
+            extend(())
+    return runway, tuple(seq)
+
+
+def _feed(cs, blocks, pipelined: bool):
+    verdicts = []
+    for blk in blocks:
+        try:
+            if pipelined:
+                cs.process_new_block_pipelined(blk)
+            else:
+                cs.process_new_block(blk)
+            verdicts.append("ok")
+        except BlockValidationError as e:
+            verdicts.append(e.reason)
+    cs.settle_horizon()
+    return verdicts
+
+
+class TestPipelinedEquivalence:
+    def test_valid_chain_identical_coin_set(self):
+        runway, seq = _build_sequence(n_good_pre=3, bad=False)
+        results = {}
+        for depth in (1, 3):
+            cs = _with_runway(depth)
+            _feed(cs, seq, pipelined=(depth > 1))
+            results[depth] = (cs.tip().hash, _coin_digest(cs))
+        assert results[1] == results[3]
+
+    def test_differential_both_orders(self):
+        """The serial and pipelined engines must accept/reject the SAME
+        set of blocks (a pipelined verdict just lands later, at settle)
+        and land on the identical tip + byte-identical coin set for a
+        sequence containing a bad-signature block — whichever engine runs
+        first."""
+        runway, seq = _build_sequence(n_good_pre=2, bad=True, n_children=2)
+        bad_and_children = {b.get_hash() for b in seq[2:]}
+
+        def active_set(cs):
+            return {cs.chain[h].hash
+                    for h in range(cs.tip().height + 1)}
+
+        outcomes = []
+        for order in (("serial", "pipelined"), ("pipelined", "serial")):
+            pair = {}
+            for mode in order:
+                cs = _with_runway(5 if mode == "pipelined" else 1)
+                _feed(cs, seq, pipelined=(mode == "pipelined"))
+                active = active_set(cs)
+                assert not (active & bad_and_children), mode
+                pair[mode] = (cs.tip().hash, frozenset(active),
+                              _coin_digest(cs))
+            assert pair["serial"] == pair["pipelined"], order
+            outcomes.append(pair["serial"])
+        assert outcomes[0] == outcomes[1]
+
+    def test_max_depth_bounded(self):
+        runway, seq = _build_sequence(n_good_pre=3, bad=False)
+        cs = _with_runway(2)
+        _feed(cs, seq, pipelined=True)
+        assert 0 < cs.pipeline_stats["max_depth"] <= 2
+        snap = cs.pipeline_snapshot()
+        for key in ("depth", "in_horizon", "settled_blocks", "unwinds",
+                    "scan_ms", "settle_wait_ms", "commit_ms",
+                    "overlap_fraction", "packer"):
+            assert key in snap
+        assert snap["in_horizon"] == 0
+
+
+class TestLateSettleFailure:
+    def test_unwind_restores_pre_block_coin_set(self):
+        """Block B's batch fails after K=2 children were speculatively
+        connected: the coin set must come back byte-identical to the
+        pre-B state, B marked invalid, children FAILED_CHILD, and the
+        serial engine must reach the same tip + coin set."""
+        runway, seq = _build_sequence(n_good_pre=1, bad=True, n_children=2)
+        cs = _with_runway(depth=6)  # deep enough that B settles late
+        good, bad_blk, child1, child2 = seq
+        cs.process_new_block_pipelined(good)
+        cs.settle_horizon()
+        pre = _coin_digest(cs)
+        pre_tip = cs.tip()
+
+        cs.process_new_block_pipelined(bad_blk)
+        cs.process_new_block_pipelined(child1)
+        cs.process_new_block_pipelined(child2)
+        # all three are speculative: the settled world hasn't moved
+        assert len(cs._horizon) == 3
+        assert cs.settled_tip() is pre_tip
+        assert cs.chain.tip().hash == child2.get_hash()
+
+        cs.settle_horizon()  # B's batch fails here -> full unwind
+        assert cs.tip() is pre_tip
+        assert _coin_digest(cs) == pre
+        assert cs.pipeline_stats["unwinds"] == 1
+        assert cs.pipeline_stats["unwound_blocks"] == 3
+        b_idx = cs.block_index[bad_blk.get_hash()]
+        assert b_idx.status & BlockStatus.FAILED_VALID
+        for child in (child1, child2):
+            c_idx = cs.block_index[child.get_hash()]
+            assert c_idx.status & BlockStatus.FAILED_CHILD
+
+        # differential: the serial engine on the same sequence lands on
+        # the identical tip and byte-identical coin set
+        cs2 = _with_runway(1)
+        _feed(cs2, seq, pipelined=False)
+        assert cs2.tip().hash == pre_tip.hash
+        assert _coin_digest(cs2) == pre
+
+    def test_unwind_leaves_no_inflight_dispatches(self):
+        runway, seq = _build_sequence(n_good_pre=1, bad=True, n_children=2)
+        cs = _with_runway(depth=6)
+        _feed(cs, seq, pipelined=True)
+        assert ecdsa_batch.STATS.in_flight == 0
+        if cs._packer is not None:
+            assert cs._packer.snapshot()["pending_lanes"] == 0
+
+    def test_backpressure_triggers_unwind_mid_feed(self):
+        """With a shallow horizon the bad block's settle fires from the
+        backpressure path while later blocks are being fed; children must
+        then be rejected on accept (bad-prevblk), like the serial engine's
+        ordering would produce."""
+        runway, seq = _build_sequence(n_good_pre=2, bad=True, n_children=3)
+        cs = _with_runway(depth=2)
+        verdicts = _feed(cs, seq, pipelined=True)
+        assert cs.pipeline_stats["unwinds"] == 1
+        assert "bad-prevblk" in verdicts  # a late child hit dead ancestry
+        cs2 = _with_runway(1)
+        _feed(cs2, seq, pipelined=False)
+        assert cs2.tip().hash == cs.tip().hash
+        assert _coin_digest(cs2) == _coin_digest(cs)
+
+
+def _oracle_records(n, bad_at=()):
+    from bitcoincashplus_tpu.crypto import secp256k1 as oracle
+    from bitcoincashplus_tpu.script.interpreter import SigCheckRecord
+
+    recs = []
+    for i in range(n):
+        secret = 0xC0FFEE + 31 * i
+        pub = oracle.point_mul(secret, oracle.G)
+        e = (0xFACE0FF + i) % oracle.N
+        r, s = oracle.ecdsa_sign(secret, e)
+        if i in bad_at:
+            e = (e + 1) % oracle.N  # wrong message: verifies False
+        recs.append(SigCheckRecord(pub, r, s, e))
+    return recs
+
+
+class TestLanePacker:
+    def test_per_block_futures_and_attribution(self):
+        packer = ecdsa_batch.LanePacker(backend="cpu", lanes=8)
+        g1 = _oracle_records(3)
+        g2 = _oracle_records(4, bad_at=(2,))
+        g3 = _oracle_records(2)
+        f1, f2, f3 = packer.add(g1), packer.add(g2), packer.add(g3)
+        packer.flush()
+        assert f1.result().all()
+        ok2 = f2.result()
+        assert list(ok2) == [True, True, False, True]
+        assert f3.result().all()
+        snap = packer.snapshot()
+        assert snap["blocks"] == 3
+        assert snap["lanes_real"] == 9
+        assert snap["pending_lanes"] == 0
+
+    def test_block_split_across_dispatches(self):
+        """A block bigger than the lane target spans multiple shared
+        dispatches; its future still returns lanes in submission order."""
+        packer = ecdsa_batch.LanePacker(backend="cpu", lanes=4)
+        recs = _oracle_records(10, bad_at=(7,))
+        fut = packer.add(recs)
+        packer.flush()
+        ok = fut.result()
+        assert len(ok) == 10
+        assert list(np.nonzero(~ok)[0]) == [7]
+        assert packer.snapshot()["dispatches"] >= 3
+
+    def test_settle_forces_flush_of_parked_lanes(self):
+        """result() on a future whose lanes are still parked behind the
+        fill target must flush rather than deadlock."""
+        packer = ecdsa_batch.LanePacker(backend="cpu", lanes=1 << 20)
+        fut = packer.add(_oracle_records(2))
+        assert fut.result().all()  # no explicit flush()
+        assert packer.snapshot()["pending_lanes"] == 0
+
+    def test_drain_discards_parked_lanes(self):
+        """Abort-path drain must DISCARD a future's still-parked lanes
+        (never verify doomed work) while leaving other futures' records
+        and offsets intact."""
+        packer = ecdsa_batch.LanePacker(backend="cpu", lanes=1 << 20)
+        f1 = packer.add(_oracle_records(3))
+        f2 = packer.add(_oracle_records(2, bad_at=(0,)))
+        f2.drain()
+        snap = packer.snapshot()
+        assert snap["lanes_discarded"] == 2
+        assert f1.result().all()  # offsets survive the mid-buffer discard
+        assert packer.snapshot()["lanes_real"] == 3
+        assert packer.snapshot()["pending_lanes"] == 0
+
+    def test_unhealthy_breaker_disables_aggregation(self):
+        dispatch.reset()
+        try:
+            br = dispatch.breaker("ecdsa")
+            for _ in range(br.cfg.threshold):
+                br.record_failure(RuntimeError("boom"))
+            assert not br.healthy()
+            packer = ecdsa_batch.LanePacker(backend="auto", lanes=1 << 20)
+            fut = packer.add(_oracle_records(2))
+            # device distrusted: records dispatched immediately, not parked
+            assert packer.snapshot()["pending_lanes"] == 0
+            assert fut.result().all()
+        finally:
+            dispatch.reset()
+
+
+class TestSupervisedEnqueue:
+    def test_async_settle_supervision(self):
+        dispatch.reset()
+        try:
+            h = dispatch.supervised_enqueue(
+                "pipetest", lambda: (lambda: 7), lambda: -1, items=3)
+            assert h.result() == 7 and h.used_device
+            # enqueue failure: breaker charged, CPU verdict served
+            h2 = dispatch.supervised_enqueue(
+                "pipetest", lambda: (_ for _ in ()).throw(RuntimeError("x")),
+                lambda: -1)
+            assert h2.result() == -1 and not h2.used_device
+            # settle-time failure: supervision still catches it
+            def enqueue():
+                def settle():
+                    raise RuntimeError("died at settle")
+                return settle
+            h3 = dispatch.supervised_enqueue("pipetest", enqueue, lambda: -2)
+            assert h3.result() == -2 and not h3.used_device
+            snap = dispatch.breaker("pipetest").snapshot()
+            assert snap["consecutive_failures"] >= 1
+            assert snap["fallback_calls"] >= 2
+            # validation probe gates the accept side
+            h4 = dispatch.supervised_enqueue(
+                "pipetest", lambda: (lambda: 9), lambda: -3,
+                validate=lambda out: out == 10)
+            assert h4.result() == -3
+        finally:
+            dispatch.reset()
+
+
+class TestSigCacheSatellite:
+    def test_counters_and_entry_cap_lru(self):
+        c = SignatureCache(max_entries=3)
+        keys = [bytes([i]) * 129 for i in range(5)]
+        for k in keys[:3]:
+            c.add(k)
+        assert c.inserts == 3 and len(c) == 3
+        assert c.contains(keys[0])  # refresh 0 -> 1 is now stalest
+        assert not c.contains(keys[4])
+        c.add(keys[3])  # evicts 1 (LRU), not 0
+        assert c.evictions == 1
+        assert c.contains(keys[0]) and not c.contains(keys[1])
+        snap = c.snapshot()
+        assert snap["entries"] == 3 and snap["inserts"] == 4
+        assert snap["hits"] == 2 and snap["evictions"] == 1
+        assert 0 < snap["hit_rate"] < 1
+
+    def test_byte_cap_binds(self):
+        from bitcoincashplus_tpu.validation.sigcache import ENTRY_COST_BYTES
+
+        c = SignatureCache(max_entries=1 << 20,
+                           max_bytes=2 * ENTRY_COST_BYTES)
+        for i in range(4):
+            c.add(bytes([i]) * 129)
+        assert len(c) == 2
+        assert c.evictions == 2
+        assert c.estimated_bytes() <= 2 * ENTRY_COST_BYTES
+
+
+class TestBIP30Satellite:
+    def test_duplicate_tx_rejected_via_cache_resident_probe(self):
+        """A tx duplicated in a later block trips BIP30 from the cache
+        layer (its unspent outputs are resident), without a store probe."""
+        cs = _make_cs()
+        generate_blocks(cs, SPK, 104, tile=TILE)
+        blk1 = cs.get_block(cs.chain[1].hash)
+        spend = _signed_spend(COutPoint(blk1.vtx[0].txid, 0),
+                              blk1.vtx[0].vout[0].value)
+        tip = cs.tip()
+        a = _hand_mine(tip.hash, tip.height + 1, cs.get_time() + 10,
+                       tip.bits, (spend,))
+        cs.process_new_block(a)
+        assert cs.tip().hash == a.get_hash()
+        before = dict(cs.bip30_stats)
+        b = _hand_mine(a.get_hash(), tip.height + 2, cs.get_time() + 10,
+                       tip.bits, (spend,))  # same tx again
+        idx = cs.accept_block(b)
+        with pytest.raises(BlockValidationError) as ei:
+            cs.connect_block(b, idx)
+        assert ei.value.reason == "bad-txns-BIP30"
+        st = cs.bip30_stats
+        assert st["lookups"] > before["lookups"]
+        assert st["cache_resolved"] > before["cache_resolved"]
+
+    def test_scan_skipped_above_checkpoint(self):
+        """Core's BIP34-era exemption: above the last active-chain
+        checkpoint the per-output scan is skipped entirely."""
+        cs = _make_cs()
+        generate_blocks(cs, SPK, 2, tile=TILE)
+        cs.params.checkpoints[1] = cs.chain[1].hash
+        before = dict(cs.bip30_stats)
+        generate_blocks(cs, SPK, 3, tile=TILE)
+        st = cs.bip30_stats
+        # >= 3: mine_block's TestBlockValidity dry-run connects each block
+        # once more, and the dry-run skips too
+        assert st["skipped_scans"] >= before["skipped_scans"] + 3
+        assert st["skipped_lookups"] > before["skipped_lookups"]
+        assert st["lookups"] == before["lookups"]
+
+    def test_no_checkpoints_means_no_skip(self):
+        cs = _make_cs()
+        before = dict(cs.bip30_stats)
+        generate_blocks(cs, SPK, 2, tile=TILE)
+        st = cs.bip30_stats
+        assert st["skipped_scans"] == before["skipped_scans"]
+        assert st["lookups"] > before["lookups"]
+
+
+class TestNodeKnob:
+    def test_pipelinedepth_flag_wires_through(self, tmp_path):
+        from bitcoincashplus_tpu.node.config import Config
+        from bitcoincashplus_tpu.node.node import Node
+
+        cfg = Config()
+        cfg.args["datadir"] = [str(tmp_path)]
+        cfg.args["regtest"] = ["1"]
+        cfg.args["pipelinedepth"] = ["3"]
+        node = Node(config=cfg)
+        try:
+            assert node.chainstate.pipeline_depth == 3
+            snap = node.chainstate.pipeline_snapshot()
+            assert snap["depth"] == 3 and snap["in_horizon"] == 0
+        finally:
+            node.close()
