@@ -501,12 +501,99 @@ def _make_chaos_corpus(srcdir, dstdir, window: int = 6, seed: int = 13):
     return len(out)
 
 
+def _run_kernel_dimension(workdir, depth, gen):
+    """ecdsa_kernel dimension (ISSUE 5): the pipelined import over the
+    SAME mixed corpus once per device verify kernel (glv, w4), each in a
+    fresh subprocess with BCP_ECDSA_KERNEL pinned and BCP_NO_NATIVE=1 —
+    kernel selection is process-global and the native CPU lane would
+    otherwise swallow every batch on CPU hosts (the native handle is also
+    memoized at first load, so in-process toggling is unreliable). Each
+    run warms its kernel at the packer's bucket shapes before the timed
+    import, so compile cost stays out of the walls. Returns
+    {kernel: {wall_s, digest, decompose_s, pack_s, device_s, ...}} plus
+    glv_speedup."""
+    code = r"""
+import os, sys, json, time, tempfile
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(tempfile.gettempdir(), "bcp-jax-test-cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+import numpy as np
+import bench
+from bitcoincashplus_tpu.ops import ecdsa_batch
+kernel = os.environ["BCP_ECDSA_KERNEL"]
+# warm the kernel at the cross-block packer's dispatch shapes (2048 and
+# the 1024 tail bucket) so XLA compile lands outside the timed legs
+rng = np.random.default_rng(3)
+for n in (2046, 900):
+    ecdsa_batch.verify_batch(bench._make_sig_records(rng, 8, n),
+                             backend="device", kernel=kernel)
+# end-to-end dispatch path (host pack + lattice decompose + device +
+# verdict) over one full packer bucket, fresh-content per run — the leg
+# this kernel swap targets, free of the Python byte engine's wall
+vts = []
+for _ in range(3):
+    recs = bench._make_sig_records(rng, 64, 2046)
+    t0 = time.perf_counter()
+    ok = ecdsa_batch.verify_batch(recs, backend="device", kernel=kernel)
+    vts.append(time.perf_counter() - t0)
+    assert bool(ok.all())
+verify_wall = sorted(vts)[1]
+s0 = ecdsa_batch.STATS.snapshot()
+t0 = time.perf_counter()
+st = bench._run_reindex(%(workdir)r, pipeline_depth=%(depth)d,
+                        force_python=True)
+wall = time.perf_counter() - t0
+s1 = ecdsa_batch.STATS.snapshot()
+out = {
+    "wall_s": round(st["wall_s"], 2),
+    "subprocess_wall_s": round(wall, 2),
+    "verify_wall_s": round(verify_wall, 3),
+    "verify_sigs_per_s": round(2046 / verify_wall),
+    "tip_height": st["tip_height"],
+    "digest": bench._chainstate_digest(%(workdir)r),
+    "decompose_s": round(s1["glv_decompose_s"] - s0["glv_decompose_s"], 3),
+    "pack_s": round(s1["glv_pack_s"] - s0["glv_pack_s"], 3),
+    "device_s": round(s1["device_seconds"] - s0["device_seconds"], 3),
+    "glv_dispatches": s1["glv_dispatches"] - s0["glv_dispatches"],
+    "glv_fallbacks": s1["glv_fallbacks"] - s0["glv_fallbacks"],
+    "dispatches": s1["dispatches"] - s0["dispatches"],
+    "cpu_fallback_sigs": s1["cpu_fallback_sigs"] - s0["cpu_fallback_sigs"],
+}
+print("BENCHJSON " + json.dumps(out))
+""" % {"repo": os.path.dirname(os.path.abspath(__file__)),
+       "workdir": workdir, "depth": depth}
+    runs = {}
+    for kernel in ("w4", "glv"):
+        env = dict(os.environ)
+        env["BCP_ECDSA_KERNEL"] = kernel
+        env["BCP_NO_NATIVE"] = "1"
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, env=env,
+                             timeout=3600)
+        line = [ln for ln in out.stdout.splitlines()
+                if ln.startswith("BENCHJSON ")]
+        if not line:
+            raise RuntimeError(
+                f"kernel-dimension subprocess ({kernel}) failed: "
+                f"{out.stderr[-400:]}")
+        runs[kernel] = json.loads(line[-1][len("BENCHJSON "):])
+        runs[kernel]["sigs_per_s"] = round(
+            gen["sigs"] / max(runs[kernel]["wall_s"], 1e-9))
+    return runs
+
+
 def bench_import_pipeline():
     """ISSUE 4 tentpole metric: the pipelined Python IBD engine (settle
     horizon + cross-block lane packer) vs the serial engine on the SAME
     mixed-script corpus — per-leg wall times, measured overlap fraction,
     end-to-end sigs/s, and byte-identical-chainstate checks on both the
-    mixed and the chaos (shuffled/garbage-framed) corpora."""
+    mixed and the chaos (shuffled/garbage-framed) corpora. ISSUE 5 adds
+    the ecdsa_kernel dimension: the same mixed corpus imported once per
+    device verify kernel (w4 vs GLV, device-forced batches), emitting
+    glv_speedup, per-stage packer/decompose/device timings, and the
+    cross-kernel chainstate digest equality check."""
     import shutil
     import tempfile
 
@@ -527,6 +614,30 @@ def bench_import_pipeline():
                 st = _run_reindex(cdir, pipeline_depth=d, force_python=True)
                 runs[(corpus, mode)] = st
                 digests[(corpus, mode)] = _chainstate_digest(cdir)
+
+        # ecdsa_kernel dimension: both kernels over the mixed corpus
+        # (device-forced, subprocess-isolated); digests must match each
+        # other AND the in-process runs above
+        try:
+            kruns = _run_kernel_dimension(workdir, depth, gen)
+            # headline ratio: the verify dispatch path end to end (host
+            # pack + lattice decompose + device + verdict) — the leg this
+            # kernel swap targets; the import-wall ratio is reported
+            # alongside but is byte-engine-bound under BCP_NO_NATIVE
+            # (Python deserialization dominates it on CPU hosts)
+            glv_speedup = round(
+                kruns["w4"]["verify_wall_s"]
+                / max(kruns["glv"]["verify_wall_s"], 1e-9), 4)
+            glv_import_speedup = round(
+                kruns["w4"]["wall_s"] / max(kruns["glv"]["wall_s"], 1e-9), 4)
+            kernel_digests_identical = (
+                kruns["w4"].pop("digest") == kruns["glv"].pop("digest")
+            )
+        except Exception as e:  # pragma: no cover - diagnostics only
+            kruns = {"error": f"{type(e).__name__}: {e}"}
+            glv_speedup = None
+            glv_import_speedup = None
+            kernel_digests_identical = None
 
         mp = runs[("mixed", "pipelined")]
         ms = runs[("mixed", "serial")]
@@ -563,6 +674,10 @@ def bench_import_pipeline():
             },
             corpus={"sigs": gen["sigs"], "blocks": gen["blocks"],
                     "bytes": gen["bytes"], "mixed": True},
+            ecdsa_kernel=kruns,
+            glv_speedup=glv_speedup,
+            glv_import_speedup=glv_import_speedup,
+            kernel_digests_identical=kernel_digests_identical,
             chaos={
                 "pipelined_wall_s":
                     round(runs[("chaos", "pipelined")]["wall_s"], 2),
@@ -579,11 +694,18 @@ def bench_import_pipeline():
                  "overlap_fraction = share of dispatched-batch lifetime "
                  "the host spent NOT blocked on settle (sync CPU backend "
                  "books verify at enqueue, inside scan_ms); vs_baseline = "
-                 "pipelined/serial end-to-end sigs/s",
+                 "pipelined/serial end-to-end sigs/s; glv_speedup = w4/glv "
+                 "verify-dispatch wall (pack+decompose+device+verdict, "
+                 "full 2048 bucket, fresh content, median of 3) — "
+                 "glv_import_speedup is the whole-import ratio, byte-"
+                 "engine-bound under BCP_NO_NATIVE on CPU hosts; kernel "
+                 "runs are device-forced with chainstate digests compared "
+                 "across kernels",
         )
         return {"pipeline_sigs_per_s": sps_pipe,
                 "pipeline_overlap": pipe.get("overlap_fraction", 0.0),
-                "pipeline_identical": all(identical.values())}
+                "pipeline_identical": all(identical.values()),
+                "glv_speedup": glv_speedup}
     except Exception as e:  # pragma: no cover - diagnostics only
         emit("import_pipeline", -1, "sigs/s", 0.0,
              error=f"{type(e).__name__}: {e}")

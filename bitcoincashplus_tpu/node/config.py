@@ -47,6 +47,10 @@ Options:
   -minrelaytxfee=<amt>   Minimum relay fee rate in satoshis/kB (default: 1000)
   -tpu=<0|1>             Use the TPU batch backend for sig verification and
                          mining sweeps (default: auto-detect)
+  -ecdsakernel=<glv|w4>  Device ECDSA verify kernel: glv = endomorphism-split
+                         ladder + fixed-base G comb (default), w4 = the
+                         64-window kernel (kept as oracle/fallback); unknown
+                         values are rejected at startup
   -port=<port>           Listen for P2P connections on <port>
   -listen                Accept P2P connections from outside (default: 1 when P2P enabled)
   -connect=<ip:port>     Connect only to the specified node (may be repeated)

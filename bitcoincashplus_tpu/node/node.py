@@ -32,7 +32,7 @@ from ..validation.chain import BlockStatus
 from ..validation.chainstate import BlockValidationError, ChainstateManager
 from ..validation.scriptcheck import BlockScriptVerifier
 from ..validation.sigcache import SignatureCache
-from .config import Config
+from .config import Config, ConfigError
 
 DEFAULT_FLUSH_INTERVAL = 64  # blocks between periodic FlushStateToDisk calls
 
@@ -169,8 +169,22 @@ class Node:
         self.versionbits_cache = VersionBitsCache()
         backend = config.tpu_backend
         self.backend = backend
+        # -ecdsakernel=<glv|w4>: device verify kernel selection. Validated
+        # HERE, at startup — an unknown value must fail init (like a
+        # malformed -maxsigcachesize), not surface as a per-batch fallback
+        # at the first block (ops/ecdsa_batch.set_kernel raises on junk)
+        from ..ops import ecdsa_batch as _eb
+
+        if config.has("ecdsakernel"):
+            try:
+                self.ecdsa_kernel = _eb.set_kernel(config.get("ecdsakernel"))
+            except ValueError as e:
+                raise ConfigError(str(e)) from None
+        else:
+            self.ecdsa_kernel = _eb.active_kernel()
         verifier = BlockScriptVerifier(self.params, backend=backend,
-                                       sigcache=self.sigcache)
+                                       sigcache=self.sigcache,
+                                       kernel=self.ecdsa_kernel)
         self.chainstate = ChainstateManager(
             self.params, self.coins_db, self.block_store,
             script_verifier=verifier, index_db=self.index_db,
@@ -582,7 +596,8 @@ class Node:
         state (the native fast-import recovery path). Only callable before
         servers start — import runs during init."""
         verifier = BlockScriptVerifier(self.params, backend=self.backend,
-                                       sigcache=self.sigcache)
+                                       sigcache=self.sigcache,
+                                       kernel=self.ecdsa_kernel)
         self.block_store.positions.clear()
         self.block_store.undo_positions.clear()
         self.chainstate = ChainstateManager(

@@ -5,7 +5,12 @@ ops/miner, ops/ecdsa_batch) funnels through ``supervised_call``: bounded
 retries with jittered backoff absorb transient device errors; a per-
 subsystem circuit breaker opens after N consecutive hard failures and
 routes traffic to the reference CPU engine; probabilistic half-open probes
-re-test the device and close the breaker on recovery. Validation probes
+re-test the device and close the breaker on recovery. The ecdsa site
+additionally carries a KERNEL chain inside the breaker boundary
+(glv -> w4 -> XLA ladder, -ecdsakernel selects; ops/ecdsa_batch): the
+known-answer probe lanes ride — and therefore validate — whichever
+kernel actually served the batch, so a lying GLV mask is caught by the
+same KAT gate as any other device fault. Validation probes
 (known-answer lanes, witness pairs, hit re-verification) catch poisoned
 device output before it is trusted, and every REJECT-side verdict is
 additionally host-confirmed (ecdsa_batch False lanes, merkle_root

@@ -8,8 +8,11 @@ verified in ONE device dispatch via ops/secp256k1.ecdsa_verify_batch_jit
 (SURVEY.md §3.2 P1, §8.4 "ECDSA batch").
 
 Pipeline per batch:
-  1. host: w = s⁻¹ mod n, u1 = e·w, u2 = r·w  (Python ints, µs per sig)
-  2. pack: u1/u2 → (256, B) MSB-first bit planes; qx/qy/r/rn → (20, B)
+  1. host: w = s⁻¹ mod n, u1 = e·w, u2 = r·w  (Python ints, µs per sig);
+     the GLV kernel additionally lattice-splits each scalar
+     (k = k1 + λ·k2, |k1|,|k2| < 2^128 — pack_records_glv)
+  2. pack: u1/u2 → (256, B) MSB-first bit planes (ladder kernels) or
+     split-scalar byte matrices + sign flags (GLV); qx/qy/r/rn → (20, B)
      13-bit limbs; wrap_ok = (r + n < p) per lane (the kernel gates the
      x-wraparound candidate on it — see ecdsa_verify_batch_device)
   3. pad B up to a bucket size (bounds XLA recompiles to len(BUCKETS))
@@ -40,6 +43,72 @@ BUCKETS = (32, 128, 512, 2048, 8192, 16384, 32768)
 # Below this lane count a device round-trip costs more than host verify.
 CPU_FLOOR = 8
 
+# ---- kernel selection (-ecdsakernel=glv|w4) --------------------------------
+# "glv": the λ-endomorphism split verifier (ops/secp256k1 GLV core — 32
+# windows / 128 doublings over four addition streams + the fixed-base G
+# comb). "w4": the previous-generation 64-window kernel, kept in-tree as
+# the differential oracle and the breaker/dispatch fallback. The GLV path
+# degrades w4 -> XLA ladder -> CPU on failure; selection is validated at
+# node startup (node.py rejects unknown values before the first batch).
+ECDSA_KERNELS = ("glv", "w4")
+# Fault-injection site for the GLV leg specifically (explicit opt-in only,
+# like util/faults' "net" site: BCP_FAULT_OPS=all keeps meaning the four
+# accelerator subsystems, so existing dead-backend drills are unchanged).
+# fail-* modes prove the glv -> w4 dispatch fallback; poison-output proves
+# the KAT gate catches a lying GLV mask and settles on the CPU engine.
+GLV_SITE = "ecdsa_glv"
+_KERNEL = None  # set_kernel() override; None = BCP_ECDSA_KERNEL or "glv"
+_BAD_ENV_WARNED = False
+
+
+def active_kernel() -> str:
+    """The kernel the next device dispatch will try first. An invalid
+    BCP_ECDSA_KERNEL value falls back to the default with a one-time
+    warning (this runs on the dispatch hot path, so it must not raise —
+    the -ecdsakernel flag is the validated front door)."""
+    global _BAD_ENV_WARNED
+    if _KERNEL is not None:
+        return _KERNEL
+    env = os.environ.get("BCP_ECDSA_KERNEL", "glv")
+    if env in ECDSA_KERNELS:
+        return env
+    if not _BAD_ENV_WARNED:
+        _BAD_ENV_WARNED = True
+        log_printf("BCP_ECDSA_KERNEL=%r is not one of %s — using 'glv'",
+                   env, "/".join(ECDSA_KERNELS))
+    return "glv"
+
+
+def set_kernel(name: str) -> str:
+    """Select the device verify kernel; raises ValueError on unknown names
+    (node startup turns that into a ConfigError — reject at init, not at
+    the first batch)."""
+    global _KERNEL
+    if name not in ECDSA_KERNELS:
+        raise ValueError(
+            f"-ecdsakernel={name!r}: unknown kernel "
+            f"(valid: {', '.join(ECDSA_KERNELS)})"
+        )
+    _KERNEL = name
+    return name
+
+
+def kernel_info() -> dict:
+    """gettpuinfo's ``ecdsa`` section: the active kernel, GLV health, the
+    one-time fixed-base-table build cost, and the host pack-stage split."""
+    from . import secp256k1 as dev_mod
+
+    return {
+        "kernel": active_kernel(),
+        "kernels": list(ECDSA_KERNELS),
+        "glv_broken": _GLV_BROKEN,
+        "glv_dispatches": STATS.glv_dispatches,
+        "glv_fallbacks": STATS.glv_fallbacks,
+        "table_build_s": round(dev_mod.GLV_TABLE_BUILD_S, 4),
+        "decompose_s": round(STATS.glv_decompose_s, 4),
+        "pack_s": round(STATS.glv_pack_s, 4),
+    }
+
 
 @dataclass
 class BatchStats:
@@ -61,9 +130,17 @@ class BatchStats:
     in_flight: int = 0
     max_in_flight: int = 0
     pallas_fallbacks: int = 0  # Mosaic compile failures -> XLA kernel
-    # w4 kernel lanes flagged degenerate (adversarially-crafted H == 0
+    # w4/glv kernel lanes flagged degenerate (adversarially-crafted H == 0
     # collisions) and re-verified on the CPU path — see ops/secp256k1.py
     degenerate_rechecks: int = 0
+    # GLV kernel accounting: dispatches that ran the GLV program, GLV-leg
+    # failures that degraded to the w4 kernel, and the host-side pack
+    # stage split (lattice decomposition vs byte packing) for the
+    # per-stage bench timings (gettpuinfo `ecdsa` section)
+    glv_dispatches: int = 0
+    glv_fallbacks: int = 0
+    glv_decompose_s: float = 0.0
+    glv_pack_s: float = 0.0
     # supervised-dispatch accounting (ops/dispatch breaker layer): sigs
     # re-verified on the CPU engine because the device path failed or its
     # known-answer lanes came back wrong. NOTE sigs_padded includes the 2
@@ -267,6 +344,89 @@ def pack_records_w4_bytes(records: Sequence, bucket: int):
     return u1m, u2m, qxb, qyb, q_inf, r0b, rnb, wrap8
 
 
+def _glv_pack_parts(u1_bytes, u2_bytes, qx_bytes, qy_ints, r_bytes,
+                    rn_bytes, wraps, range_bad, bucket: int):
+    """Shared GLV pack: lattice-decompose the (u1, u2) scalars on host
+    (exact Python ints — the "lattice reduction on host in the packer"
+    leg) and emit the GLV program's byte matrices. u1/u2: (n, 32) uint8
+    big-endian scalars; qx_bytes/r_bytes/rn_bytes: (n, 32) uint8;
+    qy_ints: per-record pubkey y as Python ints (the first Q-stream sign
+    folds into y here, so the device never negates Q). range_bad: (n,)
+    bool poison mask or None. Decompose and pack stages are timed into
+    STATS for the bench's per-stage split."""
+    from . import secp256k1 as dev
+
+    n = len(qy_ints)
+    t0 = time.monotonic()
+    d1m = np.zeros((bucket, 16), np.uint8)
+    d2m = np.zeros((bucket, 16), np.uint8)
+    s1m = np.zeros((bucket, 16), np.uint8)
+    s2m = np.zeros((bucket, 16), np.uint8)
+    sg1 = np.zeros(bucket, np.uint8)
+    sg2 = np.zeros(bucket, np.uint8)
+    ydiff = np.zeros(bucket, np.uint8)
+    qyb = np.zeros((bucket, 32), np.uint8)
+    for i in range(n):
+        u1 = int.from_bytes(u1_bytes[i].tobytes(), "big")
+        u2 = int.from_bytes(u2_bytes[i].tobytes(), "big")
+        a1, na1, a2, na2 = dev.glv_decompose(u1)
+        b1, nb1, b2, nb2 = dev.glv_decompose(u2)
+        # comb digits little-endian (position i = weight 256^i); ladder
+        # scalars big-endian (MSB-first nibble windows on device)
+        d1m[i] = np.frombuffer(a1.to_bytes(16, "little"), np.uint8)
+        d2m[i] = np.frombuffer(a2.to_bytes(16, "little"), np.uint8)
+        s1m[i] = np.frombuffer(b1.to_bytes(16, "big"), np.uint8)
+        s2m[i] = np.frombuffer(b2.to_bytes(16, "big"), np.uint8)
+        sg1[i] = na1
+        sg2[i] = na2
+        ydiff[i] = nb1 ^ nb2
+        qy = oracle.P - qy_ints[i] if nb1 else qy_ints[i]
+        qyb[i] = np.frombuffer(qy.to_bytes(32, "big"), np.uint8)
+    STATS.glv_decompose_s += time.monotonic() - t0
+
+    t0 = time.monotonic()
+
+    def pad(mat: np.ndarray) -> np.ndarray:
+        out = np.zeros((bucket, 32), np.uint8)
+        out[:n] = mat
+        return out
+
+    q_inf = np.ones(bucket, np.uint8)
+    q_inf[:n] = (np.asarray(range_bad, bool).astype(np.uint8)
+                 if range_bad is not None else 0)
+    wrap8 = np.zeros(bucket, np.uint8)
+    wrap8[:n] = np.asarray(wraps, np.uint8)
+    out = (d1m, d2m, sg1, sg2, s1m, s2m, ydiff, pad(qx_bytes), qyb,
+           q_inf, pad(r_bytes), pad(rn_bytes), wrap8)
+    STATS.glv_pack_s += time.monotonic() - t0
+    return out
+
+
+def pack_records_glv(records: Sequence, bucket: int):
+    """pack_records for the GLV kernel: split scalars + signs (the packer
+    emits the λ-decomposition; LanePacker buckets are unchanged). Padded
+    lanes are poisoned exactly like the w4 packers."""
+    n = len(records)
+    u1_bytes, u2_bytes, range_ok = _scalar_bitplanes(records, n)
+    wraps = [rec.r + oracle.N < oracle.P for rec in records]
+    qx_bytes = np.frombuffer(
+        b"".join(rec.pubkey[0].to_bytes(32, "big") for rec in records),
+        np.uint8).reshape(n, 32) if n else np.zeros((0, 32), np.uint8)
+    r_bytes = np.frombuffer(
+        b"".join(rec.r.to_bytes(32, "big") for rec in records),
+        np.uint8).reshape(n, 32) if n else np.zeros((0, 32), np.uint8)
+    rn_bytes = np.frombuffer(
+        b"".join((rec.r + oracle.N if w else rec.r).to_bytes(32, "big")
+                 for rec, w in zip(records, wraps)),
+        np.uint8).reshape(n, 32) if n else np.zeros((0, 32), np.uint8)
+    range_bad = None if range_ok is None else ~np.asarray(range_ok, bool)
+    return _glv_pack_parts(
+        u1_bytes, u2_bytes, qx_bytes,
+        [rec.pubkey[1] for rec in records], r_bytes, rn_bytes, wraps,
+        range_bad, bucket,
+    )
+
+
 def _verify_cpu(records: Sequence) -> np.ndarray:
     """CPU lane: the native C++ scalar module (threaded via -par) when
     available, else the Python-int oracle. Differential parity is covered
@@ -452,11 +612,14 @@ class BatchHandle:
         return self._cpu_ok
 
 
-def dispatch_batch(records: Sequence, backend: str = "auto") -> BatchHandle:
+def dispatch_batch(records: Sequence, backend: str = "auto",
+                   kernel: str | None = None) -> BatchHandle:
     """Enqueue a verify batch without waiting; returns a BatchHandle.
 
     backend: "auto" (device if available and batch >= CPU_FLOOR),
     "device" (force), "cpu" (force oracle — synchronous).
+    kernel: per-call override of the device verify kernel ("glv"/"w4");
+    None uses active_kernel() (the -ecdsakernel startup selection).
 
     The device leg is supervised (ops/dispatch): the ecdsa circuit breaker
     gates it, bounded retries absorb transient dispatch errors, and a
@@ -473,7 +636,7 @@ def dispatch_batch(records: Sequence, backend: str = "auto") -> BatchHandle:
     if use_device:
         br = dispatch.breaker("ecdsa")
         if br.allow():
-            handle = _dispatch_device(records, br)
+            handle = _dispatch_device(records, br, kernel=kernel)
             if handle is not None:
                 return handle
             # device leg failed after retries (breaker already charged):
@@ -486,21 +649,60 @@ def dispatch_batch(records: Sequence, backend: str = "auto") -> BatchHandle:
     return BatchHandle(n, cpu_ok=_verify_cpu(records))
 
 
-def _dispatch_device(records: Sequence, br) -> Optional[BatchHandle]:
+def _interpret_kernels() -> bool:
+    """True when the Pallas w4 kernels must run in interpret mode: CPU
+    backends have no Mosaic, and WITHOUT this the dispatch path silently
+    degraded every CPU "device" batch to the 256-step XLA bit ladder
+    (pallas_call raises "Only interpret mode is supported on CPU
+    backend"). Interpret mode lowers the real w4 kernel through XLA — the
+    same arrangement parallel/sig_shard uses on virtual CPU meshes."""
+    from .sha256 import backend_is_cpu
+
+    return backend_is_cpu()
+
+
+def _dispatch_device(records: Sequence, br,
+                     kernel: str | None = None) -> Optional[BatchHandle]:
     """One supervised device enqueue attempt (with retries). Returns None
     when every attempt failed — the caller owns the CPU fallback. Two
     known-answer lanes (good + bad signature) ride after the real records
-    so BatchHandle.result can detect a lying validity mask."""
+    so BatchHandle.result can detect a lying validity mask (the KAT lanes
+    ride — and therefore exercise — whichever kernel actually ran,
+    GLV included).
+
+    Kernel chain: GLV (when selected and not latched broken) -> w4 Pallas
+    -> XLA bit ladder; a GLV-leg failure is metered (STATS.glv_fallbacks)
+    and degrades to w4 within the same attempt."""
     from . import secp256k1 as dev
 
     wire = list(records) + list(_kat_records())
     boff = Backoff(base=br.cfg.backoff_base, maximum=1.0)
     last: Optional[BaseException] = None
+    kern = kernel if kernel in ECDSA_KERNELS else active_kernel()
     for attempt in range(br.cfg.retries + 1):
         try:
             INJECTOR.on_call("ecdsa")
             device_ok = degen = None
-            if pallas_enabled():
+            if kern == "glv" and glv_enabled():
+                # floor 1024: the GLV program shapes stay the packed-path
+                # bucket set {1024, 2048, ...} — sub-128 record batches
+                # would otherwise each compile a tiny one-off shape
+                # (~minutes per shape on a CPU backend, and every shape is
+                # a fresh XLA program on the chip too)
+                bucket = max(1024, _bucket_for(len(wire), pallas=True))
+                try:
+                    INJECTOR.on_call(GLV_SITE)
+                    arrays = pack_records_glv(wire, bucket)
+                    device_ok, degen = dev.ecdsa_verify_batch_glv(*arrays)
+                    if INJECTOR.should_poison(GLV_SITE):
+                        device_ok = ~device_ok
+                    STATS.glv_dispatches += 1
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:
+                    _note_glv_failure(e)
+                    device_ok = degen = None
+            if device_ok is None and pallas_enabled():
                 bucket = _bucket_for(len(wire), pallas=True)
                 try:
                     if bucket % 1024 == 0:
@@ -510,7 +712,8 @@ def _dispatch_device(records: Sequence, br) -> Optional[BatchHandle]:
                         # trip (ops/secp256k1.py)
                         arrays = pack_records_w4_bytes(wire, bucket)
                         device_ok, degen = \
-                            dev.ecdsa_verify_batch_pallas_w4_bytes(*arrays)
+                            dev.ecdsa_verify_batch_pallas_w4_bytes(
+                                *arrays, interpret=_interpret_kernels())
                     else:
                         arrays = pack_records_w4(wire, bucket)
                         device_ok, degen = dev.ecdsa_verify_batch_pallas_w4(
@@ -546,6 +749,35 @@ def _dispatch_device(records: Sequence, br) -> Optional[BatchHandle]:
 
 
 _PALLAS_BROKEN = False
+_GLV_BROKEN = False
+
+
+def glv_enabled() -> bool:
+    """Gate for the GLV device leg (kernel selection happens separately —
+    see active_kernel); latched off on deterministic lowering failures so
+    a toolchain that can't compile the GLV program degrades to w4 once,
+    not per dispatch."""
+    return not _GLV_BROKEN
+
+
+def _note_glv_failure(e: Exception) -> None:
+    """GLV-leg failure bookkeeping: the dispatch degrades to the w4 kernel
+    (same supervised attempt). Deterministic lowering failures latch
+    _GLV_BROKEN; transient errors (including injected drill faults) do
+    not. Programming errors re-raise — same invariant as
+    _note_pallas_failure: a NameError in the GLV core must not hide
+    behind a green w4 fallback forever."""
+    global _GLV_BROKEN
+    if isinstance(e, (NameError, AttributeError, UnboundLocalError)):
+        raise e
+    STATS.glv_fallbacks += 1
+    text = f"{type(e).__name__}: {e}"
+    if ("Mosaic" in text or "NotImplementedError" in text
+            or "lowering" in text):
+        _GLV_BROKEN = True
+    log_printf("glv ECDSA kernel failed (%s) — w4 fallback%s",
+               text[:200],
+               " (latched)" if _GLV_BROKEN else "")
 
 
 def pallas_enabled() -> bool:
@@ -584,9 +816,10 @@ def _note_pallas_failure(e: Exception) -> None:
                " (latched)" if _PALLAS_BROKEN else "")
 
 
-def verify_batch(records: Sequence, backend: str = "auto") -> np.ndarray:
+def verify_batch(records: Sequence, backend: str = "auto",
+                 kernel: str | None = None) -> np.ndarray:
     """Verify all records synchronously; returns (len(records),) bool."""
-    return dispatch_batch(records, backend).result()
+    return dispatch_batch(records, backend, kernel=kernel).result()
 
 
 # ---------------------------------------------------------------------------
@@ -675,9 +908,11 @@ class LanePacker:
     the device path open all lanes go to the CPU engine and aggregation
     would only add settle latency."""
 
-    def __init__(self, backend: str = "auto", lanes: int = 2046):
+    def __init__(self, backend: str = "auto", lanes: int = 2046,
+                 kernel: str | None = None):
         self.backend = backend
         self.lanes = lanes
+        self.kernel = kernel  # per-packer -ecdsakernel override (wiring)
         self._pending: list = []           # records awaiting dispatch
         self._pending_futs: list = []      # (future, count) per add(), order
         self.stats = {
@@ -742,7 +977,8 @@ class LanePacker:
         batch = self._pending[:n]
         del self._pending[:n]
         try:
-            handle = dispatch_batch(batch, backend=self.backend)
+            handle = dispatch_batch(batch, backend=self.backend,
+                                    kernel=self.kernel)
         except (KeyboardInterrupt, SystemExit,
                 NameError, AttributeError, UnboundLocalError):
             raise  # programming errors must surface, not degrade
@@ -884,9 +1120,16 @@ def dispatch_packed(pub: np.ndarray, rs: np.ndarray, msg: np.ndarray,
     )
     if not use_device and native.available():
         return _packed_cpu_handle(pub, rs, msg, n)
-    if not (use_device and pallas_enabled()):
-        # XLA fallback (pallas broken / no native lib): go through the
-        # record-level path — rare, and it keeps one source of truth
+    # the packed device leg is viable when EITHER byte-pipeline kernel can
+    # run: the GLV program is plain XLA and does not need Pallas, so a
+    # latched-broken Mosaic toolchain must not push the hottest import
+    # path through the per-record Python repack below
+    packed_ok = pallas_enabled() or (
+        active_kernel() == "glv" and glv_enabled()
+    )
+    if not (use_device and packed_ok):
+        # XLA fallback (both kernels broken / no native lib): go through
+        # the record-level path — rare, and it keeps one source of truth
         recs = _LazyRecords(pub, rs, msg)
         return dispatch_batch([recs[i] for i in range(n)], backend=backend)
 
@@ -965,19 +1208,46 @@ def _dispatch_packed_device(pub, rs, msg, rn, wrap, n: int,
             q_inf[:m] = range_bad.astype(np.uint8)
             wrap8 = np.zeros(bucket, np.uint8)
             wrap8[:m] = wrap2
-            try:
-                device_ok, degen = dev.ecdsa_verify_batch_pallas_w4_bytes(
-                    pad(u1, 32), pad(u2, 32), pad(pub2[:, :32], 32),
-                    pad(pub2[:, 32:], 32), q_inf, pad(rs2[:, :32], 32),
-                    pad(rn2, 32), wrap8)
-            except (KeyboardInterrupt, SystemExit):
-                raise
-            except Exception as e:
-                # pallas bookkeeping scoped to the KERNEL call only — a
-                # failure in the precompute/pack legs above must not
-                # latch _PALLAS_BROKEN (may re-raise programming errors)
-                _note_pallas_failure(e)
-                raise
+            device_ok = degen = None
+            if active_kernel() == "glv" and glv_enabled():
+                # GLV leg for the packed path: same host lattice split as
+                # pack_records_glv, fed from the blobs (qy ints only for
+                # the sign fold); failure degrades to the w4 kernel below
+                try:
+                    INJECTOR.on_call(GLV_SITE)
+                    qy_ints = [
+                        int.from_bytes(pub2[i, 32:].tobytes(), "big")
+                        for i in range(m)
+                    ]
+                    arrays = _glv_pack_parts(
+                        u1, u2, pub2[:, :32], qy_ints, rs2[:, :32], rn2,
+                        wrap2.astype(bool), range_bad, bucket)
+                    device_ok, degen = dev.ecdsa_verify_batch_glv(*arrays)
+                    if INJECTOR.should_poison(GLV_SITE):
+                        device_ok = ~device_ok
+                    STATS.glv_dispatches += 1
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:
+                    _note_glv_failure(e)
+                    device_ok = degen = None
+            if device_ok is None:
+                try:
+                    device_ok, degen = \
+                        dev.ecdsa_verify_batch_pallas_w4_bytes(
+                            pad(u1, 32), pad(u2, 32), pad(pub2[:, :32], 32),
+                            pad(pub2[:, 32:], 32), q_inf,
+                            pad(rs2[:, :32], 32), pad(rn2, 32), wrap8,
+                            interpret=_interpret_kernels())
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:
+                    # pallas bookkeeping scoped to the KERNEL call only —
+                    # a failure in the precompute/pack legs above must not
+                    # latch _PALLAS_BROKEN (may re-raise programming
+                    # errors)
+                    _note_pallas_failure(e)
+                    raise
             _note_device_dispatch(n, bucket)
 
             def recover() -> np.ndarray:

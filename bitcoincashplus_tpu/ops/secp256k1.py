@@ -31,6 +31,7 @@ Differentially tested against crypto/secp256k1.py (the Python-int oracle).
 from __future__ import annotations
 
 import os
+import time
 from functools import partial
 
 import jax
@@ -691,22 +692,18 @@ def _tab_select_u(win, tab: list) -> dict:
     return out
 
 
-def _verify_core_w4(get_w1, get_w2, qx, qy, q_inf2, r0, rn, wrap2):
-    """Windowed ecdsa verify core: window planes are (64, *lanes) int32
-    values in 0..15, MSB-first. Lane axes are generic: (B,) for the 2D
-    kernel, (8, T) for the aligned 3D kernel. Returns (ok, degen) as
-    (1, *lanes) int32 0/1 planes — degen lanes carry garbage and MUST be
-    re-verified by the caller."""
+def _w4_tables(qx, qy, q_inf_u, one, shape):
+    """The w4 core's tables. G table: jG for j = 1..15 as affine
+    compile-time constants (synthesized in-kernel — Mosaic forbids
+    captured arrays; Python ints at trace time). Q table: jQ for
+    j = 1..15, Jacobian, built with cheap adds — collisions in the build
+    need (j-1)Q = +/-Q with 3 <= j <= 15, impossible in a prime-order
+    group, so no degeneracy tracking here; j = 2 uses the double
+    (1Q + 1Q IS the `same` case). Split out of _verify_core_w4 so the
+    roofline op census (tools/roofline.py --ecdsa) can cost the table
+    build separately from the ladder."""
     from ..crypto.secp256k1 import G, point_add
 
-    lanes = qx.shape[1:]
-    shape = (N_LIMBS,) + lanes
-    one = _build_const_limbs([1], shape)
-    q_inf_u = q_inf2.astype(jnp.int32)
-    never_inf = jnp.zeros((1,) + lanes, jnp.int32)
-
-    # G table: jG for j = 1..15 as affine compile-time constants (synthesized
-    # in-kernel — Mosaic forbids captured arrays). Python ints at trace time.
     g_tab = [None]
     pt = G
     for j in range(1, 16):
@@ -716,10 +713,6 @@ def _verify_core_w4(get_w1, get_w2, qx, qy, q_inf2, r0, rn, wrap2):
         ))
         pt = point_add(pt, G) if j < 15 else pt
 
-    # Q table: jQ for j = 1..15, Jacobian, built with cheap adds. Collisions
-    # in the build need (j-1)Q = +/-Q with 3 <= j <= 15 — impossible in a
-    # prime-order group — so no degeneracy tracking here; j = 2 uses the
-    # double (1Q + 1Q IS the `same` case).
     q_jac = {
         "X": jnp.broadcast_to(qx, shape).astype(jnp.uint32),
         "Y": jnp.broadcast_to(qy, shape).astype(jnp.uint32),
@@ -730,6 +723,59 @@ def _verify_core_w4(get_w1, get_w2, qx, qy, q_inf2, r0, rn, wrap2):
     for j in range(3, 16):
         added, _hz = _pt_add_mixed_cheap_u(q_tab[j - 1], qx, qy, q_inf_u, one)
         q_tab.append(added)
+    return g_tab, q_tab
+
+
+def _w4_window_step(carry, w1, w2, g_tab, q_tab, q_inf_u, one, never_inf):
+    """One w4 window: 4 doublings + select-merged G (mixed) and Q (full)
+    adds. w1/w2 are (1, *lanes) int32 window values in 0..15."""
+    acc, degen = carry
+    acc = pt_double(pt_double(pt_double(pt_double(acc))))
+    # G leg: mixed add from the constant affine table
+    gx_sel, gy_sel = g_tab[1]
+    for j in range(2, 16):
+        pred = w1 == j
+        gx_sel = jnp.where(pred, g_tab[j][0], gx_sel)
+        gy_sel = jnp.where(pred, g_tab[j][1], gy_sel)
+    act1 = jnp.where(w1 != 0, 1, 0)
+    added, hz = _pt_add_mixed_cheap_u(acc, gx_sel, gy_sel, never_inf, one)
+    acc = _pt_select_u(act1, added, acc)
+    degen = jnp.maximum(degen, hz * act1)
+    # Q leg: full add from the per-lane Jacobian table
+    q_sel = _tab_select_u(w2, q_tab)
+    act2 = jnp.where(w2 != 0, 1, 0) * (1 - q_inf_u)
+    added, hz = _pt_add_full_cheap_u(acc, q_sel)
+    acc = _pt_select_u(act2, added, acc)
+    degen = jnp.maximum(degen, hz * act2)
+    return acc, degen
+
+
+def _verify_final(acc, degen, q_inf_u, r0, rn, wrap2):
+    """Shared verify-equation epilogue (w4 and GLV cores): X_R == r·Z²
+    for r in {r0, rn}, the rn candidate gated by wrap_ok."""
+    ZZ = f_sqr(acc["Z"])
+    ok0 = _is_zero_u(f_carry_sub(acc["X"], f_mul(r0, ZZ)))
+    ok1 = (
+        _is_zero_u(f_carry_sub(acc["X"], f_mul(rn, ZZ)))
+        * wrap2.astype(jnp.int32)
+    )
+    ok = (1 - acc["inf"]) * (1 - q_inf_u) * jnp.maximum(ok0, ok1)
+    return ok, degen * (1 - q_inf_u)
+
+
+def _verify_core_w4(get_w1, get_w2, qx, qy, q_inf2, r0, rn, wrap2):
+    """Windowed ecdsa verify core: window planes are (64, *lanes) int32
+    values in 0..15, MSB-first. Lane axes are generic: (B,) for the 2D
+    kernel, (8, T) for the aligned 3D kernel. Returns (ok, degen) as
+    (1, *lanes) int32 0/1 planes — degen lanes carry garbage and MUST be
+    re-verified by the caller."""
+    lanes = qx.shape[1:]
+    shape = (N_LIMBS,) + lanes
+    one = _build_const_limbs([1], shape)
+    q_inf_u = q_inf2.astype(jnp.int32)
+    never_inf = jnp.zeros((1,) + lanes, jnp.int32)
+
+    g_tab, q_tab = _w4_tables(qx, qy, q_inf_u, one, shape)
 
     zero_v = qx * U32_0
     acc0 = {
@@ -741,38 +787,13 @@ def _verify_core_w4(get_w1, get_w2, qx, qy, q_inf2, r0, rn, wrap2):
     degen0 = jnp.zeros((1,) + lanes, jnp.int32)
 
     def wstep(i, carry):
-        acc, degen = carry
-        acc = pt_double(pt_double(pt_double(pt_double(acc))))
         w1 = get_w1(i).astype(jnp.int32)
         w2 = get_w2(i).astype(jnp.int32)
-        # G leg: mixed add from the constant affine table
-        gx_sel, gy_sel = g_tab[1]
-        for j in range(2, 16):
-            pred = w1 == j
-            gx_sel = jnp.where(pred, g_tab[j][0], gx_sel)
-            gy_sel = jnp.where(pred, g_tab[j][1], gy_sel)
-        act1 = jnp.where(w1 != 0, 1, 0)
-        added, hz = _pt_add_mixed_cheap_u(acc, gx_sel, gy_sel, never_inf, one)
-        acc = _pt_select_u(act1, added, acc)
-        degen = jnp.maximum(degen, hz * act1)
-        # Q leg: full add from the per-lane Jacobian table
-        q_sel = _tab_select_u(w2, q_tab)
-        act2 = jnp.where(w2 != 0, 1, 0) * (1 - q_inf_u)
-        added, hz = _pt_add_full_cheap_u(acc, q_sel)
-        acc = _pt_select_u(act2, added, acc)
-        degen = jnp.maximum(degen, hz * act2)
-        return acc, degen
+        return _w4_window_step(carry, w1, w2, g_tab, q_tab, q_inf_u, one,
+                               never_inf)
 
     acc, degen = jax.lax.fori_loop(0, 64, wstep, (acc0, degen0))
-
-    ZZ = f_sqr(acc["Z"])
-    ok0 = _is_zero_u(f_carry_sub(acc["X"], f_mul(r0, ZZ)))
-    ok1 = (
-        _is_zero_u(f_carry_sub(acc["X"], f_mul(rn, ZZ)))
-        * wrap2.astype(jnp.int32)
-    )
-    ok = (1 - acc["inf"]) * (1 - q_inf_u) * jnp.maximum(ok0, ok1)
-    return ok, degen * (1 - q_inf_u)
+    return _verify_final(acc, degen, q_inf_u, r0, rn, wrap2)
 
 
 def _verify_kernel_w4(u1w_ref, u2w_ref, qx_ref, qy_ref, qinf_ref, r0_ref,
@@ -837,6 +858,31 @@ def _verify_kernel_w4_3d(u1w_ref, u2w_ref, qx_ref, qy_ref, qinf_ref, r0_ref,
     )
 
 
+def _expand_nibble_windows(m):
+    """Device-side scalar expansion: (B, nb) uint8 big-endian bytes ->
+    (2*nb, B) int32 MSB-first 4-bit windows. Shared by the w4 and GLV
+    byte programs — the nibble order must never drift between them."""
+    hi = (m >> 4).astype(jnp.int32)
+    lo = (m & 0xF).astype(jnp.int32)
+    return jnp.stack([hi, lo], axis=2).reshape(m.shape[0], -1).T
+
+
+def _expand_limb_cols(m):
+    """Device-side field expansion: (B, 32) uint8 big-endian values ->
+    (20, B) uint32 13-bit limb columns (the jnp twin of the host-side
+    _limb_rows — per-byte MSB-first bits, whole-value LSB reversal,
+    13-bit regroup). Shared by the w4 and GLV byte programs."""
+    B = m.shape[0]
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = (m[:, :, None] >> shifts) & jnp.uint8(1)  # (B, 32, 8)
+    bits = bits.reshape(B, 256)[:, ::-1]  # LSB-first over the value
+    bits = jnp.concatenate(
+        [bits, jnp.zeros((B, 13 * N_LIMBS - 256), m.dtype)], axis=1
+    )
+    w13 = (jnp.uint32(1) << jnp.arange(13, dtype=jnp.uint32))
+    return (bits.reshape(B, N_LIMBS, 13).astype(jnp.uint32) * w13).sum(2).T
+
+
 @partial(jax.jit, static_argnames=("interpret",))
 def _w4_bytes_program(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8,
                       interpret: bool = False):
@@ -854,21 +900,10 @@ def _w4_bytes_program(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8,
     T = B // 8
 
     def windows(m):  # (B, 32) u8 -> (64, 8, T) i32, MSB-first nibbles
-        hi = (m >> 4).astype(jnp.int32)
-        lo = (m & 0xF).astype(jnp.int32)
-        w = jnp.stack([hi, lo], axis=2).reshape(B, 64)
-        return w.T.reshape(64, 8, T)
+        return _expand_nibble_windows(m).reshape(64, 8, T)
 
     def limbs(m):  # (B, 32) u8 big-endian -> (20, 8, T) u32 13-bit limbs
-        shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
-        bits = (m[:, :, None] >> shifts) & jnp.uint8(1)  # (B, 32, 8)
-        bits = bits.reshape(B, 256)[:, ::-1]  # LSB-first over the value
-        bits = jnp.concatenate(
-            [bits, jnp.zeros((B, 13 * N_LIMBS - 256), m.dtype)], axis=1
-        )
-        w13 = (jnp.uint32(1) << jnp.arange(13, dtype=jnp.uint32))
-        lb = (bits.reshape(B, N_LIMBS, 13).astype(jnp.uint32) * w13).sum(2)
-        return lb.T.reshape(N_LIMBS, 8, T)
+        return _expand_limb_cols(m).reshape(N_LIMBS, 8, T)
 
     q2 = qinf8.astype(jnp.uint32).reshape(1, 8, T)
     w2 = wrap8.astype(jnp.uint32).reshape(1, 8, T)
@@ -950,3 +985,359 @@ def ecdsa_verify_batch_pallas_w4(u1w, u2w, qx, qy, q_inf, r0, rn, wrap_ok):
         )[0:2])
     out = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces, axis=1)
     return out[0].astype(bool), out[1].astype(bool)
+
+
+# ---- GLV endomorphism verify kernel (round 6) ------------------------------
+#
+# secp256k1 admits the efficient endomorphism φ(x, y) = (βx, y) = λ·(x, y)
+# (β³ = 1 mod p, λ³ = 1 mod n — the GLV construction, and the same split
+# libsecp256k1 ships in secp256k1_scalar_split_lambda). Each verify scalar
+# decomposes as k = k1 + λ·k2 (mod n) with |k1|, |k2| < 2^128 via lattice
+# rounding against the basis (a1, b1), (a2, b2) — done on the HOST in the
+# packer with exact Python ints (ops/ecdsa_batch.pack_records_glv), signs
+# folded into table/comb selection. The joint ladder then runs 32 4-bit
+# windows / 128 doublings over FOUR addition streams (Q, λQ, G, λG)
+# instead of the w4 kernel's 64 windows / 256 doublings over two:
+#
+#   u1·G + u2·Q = s11·(±G) + s12·(±λG) + s21·(±Q) + s22·(±λQ)
+#
+# The λQ table is free given the Q table (X → βX per entry, Y negated when
+# the two Q-stream signs differ), and the G streams leave the doubling
+# chain entirely: they are settled by a FIXED-BASE COMB — a process-global
+# table of d·256^i·G (and its φ/negation images) built once per process
+# (see _glv_comb) — as 32 order-free mixed adds after the ladder, 8-bit
+# digits, zero doublings. Verification-side GLV is safe: every scalar here
+# is public (u1, u2 derive from the signature and message), so no
+# constant-time discipline is required — lane-varying table gathers leak
+# nothing an observer does not already have.
+#
+# This core is plain XLA (jnp + gather), not Pallas: the comb tables are
+# captured numpy constants, which Mosaic forbids and in-kernel synthesis
+# cannot afford at 16×512 entries (the w4 Pallas kernels remain the
+# Mosaic-tuned path and the dispatch fallback; `-ecdsakernel=w4` forces
+# them). Completeness contract is identical to w4: the cheap adds flag
+# H == 0 collisions (degen plane) and the host re-verifies flagged lanes.
+
+LAMBDA = 0x5363AD4CC05C30E0A5261C028812645A122E22EA20816678DF02967C1B23BD72
+BETA = 0x7AE96A2B657C07106E64479EAC3434E99CF0497512F58995C1396C28719501EE
+# lattice basis for the split (libsecp256k1 scalar_impl.h): a1 + b1·λ ==
+# a2 + b2·λ == 0 (mod n); |k1|, |k2| stay below 2^128 for any k in [0, n)
+# (proven bound ~2^127.7 — asserted by the unit suite over boundary cases)
+_GLV_A1 = 0x3086D221A7D46BCDE86C90E49284EB15
+_GLV_MINUS_B1 = 0xE4437ED6010E88286F547FA90ABFE4C3
+_GLV_A2 = 0x114CA50F7A8E2F3F657C1108D9D44CFD8
+_GLV_B2 = 0x3086D221A7D46BCDE86C90E49284EB15
+
+GLV_WINDOWS = 32     # 4-bit windows over |k1|, |k2| < 2^128
+GLV_COMB_TEETH = 16  # 8-bit fixed-base comb digits over |s| < 2^128
+
+_BETA_CONST = _const(BETA)
+
+
+def _round_div(a: int, b: int) -> int:
+    """round(a / b) for b > 0, exact (ties round up, matching the
+    reference's rounded-division split)."""
+    q, r = divmod(a, b)
+    return q + (1 if 2 * r >= b else 0)
+
+
+def glv_split(k: int) -> tuple[int, int]:
+    """k (mod n) -> signed (k1, k2) with k == k1 + λ·k2 (mod n) and
+    |k1|, |k2| < 2^128. Exact lattice rounding — no precision games."""
+    k %= N
+    c1 = _round_div(_GLV_B2 * k, N)
+    c2 = _round_div(_GLV_MINUS_B1 * k, N)
+    k1 = k - c1 * _GLV_A1 - c2 * _GLV_A2
+    k2 = c1 * _GLV_MINUS_B1 - c2 * _GLV_B2
+    return k1, k2
+
+
+def glv_decompose(k: int) -> tuple[int, int, int, int]:
+    """glv_split with the signs folded out: (|k1|, neg1, |k2|, neg2),
+    neg in {0, 1}. The packer ships magnitudes; signs select negated
+    table/comb entries on device."""
+    k1, k2 = glv_split(k)
+    n1, n2 = int(k1 < 0), int(k2 < 0)
+    s1, s2 = abs(k1), abs(k2)
+    assert s1 < (1 << 128) and s2 < (1 << 128), (k, k1, k2)
+    return s1, n1, s2, n2
+
+
+# ---- process-global fixed-base comb for G / λG -----------------------------
+
+_GLV_COMB = None
+GLV_TABLE_BUILD_S = 0.0  # host build wall time, surfaced via gettpuinfo
+
+
+def _limb_rows(vals: list[int]) -> np.ndarray:
+    """ints -> (len, 20) uint32 13-bit limb rows (vectorized; the
+    per-value to_limbs_np loop would cost seconds at comb scale)."""
+    n = len(vals)
+    blob = b"".join(v.to_bytes(32, "big") for v in vals)
+    mat = np.frombuffer(blob, np.uint8).reshape(n, 32)
+    bits = np.unpackbits(mat, axis=1)[:, ::-1]
+    bits = np.concatenate(
+        [bits, np.zeros((n, 13 * N_LIMBS - 256), np.uint8)], axis=1
+    )
+    return (
+        bits.reshape(n, N_LIMBS, 13).astype(np.uint32) * _GLV_LIMB_W
+    ).sum(axis=2)
+
+
+_GLV_LIMB_W = (1 << np.arange(13, dtype=np.uint32))
+
+
+def _glv_comb() -> tuple:
+    """The fixed-base comb: numpy tables (GLV_COMB_TEETH, 512, 20) uint32
+
+        gx[i, s·256 + d] = x(d · 256^i · G)
+        gy[i, s·256 + d] = y(...) for s = 0, p − y(...) for s = 1
+        lx[i, s·256 + d] = β · x(...)  (the λG stream; φ leaves y alone,
+                                        so the λ stream reuses gy)
+
+    d = 0 slots hold the d = 1 point (callers mask the add out). Built
+    ONCE per process from Python-int affine arithmetic and cached — the
+    u1·G streams stop paying any per-batch (or per-trace) table
+    construction; the arrays are captured as XLA constants per compiled
+    shape. ~4k point_adds, a few hundred ms, timed into
+    GLV_TABLE_BUILD_S for gettpuinfo."""
+    global _GLV_COMB, GLV_TABLE_BUILD_S
+    if _GLV_COMB is not None:
+        return _GLV_COMB
+    from ..crypto.secp256k1 import G, point_add, point_double
+
+    t0 = time.monotonic()
+    base = G
+    xs, ys = [], []
+    for _i in range(GLV_COMB_TEETH):
+        row_x, row_y = [], []
+        cur = None
+        for _d in range(1, 256):
+            cur = point_add(cur, base)
+            row_x.append(cur[0])
+            row_y.append(cur[1])
+        xs.append(row_x)
+        ys.append(row_y)
+        for _ in range(8):
+            base = point_double(base)
+    # flatten -> limb rows -> (teeth, 512, 20); entry 0/256 = d=1 dummy
+    flat_x = [row[0] for row in xs] + [v for row in xs for v in row]
+    flat_y = [row[0] for row in ys] + [v for row in ys for v in row]
+    lim_x = _limb_rows(flat_x)
+    lim_y = _limb_rows(flat_y)
+    lim_lx = _limb_rows([v * BETA % P for v in flat_x])
+    lim_ny = _limb_rows([P - v for v in flat_y])
+    T = GLV_COMB_TEETH
+    gx = np.zeros((T, 512, N_LIMBS), np.uint32)
+    gy = np.zeros((T, 512, N_LIMBS), np.uint32)
+    lx = np.zeros((T, 512, N_LIMBS), np.uint32)
+    dummies_x, rows_x = lim_x[:T], lim_x[T:].reshape(T, 255, N_LIMBS)
+    dummies_y, rows_y = lim_y[:T], lim_y[T:].reshape(T, 255, N_LIMBS)
+    dummies_lx, rows_lx = lim_lx[:T], lim_lx[T:].reshape(T, 255, N_LIMBS)
+    dummies_ny, rows_ny = lim_ny[:T], lim_ny[T:].reshape(T, 255, N_LIMBS)
+    for i in range(T):
+        gx[i, 0] = gx[i, 256] = dummies_x[i]
+        gx[i, 1:256] = gx[i, 257:512] = rows_x[i]
+        lx[i, 0] = lx[i, 256] = dummies_lx[i]
+        lx[i, 1:256] = lx[i, 257:512] = rows_lx[i]
+        gy[i, 0] = dummies_y[i]
+        gy[i, 1:256] = rows_y[i]
+        gy[i, 256] = dummies_ny[i]
+        gy[i, 257:512] = rows_ny[i]
+    GLV_TABLE_BUILD_S = time.monotonic() - t0
+    _GLV_COMB = (gx, gy, lx)
+    return _GLV_COMB
+
+
+def _f_neg(y):
+    """-y mod p for weak y: (0 + 2p − y) via the redistributed bias, then
+    carry — weak output."""
+    return f_carry(_BIAS_2P - y)
+
+
+def _glv_q_tables(qx, qy, ydiff_u, q_inf_u, one):
+    """Per-lane Q-stream tables, stacked for gather. Returns two
+    (X, Y, Z) tuples of (16, 20, B) arrays: T1[j] = j·Q' (Q' is Q with
+    the first Q-stream sign already folded into qy by the packer) and
+    T2[j] = j·(±φ(Q')) — the λQ stream, derived from T1 by the
+    endomorphism (X → βX; Y negated where ydiff_u says the two Q-stream
+    signs differ). Entry 0 is a dummy (= entry 1, callers mask)."""
+    shape = qx.shape
+    q_jac = {
+        "X": jnp.broadcast_to(qx, shape).astype(jnp.uint32),
+        "Y": jnp.broadcast_to(qy, shape).astype(jnp.uint32),
+        "Z": one,
+        "inf": q_inf_u,
+    }
+    tab = [q_jac, pt_double(q_jac)]
+    for _j in range(3, 16):
+        added, _hz = _pt_add_mixed_cheap_u(tab[-1], qx, qy, q_inf_u, one)
+        tab.append(added)
+    entries = [tab[0]] + tab  # dummy 0 = 1·Q'
+    t1 = tuple(
+        jnp.stack([e[c] for e in entries], axis=0) for c in ("X", "Y", "Z")
+    )
+    beta = jnp.asarray(
+        np.broadcast_to(_BETA_CONST, shape)
+    ).astype(jnp.uint32)
+    diff = ydiff_u != 0
+    lam_entries = [
+        (f_mul(beta, e["X"]), jnp.where(diff, _f_neg(e["Y"]), e["Y"]),
+         e["Z"])
+        for e in entries
+    ]
+    t2 = tuple(
+        jnp.stack([e[c] for e in lam_entries], axis=0) for c in range(3)
+    )
+    return t1, t2
+
+
+def _glv_tab_gather(t, w):
+    """Gather one Jacobian entry per lane from a stacked (16, 20, B)
+    table: w is the (1, B) int32 window value (0..15). One gather per
+    coordinate — the XLA core's cheaper analogue of the w4 kernel's
+    15-way select chain."""
+    idx = jnp.broadcast_to(w[:, None, :], (1,) + t[0].shape[1:]).astype(
+        jnp.int32
+    )
+    return tuple(jnp.take_along_axis(c, idx, axis=0)[0] for c in t)
+
+
+def _glv_window_step(carry, w1, w2, t1, t2, q_inf_u):
+    """One GLV ladder window: 4 doublings + full adds from the Q and λQ
+    tables. w1/w2: (1, B) int32 values in 0..15."""
+    acc, degen = carry
+    acc = pt_double(pt_double(pt_double(pt_double(acc))))
+    for t, w in ((t1, w1), (t2, w2)):
+        x, y, z = _glv_tab_gather(t, w)
+        q_sel = {"X": x, "Y": y, "Z": z, "inf": q_inf_u}
+        act = jnp.where(w != 0, 1, 0) * (1 - q_inf_u)
+        added, hz = _pt_add_full_cheap_u(acc, q_sel)
+        acc = _pt_select_u(act, added, acc)
+        degen = jnp.maximum(degen, hz * act)
+    return acc, degen
+
+
+def _glv_comb_step(carry, drow, sgrow, tab_x, tab_y, one, never_inf):
+    """One fixed-base comb tooth for one G stream: a mixed add of the
+    gathered affine constant. drow: (B,) int32 digit (0..255, 0 = skip);
+    sgrow: (B,) int32 sign·256 offset; tab_x/tab_y: (512, 20) constant
+    tables for this tooth position."""
+    acc, degen = carry
+    idx = sgrow + drow
+    gx_sel = jnp.take(tab_x, idx, axis=0).T
+    gy_sel = jnp.take(tab_y, idx, axis=0).T
+    act = jnp.where(drow != 0, 1, 0)[None, :]
+    added, hz = _pt_add_mixed_cheap_u(acc, gx_sel, gy_sel, never_inf, one)
+    acc = _pt_select_u(act, added, acc)
+    degen = jnp.maximum(degen, hz * act)
+    return acc, degen
+
+
+def _verify_core_glv(w1, w2, d1, sg1, d2, sg2, qx, qy, ydiff2, q_inf2,
+                     r0, rn, wrap2):
+    """GLV verify core (flat (B,) lanes, plain XLA).
+
+    w1/w2: (32, B) int32 MSB-first 4-bit windows of |s21|, |s22| (the Q
+    and λQ streams). d1/d2: (16, B) int32 8-bit comb digits of |s11|,
+    |s12| (position i = weight 256^i). sg1/sg2: (B,) int32 G-stream sign
+    flags (0/1). qx/qy: (20, B) weak limbs, qy with the first Q-stream
+    sign folded. ydiff2/q_inf2/wrap2: (1, B) masks. Returns (ok, degen)
+    (1, B) int32 planes; degen lanes MUST be re-verified by the caller."""
+    B = qx.shape[1]
+    one = jnp.broadcast_to(_ONE_CONST, (N_LIMBS, B)).astype(jnp.uint32)
+    q_inf_u = q_inf2.astype(jnp.int32)
+    ydiff_u = ydiff2.astype(jnp.int32)
+    never_inf = jnp.zeros((1, B), jnp.int32)
+
+    t1, t2 = _glv_q_tables(qx, qy, ydiff_u, q_inf_u, one)
+    gx_tab, gy_tab, lx_tab = (jnp.asarray(c) for c in _glv_comb())
+
+    # plain-XLA core: no Mosaic/shard_map varying-init gymnastics needed
+    # (cf. the w4 core's derived-from-input accumulator init)
+    zero_v = qx * U32_0
+    acc0 = {
+        "X": zero_v + one,
+        "Y": zero_v + one,
+        "Z": zero_v,
+        "inf": jnp.ones((1, B), jnp.int32),
+    }
+    degen0 = jnp.zeros((1, B), jnp.int32)
+
+    def wstep(i, carry):
+        wr1 = jax.lax.dynamic_index_in_dim(w1, i, 0, keepdims=True)
+        wr2 = jax.lax.dynamic_index_in_dim(w2, i, 0, keepdims=True)
+        return _glv_window_step(carry, wr1.astype(jnp.int32),
+                                wr2.astype(jnp.int32), t1, t2, q_inf_u)
+
+    carry = jax.lax.fori_loop(0, GLV_WINDOWS, wstep, (acc0, degen0))
+
+    sg1o = sg1.astype(jnp.int32) * 256
+    sg2o = sg2.astype(jnp.int32) * 256
+
+    def cstep(i, carry):
+        # G stream from the G comb, λG stream from the β-mapped comb
+        # (φ leaves y untouched, so both streams share gy)
+        dr1 = jax.lax.dynamic_index_in_dim(d1, i, 0, keepdims=False)
+        tx = jax.lax.dynamic_index_in_dim(gx_tab, i, 0, keepdims=False)
+        ty = jax.lax.dynamic_index_in_dim(gy_tab, i, 0, keepdims=False)
+        carry = _glv_comb_step(carry, dr1.astype(jnp.int32), sg1o, tx, ty,
+                               one, never_inf)
+        dr2 = jax.lax.dynamic_index_in_dim(d2, i, 0, keepdims=False)
+        tlx = jax.lax.dynamic_index_in_dim(lx_tab, i, 0, keepdims=False)
+        return _glv_comb_step(carry, dr2.astype(jnp.int32), sg2o, tlx, ty,
+                              one, never_inf)
+
+    acc, degen = jax.lax.fori_loop(0, GLV_COMB_TEETH, cstep, carry)
+    return _verify_final(acc, degen, q_inf_u, r0, rn, wrap2)
+
+
+@jax.jit
+def _glv_program(d1m, d2m, sg1v, sg2v, s1m, s2m, ydiff8, qxb, qyb, qinf8,
+                 r0b, rnb, wrap8):
+    """The GLV pipeline, ONE dispatch end-to-end: byte-matrix inputs
+    (16-byte scalar halves, 32-byte field elements), device-side
+    expansion to window/digit planes and 13-bit limbs, then the GLV core.
+    Returns (2, B) uint32: row 0 ok, row 1 degenerate."""
+    B = qxb.shape[0]
+    nib_windows = _expand_nibble_windows  # (B, 16) -> (32, B)
+    limbs = _expand_limb_cols             # (B, 32) -> (20, B)
+
+    ok, degen = _verify_core_glv(
+        nib_windows(s1m), nib_windows(s2m),
+        d1m.astype(jnp.int32).T, sg1v.astype(jnp.int32),
+        d2m.astype(jnp.int32).T, sg2v.astype(jnp.int32),
+        limbs(qxb), limbs(qyb),
+        ydiff8.astype(jnp.uint32).reshape(1, B),
+        qinf8.astype(jnp.uint32).reshape(1, B),
+        limbs(r0b), limbs(rnb),
+        wrap8.astype(jnp.uint32).reshape(1, B),
+    )
+    return jnp.concatenate(
+        [ok.astype(jnp.uint32), degen.astype(jnp.uint32)], axis=0
+    )
+
+
+def ecdsa_verify_batch_glv(d1m, d2m, sg1v, sg2v, s1m, s2m, ydiff8, qxb,
+                           qyb, qinf8, r0b, rnb, wrap8):
+    """Byte-matrix GLV verify (see _glv_program). Batches beyond 16384
+    lanes split into 16384-lane program calls so compiled shapes stay the
+    same bounded bucket set as the w4 pipeline. Returns (ok, degen) bool
+    (B,) arrays — device futures until materialized."""
+    B = qxb.shape[0]
+    SPLIT = 16384
+    if B <= SPLIT:
+        out = _glv_program(d1m, d2m, sg1v, sg2v, s1m, s2m, ydiff8, qxb,
+                           qyb, qinf8, r0b, rnb, wrap8)
+        return out[0].astype(bool), out[1].astype(bool)
+    oks, dgs = [], []
+    for s in range(0, B, SPLIT):
+        sl = slice(s, s + SPLIT)
+        out = _glv_program(d1m[sl], d2m[sl], sg1v[sl], sg2v[sl], s1m[sl],
+                           s2m[sl], ydiff8[sl], qxb[sl], qyb[sl],
+                           qinf8[sl], r0b[sl], rnb[sl], wrap8[sl])
+        n = min(SPLIT, B - s)
+        oks.append(out[0].reshape(n))
+        dgs.append(out[1].reshape(n))
+    return (jnp.concatenate(oks).astype(bool),
+            jnp.concatenate(dgs).astype(bool))

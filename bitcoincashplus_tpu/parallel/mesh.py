@@ -53,3 +53,21 @@ def chip_mesh(n: int | None = None) -> Mesh:
             raise ValueError(f"requested {n} devices, have {len(devs)}")
         devs = devs[:n]
     return Mesh(np.array(devs), (CHIP_AXIS,))
+
+
+def shard_map_nocheck(body, mesh: Mesh, in_specs, out_specs):
+    """shard_map with the replication/VMA check disabled, portable across
+    jax versions: the kwarg is ``check_vma`` on current jax and
+    ``check_rep`` before the rename — and the check must be off either
+    way (pallas_call's out_shape carries no varying-mesh-axes annotation,
+    and older jax has no replication rule for while_loop at all)."""
+    import inspect
+
+    try:
+        from jax import shard_map as _sm
+    except ImportError:  # pre-0.6 jax ships it under experimental only
+        from jax.experimental.shard_map import shard_map as _sm
+    kwargs = {"mesh": mesh, "in_specs": in_specs, "out_specs": out_specs}
+    params = inspect.signature(_sm).parameters
+    kwargs["check_vma" if "check_vma" in params else "check_rep"] = False
+    return _sm(body, **kwargs)

@@ -17,13 +17,12 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..crypto.hashes import header_midstate
 from ..ops.miner import DEFAULT_TILE, _sweep_tile
 from ..ops.sha256 import bytes_to_words_np, target_to_limbs_np
-from .mesh import CHIP_AXIS, chip_mesh, local_devices
+from .mesh import CHIP_AXIS, chip_mesh, local_devices, shard_map_nocheck
 
 
 def _shard_body(midstate, tail, target_limbs, start_nonce, n_tiles, tile: int):
@@ -34,7 +33,10 @@ def _shard_body(midstate, tail, target_limbs, start_nonce, n_tiles, tile: int):
     (deterministic winner regardless of which chip finds one first).
     """
     chip = jax.lax.axis_index(CHIP_AXIS).astype(jnp.uint32)
-    n_chips = jnp.uint32(jax.lax.axis_size(CHIP_AXIS))
+    if hasattr(jax.lax, "axis_size"):
+        n_chips = jnp.uint32(jax.lax.axis_size(CHIP_AXIS))
+    else:  # pre-0.6 jax: count the axis with an all-ones psum
+        n_chips = jax.lax.psum(jnp.uint32(1), CHIP_AXIS)
     stripe = start_nonce + chip * n_tiles * np.uint32(tile)
 
     mid8 = [midstate[i] for i in range(8)]
@@ -75,9 +77,9 @@ def _shard_body(midstate, tail, target_limbs, start_nonce, n_tiles, tile: int):
 def _sharded_sweep_jit(midstate, tail, target_limbs, start_nonce, n_tiles,
                        tile: int, n_chips: int):
     mesh = chip_mesh(n_chips)
-    fn = shard_map(
+    fn = shard_map_nocheck(
         partial(_shard_body, tile=tile),
-        mesh=mesh,
+        mesh,
         in_specs=(P(), P(), P(), P(), P()),
         out_specs=(P(), P(), P(), P(CHIP_AXIS)),
     )

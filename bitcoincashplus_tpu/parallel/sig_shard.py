@@ -14,6 +14,11 @@ shards.
 On CPU meshes (the virtual-8 dryrun/bench — no Mosaic backend) the same
 kernel runs in pallas interpret mode, so the sharded program is the real
 w4 pipeline everywhere, not a stand-in ladder (VERDICT r4 #3/weak-3).
+
+The GLV kernel (ops/secp256k1._glv_program, -ecdsakernel=glv, the
+default) shards the same way via _sharded_glv_jit — plain XLA end to
+end, so no interpret split: the fixed-base comb constants replicate per
+chip and the split-scalar byte matrices shard on the batch axis.
 """
 
 from __future__ import annotations
@@ -23,11 +28,10 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ..ops.secp256k1 import _w4_bytes_program
-from .mesh import CHIP_AXIS, chip_mesh
+from .mesh import CHIP_AXIS, chip_mesh, shard_map_nocheck
 
 # per-chip lane granularity: the w4 bytes program reshapes its local batch
 # to (8, T) vregs with T a multiple of 128
@@ -40,6 +44,42 @@ def _use_interpret(n_chips: int) -> bool:
     the virtual mesh is still CPU (tests/conftest.py documents the same
     trap), and Mosaic-vs-interpret must follow where the kernel RUNS."""
     return chip_mesh(n_chips).devices.flat[0].platform == "cpu"
+
+
+@partial(jax.jit, static_argnames=("n_chips",))
+def _sharded_glv_jit(d1m, d2m, sg1, sg2, s1m, s2m, ydiff8, qxb, qyb,
+                     qinf8, r0b, rnb, wrap8, n_chips: int):
+    """GLV analogue of _sharded_w4_jit: the plain-XLA GLV program
+    (ops/secp256k1._glv_program) sharded on the batch axis — no
+    interpret-mode split needed because the GLV core never enters Mosaic
+    (its fixed-base comb rides as captured XLA constants, replicated per
+    chip by the partitioner)."""
+    from ..ops.secp256k1 import _glv_program
+
+    mesh = chip_mesh(n_chips)
+    row = P(CHIP_AXIS)
+
+    def body(d1m, d2m, sg1, sg2, s1m, s2m, ydiff8, qxb, qyb, qinf8, r0b,
+             rnb, wrap8):
+        out = _glv_program(d1m, d2m, sg1, sg2, s1m, s2m, ydiff8, qxb, qyb,
+                           qinf8, r0b, rnb, wrap8)
+        b_local = qxb.shape[0]
+        ok = out[0].reshape(b_local).astype(bool)
+        degen = out[1].reshape(b_local).astype(bool)
+        fails = jax.lax.psum(
+            jnp.sum(((~ok | degen) & (qinf8 == 0)).astype(jnp.uint32)),
+            CHIP_AXIS,
+        )
+        return ok, degen, fails
+
+    fn = shard_map_nocheck(
+        body,
+        mesh,
+        in_specs=(row,) * 13,
+        out_specs=(P(CHIP_AXIS), P(CHIP_AXIS), P()),
+    )
+    return fn(d1m, d2m, sg1, sg2, s1m, s2m, ydiff8, qxb, qyb, qinf8, r0b,
+              rnb, wrap8)
 
 
 @partial(jax.jit, static_argnames=("n_chips", "interpret"))
@@ -63,24 +103,30 @@ def _sharded_w4_jit(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8,
         )
         return ok, degen, fails
 
-    fn = shard_map(
+    fn = shard_map_nocheck(
         body,
-        mesh=mesh,
+        mesh,
         in_specs=(row,) * 8,
         out_specs=(P(CHIP_AXIS), P(CHIP_AXIS), P()),
         # pallas_call's out_shape carries no varying-mesh-axes annotation;
-        # the specs above state the sharding explicitly
-        check_vma=False,
+        # the specs state the sharding explicitly (check disabled)
     )
     return fn(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8)
 
 
-def verify_batch_sharded(records, n_chips: int) -> np.ndarray:
+def verify_batch_sharded(records, n_chips: int,
+                         kernel: str | None = None) -> np.ndarray:
     """Shard a record batch across the mesh; returns (len(records),) bool.
     Pads B up to n_chips * 1024-lane shards with poisoned lanes; degenerate
     lanes (H == 0 collisions) re-verify on the host scalar path exactly
-    like the single-chip dispatch (ops/ecdsa_batch.BatchHandle)."""
-    from ..ops.ecdsa_batch import _verify_cpu, pack_records_w4_bytes
+    like the single-chip dispatch (ops/ecdsa_batch.BatchHandle). ``kernel``
+    overrides the -ecdsakernel selection for this call (None = active)."""
+    from ..ops import ecdsa_batch
+    from ..ops.ecdsa_batch import (
+        _verify_cpu,
+        pack_records_glv,
+        pack_records_w4_bytes,
+    )
 
     n = len(records)
     per_chip = max(
@@ -89,11 +135,19 @@ def verify_batch_sharded(records, n_chips: int) -> np.ndarray:
         // _CHIP_BUCKET * _CHIP_BUCKET,
     )
     bucket = per_chip * n_chips
-    arrays = pack_records_w4_bytes(records, bucket)
-    ok, degen, _fails = jax.block_until_ready(
-        _sharded_w4_jit(*map(np.asarray, arrays), n_chips=n_chips,
-                        interpret=_use_interpret(n_chips))
-    )
+    kern = kernel if kernel in ecdsa_batch.ECDSA_KERNELS \
+        else ecdsa_batch.active_kernel()
+    if kern == "glv" and ecdsa_batch.glv_enabled():
+        arrays = pack_records_glv(records, bucket)
+        ok, degen, _fails = jax.block_until_ready(
+            _sharded_glv_jit(*map(np.asarray, arrays), n_chips=n_chips)
+        )
+    else:
+        arrays = pack_records_w4_bytes(records, bucket)
+        ok, degen, _fails = jax.block_until_ready(
+            _sharded_w4_jit(*map(np.asarray, arrays), n_chips=n_chips,
+                            interpret=_use_interpret(n_chips))
+        )
     out = np.asarray(ok)[:n].copy()
     degen = np.asarray(degen)[:n]
     idxs = np.nonzero(degen)[0]
@@ -124,6 +178,9 @@ def dryrun(n_devices: int) -> None:
             e ^= 1  # corrupt: lane must report False
         recs.append(SigCheckRecord(pub, r, s, e))
         expected.append(oracle.ecdsa_verify(pub, r, s, e))
+    from ..ops.ecdsa_batch import active_kernel
+
     got = verify_batch_sharded(recs, n_devices)
     assert got.tolist() == expected, (got.tolist(), expected)
-    print(f"sig_shard dryrun: {n_devices}-chip sharded w4 sig batch OK")
+    print(f"sig_shard dryrun: {n_devices}-chip sharded "
+          f"{active_kernel()} sig batch OK")

@@ -118,6 +118,10 @@ def gettpuinfo(node, params):
     return {
         "backend": node.backend,
         "devices": devices,
+        # active verify-kernel selection (-ecdsakernel) + GLV health: the
+        # fixed-base comb build cost (0.0 until the first GLV dispatch
+        # builds it), host decompose/pack stage times, fallback tallies
+        "ecdsa": ecdsa_batch.kernel_info(),
         "batch": stats,
         "breakers": dispatch.snapshot(),
         "faults": faults.INJECTOR.snapshot(),

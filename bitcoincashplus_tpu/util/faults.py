@@ -50,6 +50,10 @@ SITES = ("sha256", "merkle", "miner", "ecdsa")
 # The P2P message-dispatch injection site (explicit opt-in only — never
 # part of the "all" set, see module docstring).
 NET_SITE = "net"
+# "ecdsa_glv" (ops/ecdsa_batch.GLV_SITE) is likewise explicit-only: it
+# targets the GLV kernel LEG inside the ecdsa dispatch so drills can prove
+# the glv -> w4 -> CPU degradation chain without disturbing the
+# whole-subsystem "ecdsa" site the dead-backend suite arms via "all".
 
 
 class InjectedFault(RuntimeError):

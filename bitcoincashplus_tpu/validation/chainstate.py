@@ -737,7 +737,8 @@ class ChainstateManager:
             from ..ops.ecdsa_batch import LanePacker
 
             self._packer = LanePacker(
-                backend=getattr(self.script_verifier, "backend", "auto"))
+                backend=getattr(self.script_verifier, "backend", "auto"),
+                kernel=getattr(self.script_verifier, "kernel", None))
         return self._packer
 
     def process_new_block_pipelined(self, block: CBlock) -> bool:

@@ -247,9 +247,12 @@ class BlockScriptVerifier:
 
     def __init__(self, params: ChainParams, backend: str = "auto",
                  sigcache: Optional[SignatureCache] = None,
-                 chunk: int = 4094):
+                 chunk: int = 4094, kernel: Optional[str] = None):
         self.params = params
         self.backend = backend
+        # -ecdsakernel wiring (no semantic change — the dispatch layer owns
+        # kernel selection/fallback; None defers to the process default)
+        self.kernel = kernel
         self.sigcache = sigcache if sigcache is not None else SignatureCache()
         # P3 pipeline overlap (SURVEY.md §3.2): once this many deferred
         # records accumulate, dispatch them to the chip WITHOUT waiting and
@@ -312,7 +315,7 @@ class BlockScriptVerifier:
                 else:
                     try:
                         handle = ecdsa_batch.dispatch_batch(
-                            batch, backend=self.backend
+                            batch, backend=self.backend, kernel=self.kernel
                         )
                     except (KeyboardInterrupt, SystemExit,
                             NameError, AttributeError, UnboundLocalError):

@@ -51,8 +51,9 @@ def pytest_collection_modifyitems(config, items):
     the first thing a timeout cuts, never the established coverage.
     The ``pipeline`` suite (pipelined-IBD differentials/unwind, tier-1,
     JAX_PLATFORMS=cpu) runs after the plain unit suite and before the
-    functional/adversarial groups. Stable sort: order within each group
-    is unchanged."""
+    functional/adversarial groups; the ``glv`` kernel suite is plain-unit
+    (group 0) on purpose — fast, ordered with the unit run. Stable sort:
+    order within each group is unchanged."""
 
     def group(item) -> int:
         if "functional" not in str(item.fspath):

@@ -68,6 +68,9 @@ def fake_ecdsa_kernel(monkeypatch):
     from bitcoincashplus_tpu.crypto import secp256k1 as oracle
 
     monkeypatch.setenv("BCP_SECP_PALLAS", "0")
+    # pin the w4/XLA kernel so a half-open probe hits this stub, not the
+    # real GLV program (which would pay a real kernel compile here)
+    monkeypatch.setenv("BCP_ECDSA_KERNEL", "w4")
     state: dict = {"mask": []}
     real_pack = ecdsa_batch.pack_records
 

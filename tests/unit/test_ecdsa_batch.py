@@ -125,7 +125,9 @@ def test_sharded_batch_differential():
     from bitcoincashplus_tpu.parallel.sig_shard import verify_batch_sharded
 
     recs, expected = make_records(16, n_bad=5)
-    ok = verify_batch_sharded(recs, 8)
+    # pin w4: this is the w4 sharded differential (the GLV sharded one
+    # lives in test_glv.py) — the default kernel would route to GLV
+    ok = verify_batch_sharded(recs, 8, kernel="w4")
     assert ok.tolist() == expected
 
 

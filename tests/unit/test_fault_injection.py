@@ -221,6 +221,10 @@ def fake_kernel(monkeypatch):
     import bitcoincashplus_tpu.ops.secp256k1 as dev
 
     monkeypatch.setenv("BCP_SECP_PALLAS", "0")
+    # pin the w4/XLA kernel: the GLV leg (default) would bypass this stub
+    # and pay a real kernel compile — the GLV drill has its own suite
+    # (tests/unit/test_glv.py)
+    monkeypatch.setenv("BCP_ECDSA_KERNEL", "w4")
     state: dict = {"mask": None}
     real_pack = ecdsa_batch.pack_records
 

@@ -28,6 +28,16 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
 
+# --ecdsa: trace-only ECDSA vector-op census (w4 vs GLV kernels) — no
+# device needed, and the accelerator plugin must not wedge a CPU-only
+# tool run, so pin the backend BEFORE jax imports. BCP_SECP_PARALLEL=1
+# traces the parallel field forms — the ops the device VPU executes —
+# rather than the CPU backend's compile-friendly scan forms.
+ECDSA_MODE = "--ecdsa" in sys.argv
+if ECDSA_MODE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["BCP_SECP_PARALLEL"] = "1"
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -156,7 +166,137 @@ def run_sweep_rate(sublanes=64, max_tiles=262144):
     return sorted(rates[1:])[len(rates[1:]) // 2]
 
 
+# ---- ECDSA vector-op census (--ecdsa) ---------------------------------------
+#
+# Counts the lane-shaped vector ops per verify for the w4 and GLV kernels
+# by tracing each kernel PHASE separately (table build, ladder window,
+# comb tooth, final check) and scaling by its trip count — the cores run
+# their windows under lax.fori_loop, whose body a plain jaxpr walk counts
+# once. Same counting convention as the SHA census: only ops whose output
+# carries the lane axis; scalar/host work is excluded.
+
+def _ecdsa_census_parts(B: int = 128):
+    import jax.numpy as jnp
+
+    from bitcoincashplus_tpu.crypto import secp256k1 as orc
+    from bitcoincashplus_tpu.ops import secp256k1 as S
+
+    rng = random.Random(9)
+
+    def limbs():
+        return jnp.asarray(
+            S.pack_batch_np([rng.randrange(orc.P) for _ in range(B)])
+        )
+
+    qx, qy, r0, rn = limbs(), limbs(), limbs(), limbs()
+    one = jnp.asarray(
+        np.broadcast_to(S.to_limbs_np(1).reshape(S.N_LIMBS, 1), (S.N_LIMBS, B))
+    ).astype(jnp.uint32)
+    q_inf_u = jnp.zeros((1, B), jnp.int32)
+    never_inf = jnp.zeros((1, B), jnp.int32)
+    wrap2 = jnp.zeros((1, B), jnp.uint32)
+    win = jnp.ones((1, B), jnp.int32) * 7
+    acc = {"X": qx, "Y": qy, "Z": qx, "inf": jnp.zeros((1, B), jnp.int32)}
+    degen = jnp.zeros((1, B), jnp.int32)
+    shape = (S.N_LIMBS, B)
+
+    def count(f, *args):
+        jaxpr = jax.make_jaxpr(f)(*args)
+        total = 0
+
+        def walk(jx):
+            nonlocal total
+            for eqn in jx.eqns:
+                for sub in eqn.params.values():
+                    if hasattr(sub, "jaxpr"):
+                        walk(sub.jaxpr)
+                shapes = [v.aval.shape for v in eqn.outvars
+                          if hasattr(v.aval, "shape")]
+                if any(s and int(np.prod(s)) >= B for s in shapes):
+                    total += 1
+
+        walk(jaxpr.jaxpr)
+        return total
+
+    # w4 phases
+    w4_tables = count(
+        lambda qx, qy: S._w4_tables(qx, qy, q_inf_u, one, shape)[1], qx, qy
+    )
+
+    def w4_step(qx, qy, acc_in):
+        g_tab, q_tab = S._w4_tables(qx, qy, q_inf_u, one, shape)
+        return S._w4_window_step((acc_in, degen), win, win, g_tab, q_tab,
+                                 q_inf_u, one, never_inf)
+
+    w4_window = count(w4_step, qx, qy, acc) - count(
+        lambda qx, qy: S._w4_tables(qx, qy, q_inf_u, one, shape), qx, qy
+    )
+    w4_final = count(
+        lambda a, r0, rn: S._verify_final(a, degen, q_inf_u, r0, rn, wrap2),
+        acc, r0, rn,
+    )
+
+    # GLV phases
+    glv_tables = count(
+        lambda qx, qy: S._glv_q_tables(qx, qy, q_inf_u * 0, q_inf_u, one),
+        qx, qy,
+    )
+
+    def glv_step(qx, qy, acc_in):
+        t1, t2 = S._glv_q_tables(qx, qy, q_inf_u * 0, q_inf_u, one)
+        return S._glv_window_step((acc_in, degen), win, win, t1, t2, q_inf_u)
+
+    glv_window = count(glv_step, qx, qy, acc) - glv_tables
+    comb = S._glv_comb()
+    tab_x = jnp.asarray(comb[0][0])
+    tab_y = jnp.asarray(comb[1][0])
+    drow = jnp.ones((B,), jnp.int32) * 9
+    sgrow = jnp.zeros((B,), jnp.int32)
+    glv_tooth = count(
+        lambda a: S._glv_comb_step((a, degen), drow, sgrow, tab_x, tab_y,
+                                   one, never_inf),
+        acc,
+    )
+    glv_final = w4_final  # shared epilogue (_verify_final)
+
+    w4_total = w4_tables + 64 * w4_window + w4_final
+    glv_total = (glv_tables + S.GLV_WINDOWS * glv_window
+                 + 2 * S.GLV_COMB_TEETH * glv_tooth + glv_final)
+    return {
+        "w4": {"tables": w4_tables, "window": w4_window, "windows": 64,
+               "final": w4_final, "total": w4_total},
+        "glv": {"tables": glv_tables, "window": glv_window,
+                "windows": S.GLV_WINDOWS, "comb_tooth": glv_tooth,
+                "comb_adds": 2 * S.GLV_COMB_TEETH, "final": glv_final,
+                "total": glv_total},
+    }
+
+
+def run_ecdsa_census():
+    parts = _ecdsa_census_parts()
+    w4, glv = parts["w4"], parts["glv"]
+    print("ECDSA verify kernels — vector ops per lane "
+          "(parallel field forms, jaxpr census)")
+    print(f"{'phase':<28}{'w4':>12}{'glv':>12}")
+    print(f"{'table build (per batch)':<28}{w4['tables']:>12,}"
+          f"{glv['tables']:>12,}")
+    print(f"{'ladder window (each)':<28}{w4['window']:>12,}"
+          f"{glv['window']:>12,}")
+    print(f"{'ladder windows':<28}{w4['windows']:>12}{glv['windows']:>12}")
+    print(f"{'comb tooth (each)':<28}{'-':>12}{glv['comb_tooth']:>12,}")
+    print(f"{'comb adds':<28}{'-':>12}{glv['comb_adds']:>12}")
+    print(f"{'final check':<28}{w4['final']:>12,}{glv['final']:>12,}")
+    print(f"{'TOTAL per verify':<28}{w4['total']:>12,}{glv['total']:>12,}")
+    red = 1.0 - glv['total'] / w4['total']
+    print(f"GLV reduction vs w4: {red * 100:.1f}% "
+          f"({'meets' if red >= 0.30 else 'MISSES'} the >=30% target)")
+    return parts
+
+
 def main():
+    if ECDSA_MODE:
+        run_ecdsa_census()
+        return
     spec_ops, full_ops, spec_detail = run_census()
     print(f"census: specialized h7 sweep = {spec_ops} vector ops/nonce")
     print(f"census: generic full-digest  = {full_ops} vector ops/nonce")
