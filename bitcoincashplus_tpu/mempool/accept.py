@@ -20,6 +20,7 @@ from typing import Optional
 from ..consensus.tx import CTransaction
 from ..consensus.tx_check import TxValidationError, check_transaction, is_final_tx
 from ..ops import ecdsa_batch
+from ..util import telemetry as tm
 from ..script.interpreter import (
     SCRIPT_ENABLE_SIGHASH_FORKID,
     STANDARD_SCRIPT_VERIFY_FLAGS,
@@ -45,6 +46,31 @@ MEMPOOL_HEIGHT = 0x7FFFFFFF
 
 # MAX_STANDARD_TX_SIGOPS (policy.h): 1/5 of the block sigop limit.
 MAX_STANDARD_TX_SIGOPS = 4000
+
+# -- telemetry (util/telemetry): the serving path's p50/p99 accept
+# latency (ROADMAP "always-on signature service" ask) — one observation
+# per AcceptToMemoryPool call, labeled by outcome. Sub-millisecond
+# buckets matter here (a cache-hit accept is ~100 µs), so the default
+# latency ladder's low end is kept.
+_ACCEPT_H = tm.histogram(
+    "bcp_mempool_accept_seconds",
+    "AcceptToMemoryPool wall-clock per transaction",
+    labels=("result",))
+_ACCEPT_REJECTS = tm.counter(
+    "bcp_mempool_reject_total",
+    "Transactions rejected at mempool admission")
+
+
+def accept_latency_quantiles() -> dict:
+    """gettpuinfo's serving-path latency view: p50/p90/p99 (ms) of
+    ACCEPTED transactions, plus accept/reject tallies."""
+    acc = _ACCEPT_H.labels(result="accepted")
+    rej = _ACCEPT_H.labels(result="rejected")
+    out = {f"{k}_ms": round(v * 1e3, 3)
+           for k, v in acc.quantiles((0.5, 0.9, 0.99)).items()}
+    out["accepted"] = acc.count
+    out["rejected"] = rej.count
+    return out
 
 
 def standard_script_flags(params, height: int) -> int:
@@ -136,7 +162,35 @@ def accept_to_memory_pool(
     ancestor_limits: Optional[dict] = None,
 ) -> MempoolEntry:
     """AcceptToMemoryPool (src/validation.cpp:~400). Returns the entry on
-    success; raises MempoolError with the reference's reject reason."""
+    success; raises MempoolError with the reference's reject reason.
+    Per-tx wall-clock lands in the bcp_mempool_accept_seconds histogram
+    (p50/p99 via gettpuinfo.telemetry.accept_latency)."""
+    t0 = _time.monotonic()
+    with tm.span("mempool.accept", txid=tx.txid_hex):
+        try:
+            entry = _accept_to_memory_pool_inner(
+                pool, chainstate, tx, sigcache, require_standard,
+                min_fee_rate, backend, now, ancestor_limits)
+        except MempoolError:
+            _ACCEPT_H.labels(result="rejected").observe(
+                _time.monotonic() - t0)
+            _ACCEPT_REJECTS.inc()
+            raise
+    _ACCEPT_H.labels(result="accepted").observe(_time.monotonic() - t0)
+    return entry
+
+
+def _accept_to_memory_pool_inner(
+    pool: CTxMemPool,
+    chainstate,
+    tx: CTransaction,
+    sigcache: Optional[SignatureCache],
+    require_standard: Optional[bool],
+    min_fee_rate: int,
+    backend: str,
+    now: Optional[int],
+    ancestor_limits: Optional[dict],
+) -> MempoolEntry:
     params = chainstate.params
     if require_standard is None:
         require_standard = params.require_standard

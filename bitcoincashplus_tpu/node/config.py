@@ -42,6 +42,16 @@ Options:
                          (0 = verify everything)
   -debug=<category>      Enable debug logging (all|net|mempool|rpc|bench|db|validation|tpu)
   -printtoconsole        Send trace/debug info to console instead of debug.log only
+  -logjson               Write debug.log records as JSON objects stamped with the
+                         active telemetry span's correlation id (default: 0)
+  -telemetry=<level>     Telemetry level: off = disabled, counters = metrics
+                         registry (getmetrics RPC + /metrics Prometheus text;
+                         default, <2% overhead), trace = counters + pipeline
+                         span tracing (dumptrace RPC / -tracefile); unknown
+                         values are rejected at startup
+  -tracefile=<path>      Dump the span trace (Chrome/perfetto JSON) to <path>
+                         at shutdown; implies -telemetry=trace (an explicit
+                         lower -telemetry level alongside it is rejected)
   -maxmempool=<n>        Max transaction memory pool size in MiB (default: 300)
   -mempoolexpiry=<n>     Do not keep transactions in mempool longer than <n> hours (default: 336)
   -minrelaytxfee=<amt>   Minimum relay fee rate in satoshis/kB (default: 1000)

@@ -27,6 +27,7 @@ from ..mining.generate import MAX_TRIES_DEFAULT, mine_block
 from ..store.blockstore import BlockStore
 from ..store.chainstatedb import BlockIndexDB, CoinsDB
 from ..store.kvstore import KVStore
+from ..util import telemetry
 from ..util.log import log_init, log_print, log_printf
 from ..validation.chain import BlockStatus
 from ..validation.chainstate import BlockValidationError, ChainstateManager
@@ -35,6 +36,10 @@ from ..validation.sigcache import SignatureCache
 from .config import Config, ConfigError
 
 DEFAULT_FLUSH_INTERVAL = 64  # blocks between periodic FlushStateToDisk calls
+
+# explicit -telemetry levels a -tracefile sink contradicts (node startup
+# rejects the combination rather than writing an empty dump)
+MODES_BELOW_TRACE = ("off", "counters")
 
 
 class InitError(Exception):
@@ -85,7 +90,28 @@ class Node:
             logfile_path=os.path.join(self.datadir, "debug.log"),
             categories=config.get_multi("debug"),
             print_to_console=config.get_bool("printtoconsole"),
+            json_mode=config.get_bool("logjson"),
         )
+        # -telemetry=<off|counters|trace> / -tracefile=<path>: resolved
+        # BEFORE any import/reindex work so startup spans are captured.
+        # Validated here — an unknown level must fail init like any other
+        # malformed flag, not degrade silently (telemetry.set_mode raises).
+        self.tracefile = config.get("tracefile") or None
+        tmode = config.get("telemetry", "")
+        if self.tracefile and tmode and tmode in MODES_BELOW_TRACE:
+            # an explicit lower level with a trace sink would silently
+            # write an empty dump — reject the contradiction instead
+            raise ConfigError(
+                f"-tracefile requires -telemetry=trace "
+                f"(got -telemetry={tmode})")
+        if self.tracefile and not tmode:
+            tmode = "trace"  # a trace sink implies span tracing
+        if tmode:
+            try:
+                telemetry.set_mode(tmode)
+            except ValueError as e:
+                raise ConfigError(str(e)) from None
+        self.telemetry_mode = telemetry.mode()
         log_printf("bcpd init: network=%s datadir=%s", self.params.network, self.datadir)
 
         # -par=<n>: thread budget for the native CPU verify fallback
@@ -226,6 +252,14 @@ class Node:
             expiry_seconds=config.get_int("mempoolexpiry", 336) * 3600,
         )
         self.min_relay_fee_rate = config.get_int("minrelaytxfee", 1000)
+        # registry collectors (util/telemetry): project this node's
+        # sigcache / pipeline / bench / mempool state into the unified
+        # metrics namespace at scrape time — the STATS-migration pattern
+        # (gettpuinfo keeps reading the same sources directly). A fresh
+        # node replaces a closed one's collectors by name.
+        telemetry.register_collector("sigcache", self._sigcache_families)
+        telemetry.register_collector("pipeline", self._pipeline_families)
+        telemetry.register_collector("mempool", self._mempool_families)
         # P2P adversarial-supervision limits (p2p/connman.py): the
         # ban-score discharge threshold, the block-download stall timeout,
         # the supervision tick cadence, the per-peer receive-rate ceiling
@@ -329,6 +363,38 @@ class Node:
             from ..mempool.persist import load_mempool
 
             load_mempool(self, self._mempool_dat)
+
+    # -- telemetry collectors (util/telemetry registry) -----------------
+
+    def _sigcache_families(self) -> list:
+        return telemetry.flat_families(
+            "bcp_sigcache", self.sigcache.snapshot(), typ="gauge",
+            help="validation/sigcache state (entries/bytes gauges, "
+                 "hit/miss/insert/eviction tallies)")
+
+    def _pipeline_families(self) -> list:
+        cs = self.chainstate
+        out = telemetry.flat_families(
+            "bcp_pipeline", cs.pipeline_snapshot(), typ="gauge",
+            help="pipelined-IBD settle horizon (chainstate.pipeline_stats "
+                 "+ cross-block lane packer)")
+        out += telemetry.flat_families(
+            "bcp_connectblock", cs.bench, typ="counter",
+            help="cumulative ConnectBlock phase timings (ms)")
+        out += telemetry.flat_families(
+            "bcp_bip30", cs.bip30_stats, typ="counter",
+            help="BIP30 pre-scan fast-path counters")
+        return out
+
+    def _mempool_families(self) -> list:
+        return [
+            {"name": "bcp_mempool_size", "type": "gauge",
+             "help": "Transactions in the mempool",
+             "samples": [({}, len(self.mempool.entries))]},
+            {"name": "bcp_mempool_bytes", "type": "gauge",
+             "help": "Serialized mempool size (bytes)",
+             "samples": [({}, self.mempool.total_size)]},
+        ]
 
     # -- validation-interface callbacks (CMainSignals analogues) --------
 
@@ -1454,4 +1520,18 @@ class Node:
             self.block_store.close()
             self._index_kv.close()
             self._coins_kv.close()
+        # drop this node's registry collectors: the bound methods would
+        # otherwise keep the closed node's whole object graph (coins
+        # cache, mempool, block index) alive in the process-global
+        # REGISTRY for the rest of the process
+        for name in ("sigcache", "pipeline", "mempool"):
+            telemetry.REGISTRY.unregister_collector(name)
+        if self.tracefile:
+            # -tracefile: the span ring buffer as Chrome/perfetto JSON,
+            # written LAST so shutdown's own flush spans are included
+            try:
+                n = telemetry.TRACER.dump(self.tracefile)
+                log_printf("-tracefile: %d span(s) -> %s", n, self.tracefile)
+            except OSError as e:
+                log_printf("-tracefile dump failed: %r", e)
         log_printf("bcpd shutdown complete")

@@ -41,10 +41,63 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from ..util import telemetry as tm
 from ..util.faults import INJECTOR, Backoff, PoisonedOutput, retry_call
 from ..util.log import log_print, log_printf
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+# -- telemetry families (util/telemetry): per-subsystem dispatch latency
+# split by the path that served the call, retry/fallback tallies, and a
+# breaker-state collector projecting the live registry at scrape time.
+_LAT = tm.histogram(
+    "bcp_dispatch_latency_seconds",
+    "Supervised backend-crossing latency per subsystem and serving path "
+    "(device = the accelerator served it, cpu = breaker/failure fallback, "
+    "settle = async handle materialization)",
+    labels=("site", "path"))
+_RETRIES = tm.counter(
+    "bcp_dispatch_retries_total",
+    "Same-call device retries absorbed by supervised dispatch",
+    labels=("site",))
+_FALLBACKS = tm.counter(
+    "bcp_dispatch_fallback_total",
+    "Calls served by the CPU engine because the device path was open or "
+    "failed", labels=("site",))
+
+_BREAKER_STATE_NUM = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+def _collect_breakers():
+    """Registry collector: breaker state (0 closed / 1 half-open / 2 open)
+    and the trip/probe/fallback tallies, one sample per subsystem."""
+    snaps = snapshot()
+    if not snaps:
+        return []
+    state = {"name": "bcp_breaker_state", "type": "gauge",
+             "help": "Circuit-breaker state per subsystem "
+                     "(0=closed 1=half-open 2=open)",
+             "samples": []}
+    out = [state]
+    for field, help_ in (
+        ("trips", "Times the breaker opened"),
+        ("probes", "Half-open probes attempted"),
+        ("recoveries", "Probes that closed the breaker"),
+        ("fallback_calls", "Calls routed to the CPU engine"),
+        ("fallback_items", "Items (sigs/hashes/leaves) served on CPU"),
+    ):
+        fam = {"name": f"bcp_breaker_{field}_total", "type": "counter",
+               "help": help_, "samples": []}
+        for name, snap in snaps.items():
+            fam["samples"].append(({"subsystem": name}, snap[field]))
+        out.append(fam)
+    for name, snap in snaps.items():
+        state["samples"].append(
+            ({"subsystem": name}, _BREAKER_STATE_NUM[snap["state"]]))
+    return out
+
+
+tm.register_collector("dispatch_breakers", _collect_breakers)
 
 
 @dataclass
@@ -139,6 +192,7 @@ class CircuitBreaker:
         with self._lock:
             self.fallback_calls += 1
             self.fallback_items += max(0, int(items))
+        _FALLBACKS.labels(site=self.name).inc()
 
     def healthy(self) -> bool:
         """Read-only probe: is the device path currently trusted? Unlike
@@ -223,7 +277,10 @@ def supervised_call(site: str, device_fn: Callable, cpu_fn: Callable,
     Returns (result, used_device)."""
     br = breaker(site)
     if br.allow():
+        calls = [0]
+
         def attempt():
+            calls[0] += 1
             INJECTOR.on_call(site)
             out = device_fn()
             if poison is not None and INJECTOR.should_poison(site):
@@ -233,21 +290,32 @@ def supervised_call(site: str, device_fn: Callable, cpu_fn: Callable,
                     f"{site}: device output failed validation probe")
             return out
 
+        t0 = time.monotonic()
         try:
-            out = retry_call(
-                attempt, attempts=br.cfg.retries + 1,
-                backoff=Backoff(base=br.cfg.backoff_base, maximum=1.0),
-            )
+            with tm.span("dispatch.call", site=site, items=items):
+                out = retry_call(
+                    attempt, attempts=br.cfg.retries + 1,
+                    backoff=Backoff(base=br.cfg.backoff_base, maximum=1.0),
+                )
             br.record_success()
+            if calls[0] > 1:
+                _RETRIES.labels(site=site).inc(calls[0] - 1)
+            _LAT.labels(site=site, path="device").observe(
+                time.monotonic() - t0)
             return out, True
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as e:  # noqa: BLE001 — breaker boundary
             br.record_failure(e)
+            if calls[0] > 1:
+                _RETRIES.labels(site=site).inc(calls[0] - 1)
             log_print("tpu", "%s device call failed (%s) — CPU fallback",
                       site, e)
     br.note_fallback(items)
-    return cpu_fn(), False
+    t0 = time.monotonic()
+    out = cpu_fn()
+    _LAT.labels(site=site, path="cpu").observe(time.monotonic() - t0)
+    return out, False
 
 
 class SupervisedHandle:
@@ -265,10 +333,10 @@ class SupervisedHandle:
     call from multiple consumers (the first settle pays; the rest read)."""
 
     __slots__ = ("_site", "_pending", "_cpu_fn", "_validate", "_poison",
-                 "_items", "_result", "_done", "used_device")
+                 "_items", "_result", "_done", "used_device", "_ctx")
 
     def __init__(self, site, pending, cpu_fn, validate, poison, items,
-                 used_device):
+                 used_device, ctx=None):
         self._site = site
         self._pending = pending      # zero-arg materializer, or None
         self._cpu_fn = cpu_fn
@@ -278,6 +346,10 @@ class SupervisedHandle:
         self._result = None
         self._done = pending is None
         self.used_device = used_device
+        # trace-correlation handoff: the enqueue-side span context rides
+        # the handle so the settle span — often on ANOTHER thread — links
+        # back to the dispatching block's correlation chain
+        self._ctx = ctx
         if self._done:
             self._result = cpu_fn()  # CPU path is synchronous anyway
 
@@ -285,14 +357,19 @@ class SupervisedHandle:
         if self._done:
             return self._result
         br = breaker(self._site)
+        t0 = time.monotonic()
         try:
-            out = self._pending()
+            with tm.span("dispatch.settle", parent=self._ctx,
+                         site=self._site, items=self._items):
+                out = self._pending()
             if self._poison is not None and INJECTOR.should_poison(self._site):
                 out = self._poison(out)
             if self._validate is not None and not self._validate(out):
                 raise PoisonedOutput(
                     f"{self._site}: device output failed validation probe")
             br.record_success()
+            _LAT.labels(site=self._site, path="settle").observe(
+                time.monotonic() - t0)
             self._result = out
         except (KeyboardInterrupt, SystemExit):
             raise
@@ -321,10 +398,12 @@ def supervised_enqueue(site: str, enqueue_fn: Callable, cpu_fn: Callable,
     br = breaker(site)
     if br.allow():
         try:
-            INJECTOR.on_call(site)
-            pending = enqueue_fn()
+            with tm.span("dispatch.enqueue", site=site, items=items):
+                INJECTOR.on_call(site)
+                pending = enqueue_fn()
+                ctx = tm.trace_context()  # the enqueue span itself
             return SupervisedHandle(site, pending, cpu_fn, validate, poison,
-                                    items, used_device=True)
+                                    items, used_device=True, ctx=ctx)
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as e:  # noqa: BLE001 — breaker boundary

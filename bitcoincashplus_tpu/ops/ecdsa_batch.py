@@ -33,9 +33,64 @@ from typing import Optional, Sequence
 import numpy as np
 
 from ..crypto import secp256k1 as oracle
+from ..util import telemetry as tm
 from ..util.faults import INJECTOR, Backoff, PoisonedOutput
 from ..util.log import log_printf
 from . import dispatch
+
+# -- telemetry families (util/telemetry): per-stage host-pack latency,
+# device settle-wait distribution, dispatch/flush lane-size histograms,
+# and the lane-fill / in-flight gauges. STATS itself is projected onto
+# the registry by the collector below, so getmetrics' /metrics namespace
+# and gettpuinfo's `batch` section read the same counters.
+_STAGE_H = tm.histogram(
+    "bcp_ecdsa_stage_seconds",
+    "Host pack-stage latency per dispatch (decompose = GLV lattice split, "
+    "pack = byte-matrix emit)", labels=("stage",),
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0))
+_SETTLE_H = tm.histogram(
+    "bcp_ecdsa_settle_wait_seconds",
+    "Blocking wait at BatchHandle.result() — near zero when the pipeline "
+    "hid the device latency")
+_LANES_H = tm.histogram(
+    "bcp_ecdsa_dispatch_lanes",
+    "Real (unpadded) lanes per device dispatch",
+    buckets=(32, 128, 512, 1024, 2048, 4096, 8192, 16384, 32768))
+_PACKER_FLUSH_H = tm.histogram(
+    "bcp_packer_flush_lanes",
+    "Lanes per cross-block LanePacker bucket flush",
+    buckets=(32, 128, 512, 1024, 2046, 4096, 8190, 16384))
+_LANE_FILL_G = tm.gauge(
+    "bcp_packer_lane_fill_pct",
+    "Cumulative real-lane fill of padded device buckets (percent)")
+_IN_FLIGHT_G = tm.gauge(
+    "bcp_ecdsa_in_flight",
+    "Device verify dispatches currently in flight")
+
+
+def _collect_ecdsa_stats():
+    """Registry collector: every numeric BatchStats field as
+    bcp_ecdsa_<field>, plus per-bucket dispatch counts. in_flight is
+    excluded — the native _IN_FLIGHT_G gauge already owns that name, and
+    a collector re-emitting it would duplicate the family with a
+    conflicting TYPE in the Prometheus exposition."""
+    snap = STATS.snapshot()
+    snap.pop("in_flight", None)
+    buckets = snap.pop("buckets_used", {})
+    out = tm.flat_families("bcp_ecdsa", snap, typ="counter",
+                           help="ops/ecdsa_batch.STATS")
+    if buckets:
+        out.append({
+            "name": "bcp_ecdsa_bucket_dispatches_total", "type": "counter",
+            "help": "Device dispatches per padded bucket size",
+            "samples": [({"bucket": str(b)}, n)
+                        for b, n in sorted(buckets.items())],
+        })
+    return out
+
+
+tm.register_collector("ecdsa_stats", _collect_ecdsa_stats)
 
 # Pad-to-bucket sizes (SURVEY.md §8.4 dispatch layer). One compiled
 # executable per bucket; persistent across blocks via jit cache.
@@ -171,6 +226,8 @@ def _note_device_dispatch(n: int, bucket: int) -> None:
     STATS.buckets_used[bucket] = STATS.buckets_used.get(bucket, 0) + 1
     STATS.in_flight += 1
     STATS.max_in_flight = max(STATS.max_in_flight, STATS.in_flight)
+    _LANES_H.observe(n)
+    _IN_FLIGHT_G.set(STATS.in_flight)
 
 
 def _bucket_for(n: int, pallas: bool = False) -> int:
@@ -383,6 +440,7 @@ def _glv_pack_parts(u1_bytes, u2_bytes, qx_bytes, qy_ints, r_bytes,
         qy = oracle.P - qy_ints[i] if nb1 else qy_ints[i]
         qyb[i] = np.frombuffer(qy.to_bytes(32, "big"), np.uint8)
     STATS.glv_decompose_s += time.monotonic() - t0
+    _STAGE_H.labels(stage="decompose").observe(time.monotonic() - t0)
 
     t0 = time.monotonic()
 
@@ -399,6 +457,7 @@ def _glv_pack_parts(u1_bytes, u2_bytes, qx_bytes, qy_ints, r_bytes,
     out = (d1m, d2m, sg1, sg2, s1m, s2m, ydiff, pad(qx_bytes), qyb,
            q_inf, pad(r_bytes), pad(rn_bytes), wrap8)
     STATS.glv_pack_s += time.monotonic() - t0
+    _STAGE_H.labels(stage="pack").observe(time.monotonic() - t0)
     return out
 
 
@@ -513,11 +572,11 @@ class BatchHandle:
     fabricated mask."""
 
     __slots__ = ("_n", "_bucket", "_device_ok", "_cpu_ok", "_degen",
-                 "_records", "_breaker", "_kat", "_recover")
+                 "_records", "_breaker", "_kat", "_recover", "_ctx")
 
     def __init__(self, n, bucket=0, device_ok=None, cpu_ok=None,
                  degen=None, records=None, breaker=None, kat=False,
-                 recover=None):
+                 recover=None, ctx=None):
         self._n = n
         self._bucket = bucket
         self._device_ok = device_ok
@@ -527,6 +586,10 @@ class BatchHandle:
         self._breaker = breaker
         self._kat = kat
         self._recover = recover  # fast whole-batch CPU verdict (packed)
+        # enqueue-side trace context: the settle span (possibly another
+        # thread, possibly many blocks later) links back to the span that
+        # dispatched this batch
+        self._ctx = ctx
 
     def _device_failed(self, err: BaseException) -> np.ndarray:
         """Settle-time device failure: breaker bookkeeping + CPU re-verify
@@ -556,11 +619,14 @@ class BatchHandle:
             return self._cpu_ok
         t0 = time.monotonic()
         try:
-            ok = np.asarray(self._device_ok)  # blocks until the chip finishes
+            with tm.span("ecdsa.settle", parent=self._ctx, lanes=self._n,
+                         bucket=self._bucket):
+                ok = np.asarray(self._device_ok)  # blocks until chip done
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as e:  # device died between enqueue and settle
             STATS.in_flight = max(0, STATS.in_flight - 1)
+            _IN_FLIGHT_G.set(STATS.in_flight)
             self._device_ok = None
             return self._device_failed(e)
         # device_seconds counts only the blocking wait — when the P3
@@ -568,7 +634,9 @@ class BatchHandle:
         # near zero; summing dispatch->settle spans would double-count
         # concurrent chunks and absorb host interpreter time.
         STATS.device_seconds += time.monotonic() - t0
+        _SETTLE_H.observe(time.monotonic() - t0)
         STATS.in_flight = max(0, STATS.in_flight - 1)
+        _IN_FLIGHT_G.set(STATS.in_flight)
         self._device_ok = None
         ok = np.asarray(ok, dtype=bool)
         if INJECTOR.should_poison("ecdsa"):
@@ -679,6 +747,9 @@ def _dispatch_device(records: Sequence, br,
     boff = Backoff(base=br.cfg.backoff_base, maximum=1.0)
     last: Optional[BaseException] = None
     kern = kernel if kernel in ECDSA_KERNELS else active_kernel()
+    # the enqueuing span (block.scan during the pipelined import) is the
+    # settle span's parent — settle may run threads/blocks away
+    ctx = tm.trace_context()
     for attempt in range(br.cfg.retries + 1):
         try:
             INJECTOR.on_call("ecdsa")
@@ -729,7 +800,7 @@ def _dispatch_device(records: Sequence, br,
                     *map(np.asarray, arrays))
             _note_device_dispatch(len(records), bucket)
             return BatchHandle(len(records), bucket, device_ok, degen=degen,
-                               records=wire, breaker=br, kat=True)
+                               records=wire, breaker=br, kat=True, ctx=ctx)
         except (KeyboardInterrupt, SystemExit):
             raise
         except (NameError, AttributeError, UnboundLocalError):
@@ -991,12 +1062,16 @@ class LanePacker:
         st = self.stats
         st["dispatches"] += 1
         st["lanes_real"] += len(batch)
+        _PACKER_FLUSH_H.observe(len(batch))
         # padding booked from the handle's ACTUAL bucket (0 = the dispatch
         # took the CPU lane, which has no padding concept); the 2 KAT lanes
         # ride every device batch and are excluded from the fill metric
         bucket = getattr(handle, "_bucket", 0)
         if bucket:
             st["lanes_padded"] += max(0, bucket - len(batch) - 2)
+        total = st["lanes_real"] + st["lanes_padded"]
+        if total:
+            _LANE_FILL_G.set(round(100.0 * st["lanes_real"] / total, 2))
         # carve the dispatched records back into per-block segments
         pos = 0
         consumed = []
@@ -1183,6 +1258,7 @@ def _dispatch_packed_device(pub, rs, msg, rn, wrap, n: int,
 
     boff = Backoff(base=br.cfg.backoff_base, maximum=1.0)
     last: Optional[BaseException] = None
+    ctx = tm.trace_context()  # settle-span parent (see _dispatch_device)
     for attempt in range(br.cfg.retries + 1):
         try:
             INJECTOR.on_call("ecdsa")
@@ -1264,7 +1340,8 @@ def _dispatch_packed_device(pub, rs, msg, rn, wrap, n: int,
 
             return BatchHandle(n, bucket, device_ok, degen=degen,
                                records=_LazyRecords(pub2, rs2, msg2),
-                               breaker=br, kat=True, recover=recover)
+                               breaker=br, kat=True, recover=recover,
+                               ctx=ctx)
         except (KeyboardInterrupt, SystemExit):
             raise
         except (NameError, AttributeError, UnboundLocalError):

@@ -36,6 +36,7 @@ from ..consensus.tx import CTransaction
 from ..consensus.pow import check_headers_pow_batch
 from ..mempool.mempool import MempoolError
 from ..store.kvstore import atomic_write_json, read_json
+from ..util import telemetry as tm
 from ..util.faults import INJECTOR, Backoff, InjectedFault, NET_SITE
 from ..util.log import log_print, log_printf
 from ..validation.chain import BlockStatus
@@ -198,6 +199,15 @@ class Peer:
         }
 
 
+# telemetry: supervision-tick duration — a tick that blocks the event
+# loop shows up here long before peers start timing out
+_TICK_H = tm.histogram(
+    "bcp_net_tick_seconds",
+    "P2P supervision tick (_tick) duration",
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+             0.05, 0.1, 0.25, 0.5, 1.0))
+
+
 class CConnman:
     def __init__(self, node, bind_host: str = "127.0.0.1", listen_port: int = 0):
         self.node = node
@@ -288,6 +298,12 @@ class CConnman:
         self._ban_seq = 0        # bumped under _ban_lock per mutation
         self._ban_saved_seq = 0  # last seq persisted (under _ban_io_lock)
         self._banned: dict[str, float] = self._load_banlist()
+        # telemetry: tick-duration histogram (inline in _tick) plus a
+        # scrape-time collector projecting net_stats and per-peer recv
+        # rates into the registry — live state, no stale labeled gauges
+        # for long-gone peers. Re-registering replaces any previous
+        # connman's collector (one live P2P stack per process).
+        tm.register_collector("net", self._telemetry_families)
         self.bantime = 86400  # -bantime default
         # mapOrphanTransactions (net_processing.cpp): txs whose inputs we
         # don't know yet. Bounded by count AND bytes; over-budget inserts
@@ -369,6 +385,7 @@ class CConnman:
         on inactivity, ping on cadence, close the receive-rate window
         (charging floods), and run block-download stall detection
         (re-request from another peer, then evict the staller)."""
+        t_tick = time.monotonic()
         # rate windows are normalized by the time since the previous tick
         # actually ran — a tick delayed by a long validation must not
         # read the drained backlog as a flood
@@ -416,6 +433,9 @@ class CConnman:
             self._unrequested.clear()
             self.net_stats["parked_handoffs"] += \
                 self._dispatch_wanted(hashes, now=now)
+        # wall clock, not the caller's fake `now`: the histogram measures
+        # how long the tick occupied the event loop
+        _TICK_H.observe(time.monotonic() - t_tick)
 
     def _check_stall(self, peer: Peer, now: float) -> None:
         """Block-download stall detection (net_processing.cpp's
@@ -718,6 +738,32 @@ class CConnman:
             log_print("net", "erased %d orphans from peer=%d",
                       len(mine), peer_id)
 
+    def _telemetry_families(self) -> list:
+        """Registry collector: net_stats counters, pool/banlist gauges,
+        and per-peer receive-rate gauges (live peers only — labels die
+        with their peer, unlike a mutable labeled gauge would)."""
+        out = tm.flat_families("bcp_net", self.net_stats, typ="counter",
+                              help="p2p/connman supervision counters")
+        out.append({"name": "bcp_net_peers", "type": "gauge",
+                    "help": "Connected peers",
+                    "samples": [({}, len(self.peers))]})
+        out.append({"name": "bcp_net_orphans", "type": "gauge",
+                    "help": "Parked orphan transactions",
+                    "samples": [({}, len(self._orphans))]})
+        out.append({"name": "bcp_net_banned", "type": "gauge",
+                    "help": "Banlist entries",
+                    "samples": [({}, len(self._banned))]})
+        peers = list(self.peers.values())
+        if peers:
+            out.append({
+                "name": "bcp_peer_recv_rate_bytes", "type": "gauge",
+                "help": "Per-peer receive rate over the last tick window "
+                        "(bytes/sec)",
+                "samples": [({"peer": str(p.id)}, round(p.recv_rate, 1))
+                            for p in peers],
+            })
+        return out
+
     def net_snapshot(self) -> dict:
         """gettpuinfo 'net' section: the supervision counters an operator
         needs to see why peers are being charged and evicted."""
@@ -742,6 +788,9 @@ class CConnman:
         log_print("net", "P2P listening on %s:%d", self.bind_host, self.port)
 
     def close(self) -> None:
+        # the 'net' collector holds a bound method of this connman; a
+        # closed P2P stack must not stay reachable through the registry
+        tm.REGISTRY.unregister_collector("net")
         if self.loop is None:
             return
 

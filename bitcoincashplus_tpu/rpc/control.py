@@ -115,6 +115,9 @@ def gettpuinfo(node, params):
         devices = [str(d) for d in jax.devices()]
     except Exception:
         pass
+    from ..mempool.accept import accept_latency_quantiles
+    from ..util import telemetry
+
     return {
         "backend": node.backend,
         "devices": devices,
@@ -134,7 +137,48 @@ def gettpuinfo(node, params):
         "bip30": dict(getattr(node.chainstate, "bip30_stats", {})),
         "net": (node.connman.net_snapshot()
                 if getattr(node, "connman", None) is not None else {}),
+        # unified-telemetry view (util/telemetry): the active level, span
+        # ring-buffer occupancy, and the serving path's p50/p90/p99
+        # mempool accept latency (the registry's histogram — getmetrics /
+        # /metrics expose the full distribution)
+        "telemetry": {
+            "mode": telemetry.mode(),
+            "spans": telemetry.TRACER.stats(),
+            "accept_latency": accept_latency_quantiles(),
+        },
     }
+
+
+@rpc_method("getmetrics")
+def getmetrics(node, params):
+    """getmetrics
+
+    The unified telemetry registry (util/telemetry): every counter/gauge/
+    histogram family — native metrics plus the collector-projected STATS,
+    breaker, sigcache, pipeline, and net surfaces — with histogram bucket
+    counts and p50/p90/p99 estimates inline. The same namespace Prometheus
+    scrapes at /metrics on the REST server."""
+    from ..util import telemetry
+
+    return telemetry.REGISTRY.snapshot()
+
+
+@rpc_method("dumptrace")
+def dumptrace(node, params):
+    """dumptrace ( "path" )
+
+    Write the span tracer's ring buffer as Chrome-trace/perfetto JSON
+    (load at ui.perfetto.dev). Default path: <datadir>/trace.json.
+    Returns {path, events, mode} — with -telemetry below `trace` the
+    buffer is empty and the dump says so rather than erroring."""
+    import os as _os
+
+    from ..util import telemetry
+
+    path = str(params[0]) if params else _os.path.join(node.datadir,
+                                                       "trace.json")
+    events = telemetry.TRACER.dump(path)
+    return {"path": path, "events": events, "mode": telemetry.mode()}
 
 
 @rpc_method("createmultisig")

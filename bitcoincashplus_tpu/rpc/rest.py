@@ -12,6 +12,14 @@ handle_rest when `-rest` is enabled. Same endpoint contract:
   /rest/mempool/info.json
   /rest/mempool/contents.json
 
+Plus this framework's observability endpoint (not in the reference):
+
+  /metrics   Prometheus text exposition (version 0.0.4) over the unified
+             telemetry registry (util/telemetry) — counters, gauges, and
+             latency histograms covering dispatch, ecdsa, pipeline,
+             sigcache, mempool-accept, and net. Same `-rest` gate as the
+             other unauthenticated GETs.
+
 Errors are plain-text with the reference's status codes (400 bad input,
 404 unknown object, 403 when -rest is off — callers without auth cookies
 use this surface, so it never throws RPC errors outward).
@@ -60,7 +68,9 @@ def _split_format(tail: str) -> tuple[str, str]:
 
 
 def handle_rest(node, path: str) -> tuple[int, str, bytes]:
-    """GET /rest/... -> (status, content_type, body)."""
+    """GET /rest/... (or /metrics) -> (status, content_type, body)."""
+    if path == "/metrics" or path.startswith("/metrics?"):
+        return handle_metrics(node)
     if not path.startswith("/rest/"):
         raise RestError(404, "not a REST path")
     parts = path[len("/rest/"):].split("/")
@@ -90,6 +100,17 @@ def handle_rest(node, path: str) -> tuple[int, str, bytes]:
                 }
             return _json(out)
     raise RestError(404, f"unknown REST endpoint: {path}")
+
+
+def handle_metrics(node) -> tuple[int, str, bytes]:
+    """GET /metrics — Prometheus text exposition over the telemetry
+    registry. Scrape-safe with -telemetry=off too (families expose their
+    frozen values; the header names the active mode for operators)."""
+    from ..util import telemetry
+
+    body = (f"# bcp telemetry mode={telemetry.mode()}\n"
+            + telemetry.REGISTRY.prometheus_text())
+    return 200, "text/plain; version=0.0.4; charset=utf-8", body.encode()
 
 
 def _json(obj) -> tuple[int, str, bytes]:

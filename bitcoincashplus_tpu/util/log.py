@@ -7,10 +7,17 @@ OpenDebugLog, fPrintToConsole). `-debug=<cat>` gates category logs;
 
 Categories used in this framework (superset of the reference's that apply):
   net, mempool, rpc, bench, db, validation, tpu
+
+Structured mode (`-logjson`): each record is one JSON object per line
+(`{"ts", "msg", "cat", "corr"}`) instead of the classic text line. `corr`
+is the active telemetry span's correlation id (util/telemetry) when span
+tracing is on — logs and -tracefile dumps cross-reference through it, so
+"which block's settle emitted this warning" is a join, not a guess.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 import threading
@@ -22,14 +29,16 @@ _logfile: Optional[IO[str]] = None
 _categories: set[str] = set()
 _all_categories = False
 _print_to_console = False
+_json_mode = False
 _started = time.time()
 
 
 def log_init(logfile_path: Optional[str] = None,
              categories: Iterable[str] = (),
-             print_to_console: bool = False) -> None:
+             print_to_console: bool = False,
+             json_mode: bool = False) -> None:
     """InitLogging + OpenDebugLog. Safe to call more than once (tests)."""
-    global _logfile, _all_categories, _print_to_console
+    global _logfile, _all_categories, _print_to_console, _json_mode
     with _lock:
         if _logfile is not None:
             try:
@@ -40,6 +49,7 @@ def log_init(logfile_path: Optional[str] = None,
         _categories.clear()
         _all_categories = False
         _print_to_console = print_to_console
+        _json_mode = json_mode
         for cat in categories:
             if cat in ("1", "all"):
                 _all_categories = True
@@ -57,9 +67,23 @@ def log_accept_category(category: str) -> bool:
     return _all_categories or category in _categories
 
 
-def _emit(line: str) -> None:
+def _emit(line: str, category: Optional[str] = None) -> None:
     stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    out = f"{stamp} {line}\n"
+    if _json_mode:
+        rec = {"ts": stamp, "msg": line}
+        if category is not None:
+            rec["cat"] = category
+        try:
+            from . import telemetry
+
+            corr = telemetry.current_corr()
+            if corr is not None:
+                rec["corr"] = corr
+        except Exception:  # telemetry must never take logging down
+            pass
+        out = json.dumps(rec) + "\n"
+    else:
+        out = f"{stamp} {line}\n"
     with _lock:
         if _logfile is not None:
             _logfile.write(out)
@@ -76,7 +100,7 @@ def log_printf(msg: str, *args) -> None:
 def log_print(category: str, msg: str, *args) -> None:
     """LogPrint(category, ...) — emitted only when -debug=<category>."""
     if log_accept_category(category):
-        _emit(msg % args if args else msg)
+        _emit(msg % args if args else msg, category=category)
 
 
 def uptime() -> int:

@@ -26,11 +26,29 @@ from ..consensus.serialize import hash_to_hex
 from ..consensus.tx import COutPoint, CTransaction, money_range
 from ..consensus.tx_check import TxValidationError, check_transaction, is_final_tx
 from ..script.script import script_int
+from ..util import telemetry as tm
 from ..util.log import log_print
 from .chain import BlockStatus, CBlockIndex, CChain
 from .coins import BlockUndo, CoinsCache, CoinsView, TxUndo, add_coins
 
 MAX_FUTURE_BLOCK_TIME = 2 * 60 * 60  # src/chain.h (MAX_FUTURE_BLOCK_TIME)
+
+# -- telemetry (util/telemetry): the pipelined engine's per-block leg
+# latencies as histograms, and scan/settle/commit spans so a -tracefile
+# dump yields a MEASURED per-block overlap fraction (tools/trace_view.py)
+# instead of the bench-only aggregate estimate.
+_SCAN_H = tm.histogram(
+    "bcp_pipeline_scan_seconds",
+    "Speculative connect + host script scan per block")
+_SETTLE_H = tm.histogram(
+    "bcp_pipeline_settle_wait_seconds",
+    "Blocking wait for a block's signature batches at settle")
+_COMMIT_H = tm.histogram(
+    "bcp_pipeline_commit_seconds",
+    "Externalization (coins merge, undo+index write, listeners) per block")
+_UNWINDS_C = tm.counter(
+    "bcp_pipeline_unwind_blocks_total",
+    "Speculative blocks dropped by settle-failure unwinds")
 
 
 class BlockValidationError(TxValidationError):
@@ -783,16 +801,22 @@ class ChainstateManager:
         layer = CoinsCache(base)
         jobs: list = []
         coins_save, self.coins = self.coins, layer
-        try:
-            undo = self._connect_block_inner(block, idx, check_scripts,
-                                             sig_jobs=jobs)
-        except BlockValidationError:
-            for j in jobs:
-                j.drain()
-            self._mark_invalid(idx)
-            return False
-        finally:
-            self.coins = coins_save
+        # the scan span is the parent of this block's ecdsa.settle spans
+        # (the batch captures trace_context() at dispatch) — trace_view
+        # stitches scan end -> settle end into the per-block in-flight
+        # window and measures the overlap fraction from it
+        with tm.span("block.scan", height=idx.height,
+                     hash=hash_to_hex(idx.hash)[:16]):
+            try:
+                undo = self._connect_block_inner(block, idx, check_scripts,
+                                                 sig_jobs=jobs)
+            except BlockValidationError:
+                for j in jobs:
+                    j.drain()
+                self._mark_invalid(idx)
+                return False
+            finally:
+                self.coins = coins_save
         self.chain.set_tip(idx)
         # prune like the serial engine does after every activation step —
         # without this, every imported block stays a candidate and the
@@ -807,6 +831,7 @@ class ChainstateManager:
         ps = self.pipeline_stats
         ps["max_depth"] = max(ps["max_depth"], len(self._horizon))
         ps["scan_ms"] += (_time.perf_counter() - t0) * 1e3
+        _SCAN_H.observe(_time.perf_counter() - t0)
         return True
 
     def _settle_oldest(self) -> bool:
@@ -822,33 +847,38 @@ class ChainstateManager:
             t0 = _time.perf_counter()
             if ent["job"] is not None:
                 try:
-                    ent["job"].settle()
+                    with tm.span("block.settle", height=idx.height,
+                                 hash=hash_to_hex(idx.hash)[:16]):
+                        ent["job"].settle()
                 except BlockValidationError as e:
                     self._unwind_horizon(e)
                     return False
             t1 = _time.perf_counter()
-            self._horizon.pop(0)
-            ent["layer"].flush()  # into the settled cache (self.coins)
-            if self._horizon:
-                # re-base the next layer onto the settled cache — its old
-                # base is the (now empty) layer we just flushed
-                self._horizon[0]["layer"].base = self.coins
-            self.block_store.put_undo(idx.hash, ent["undo"].serialize())
-            idx.status |= BlockStatus.HAVE_UNDO
-            idx.raise_validity(
-                BlockStatus.VALID_SCRIPTS if ent["scripts"]
-                else BlockStatus.VALID_CHAIN
-            )
-            self._dirty_index.add(idx)
-            ps = self.pipeline_stats
-            ps["settled_blocks"] += 1
-            ps["settle_wait_ms"] += (t1 - t0) * 1e3
-            self.bench["blocks"] += 1
-            for cb in self.on_block_connected:
-                cb(ent["block"], idx)
-            for cb in self.on_tip_changed:
-                cb(idx)
+            _SETTLE_H.observe(t1 - t0)
+            with tm.span("block.commit", height=idx.height):
+                self._horizon.pop(0)
+                ent["layer"].flush()  # into the settled cache (self.coins)
+                if self._horizon:
+                    # re-base the next layer onto the settled cache — its
+                    # old base is the (now empty) layer we just flushed
+                    self._horizon[0]["layer"].base = self.coins
+                self.block_store.put_undo(idx.hash, ent["undo"].serialize())
+                idx.status |= BlockStatus.HAVE_UNDO
+                idx.raise_validity(
+                    BlockStatus.VALID_SCRIPTS if ent["scripts"]
+                    else BlockStatus.VALID_CHAIN
+                )
+                self._dirty_index.add(idx)
+                ps = self.pipeline_stats
+                ps["settled_blocks"] += 1
+                ps["settle_wait_ms"] += (t1 - t0) * 1e3
+                self.bench["blocks"] += 1
+                for cb in self.on_block_connected:
+                    cb(ent["block"], idx)
+                for cb in self.on_tip_changed:
+                    cb(idx)
             ps["commit_ms"] += (_time.perf_counter() - t1) * 1e3
+            _COMMIT_H.observe(_time.perf_counter() - t1)
             return True
         finally:
             self._settling = settling_save
@@ -874,6 +904,10 @@ class ChainstateManager:
         ps = self.pipeline_stats
         ps["unwinds"] += 1
         ps["unwound_blocks"] += len(entries)
+        _UNWINDS_C.inc(len(entries))
+        tm.instant("block.unwind", height=failed.height,
+                   hash=hash_to_hex(failed.hash)[:16],
+                   dropped=len(entries), reason=err.reason)
         log_print(
             "bench",
             "settle horizon unwound: %d speculative block(s) dropped, "

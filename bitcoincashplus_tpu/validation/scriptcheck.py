@@ -19,6 +19,7 @@ from typing import Optional
 from ..consensus.params import ChainParams
 from ..ops import ecdsa_batch
 from ..crypto.hashes import hash160
+from ..util import telemetry as tm
 from ..script.interpreter import (
     SCRIPT_ENABLE_SIGHASH_FORKID,
     SCRIPT_VERIFY_CLEANSTACK,
@@ -266,7 +267,14 @@ class BlockScriptVerifier:
         self.chunk = chunk
 
     def __call__(self, block, idx, spent_per_tx) -> None:
-        self.scan(block, idx, spent_per_tx).settle()
+        # serial engine: scan+settle back to back — spanned here so the
+        # trace still shows the two legs (the pipelined engine's spans
+        # live in chainstate, around the speculative connect / horizon
+        # settle, and do not pass through __call__)
+        with tm.span("block.scan", height=idx.height):
+            job = self.scan(block, idx, spent_per_tx)
+        with tm.span("block.settle", height=idx.height):
+            job.settle()
 
     def scan(self, block, idx, spent_per_tx, packer=None) -> BlockSigJob:
         """The SCAN stage: host script interpretation over every input,

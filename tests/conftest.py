@@ -52,13 +52,18 @@ def pytest_collection_modifyitems(config, items):
     The ``pipeline`` suite (pipelined-IBD differentials/unwind, tier-1,
     JAX_PLATFORMS=cpu) runs after the plain unit suite and before the
     functional/adversarial groups; the ``glv`` kernel suite is plain-unit
-    (group 0) on purpose — fast, ordered with the unit run. Stable sort:
-    order within each group is unchanged."""
+    (group 0) on purpose — fast, ordered with the unit run. The
+    ``telemetry`` suite runs after ``pipeline`` (its registry-zeroing
+    fixture must not interleave with suites asserting on live counters)
+    and before the functional groups. Stable sort: order within each
+    group is unchanged."""
 
     def group(item) -> int:
         if "functional" not in str(item.fspath):
+            if item.get_closest_marker("telemetry"):
+                return 2
             return 1 if item.get_closest_marker("pipeline") else 0
-        return 3 if item.get_closest_marker("adversarial") else 2
+        return 4 if item.get_closest_marker("adversarial") else 3
 
     items.sort(key=group)
 

@@ -1,0 +1,426 @@
+"""Unified telemetry (util/telemetry, ISSUE 6): histogram bucket/quantile
+math against a NumPy reference, registry thread-safety under concurrent
+writers, a Prometheus exposition golden test, span nesting + correlation
+across the supervised-dispatch thread boundary, and gettpuinfo parity
+(every pre-existing key still present and equal to its source).
+
+Marker: ``telemetry`` — conftest orders these after the pipeline group
+(the mode/registry fixtures are process-global) and before functional.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from bitcoincashplus_tpu.util import telemetry as tm
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture
+def telemetry_mode():
+    """Set the process-global telemetry mode for one test and restore the
+    env-derived default (plus a clean span buffer) afterwards."""
+    def set_(name):
+        tm.set_mode(name)
+        tm.TRACER.clear()
+        return tm
+
+    yield set_
+    tm.reset()
+
+
+# ---------------------------------------------------------------------------
+# histogram math vs NumPy
+# ---------------------------------------------------------------------------
+
+BOUNDS = tuple(float(b) for b in np.geomspace(1e-4, 10.0, 40))
+
+
+def test_histogram_bucket_counts_match_numpy():
+    rng = np.random.default_rng(7)
+    samples = rng.lognormal(mean=-4.0, sigma=2.0, size=5000)
+    h = tm.Histogram(buckets=BOUNDS)
+    for s in samples:
+        h.observe(float(s))
+    # NumPy reference: le-bucketing == searchsorted(side="left") counts
+    idx = np.searchsorted(np.asarray(BOUNDS), samples, side="left")
+    ref = np.bincount(idx, minlength=len(BOUNDS) + 1)
+    assert h.counts == ref.tolist()
+    assert h.count == len(samples)
+    assert h.sum == pytest.approx(float(samples.sum()))
+
+
+def _numpy_quantile_from_buckets(bounds, counts, q):
+    """Independent reference for the interpolated histogram quantile:
+    np.interp over the cumulative distribution at the bucket edges."""
+    cum = np.cumsum(counts)
+    total = cum[-1]
+    rank = q * total
+    i = int(np.searchsorted(cum, rank, side="left"))
+    if i >= len(bounds):
+        return bounds[-1]
+    lo = bounds[i - 1] if i > 0 else 0.0
+    in_bucket = counts[i]
+    if in_bucket <= 0:
+        return bounds[i]
+    prev = cum[i] - in_bucket
+    return float(np.interp(rank, [prev, cum[i]], [lo, bounds[i]]))
+
+
+def test_histogram_quantiles_match_numpy_reference():
+    rng = np.random.default_rng(11)
+    samples = rng.lognormal(mean=-3.0, sigma=1.5, size=8000)
+    h = tm.Histogram(buckets=BOUNDS)
+    for s in samples:
+        h.observe(float(s))
+    for q in (0.5, 0.9, 0.99):
+        ref = _numpy_quantile_from_buckets(BOUNDS, h.counts, q)
+        assert h.quantile(q) == pytest.approx(ref, rel=1e-9)
+    # and the estimate tracks the TRUE percentile within bucket
+    # granularity (geomspace ratio ~1.34 -> allow 1.5x either way)
+    for q in (0.5, 0.9, 0.99):
+        true = float(np.percentile(samples, q * 100))
+        est = h.quantile(q)
+        assert true / 1.5 <= est <= true * 1.5, (q, est, true)
+
+
+def test_histogram_edge_cases():
+    h = tm.Histogram(buckets=(1.0, 2.0))
+    assert h.quantile(0.5) == 0.0  # empty
+    h.observe(100.0)  # overflow clamps to the last finite bound
+    assert h.quantile(0.99) == 2.0
+    with pytest.raises(ValueError):
+        tm.Histogram(buckets=(2.0, 1.0))  # must ascend
+
+
+# ---------------------------------------------------------------------------
+# registry thread-safety
+# ---------------------------------------------------------------------------
+
+def test_registry_thread_safety_under_concurrent_writers():
+    reg = tm.Registry()
+    c = reg.counter("t_total", labels=("who",))
+    g = reg.gauge("t_gauge")
+    h = reg.histogram("t_hist", buckets=(0.5, 1.0))
+    n_threads, n_iter = 8, 5000
+
+    def work(i):
+        child = c.labels(who=str(i % 2))
+        for k in range(n_iter):
+            child.inc()
+            h.observe(0.25 if k % 2 else 0.75)
+            g.set(k)
+
+    threads = [threading.Thread(target=work, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = sum(child.value for _lbl, child in c.samples())
+    assert total == n_threads * n_iter  # no lost increments
+    assert h._children[()].count == n_threads * n_iter
+    counts = h._children[()].counts
+    assert counts[0] == counts[1] == n_threads * n_iter // 2
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition golden
+# ---------------------------------------------------------------------------
+
+def test_prometheus_exposition_golden():
+    reg = tm.Registry()
+    c = reg.counter("bcp_test_ops_total", "Ops served", labels=("site",))
+    c.labels(site="ecdsa").inc(3)
+    c.labels(site="sha256").inc()
+    reg.gauge("bcp_test_depth", "Current depth").set(4)
+    h = reg.histogram("bcp_test_latency_seconds", "Latency",
+                      buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 0.5, 2.0):
+        h.observe(v)
+    reg.register_collector("extra", lambda: [{
+        "name": "bcp_test_collected", "type": "gauge", "help": "From afar",
+        "samples": [({"peer": "1"}, 7.5)],
+    }])
+    expected = (
+        "# HELP bcp_test_ops_total Ops served\n"
+        "# TYPE bcp_test_ops_total counter\n"
+        'bcp_test_ops_total{site="ecdsa"} 3\n'
+        'bcp_test_ops_total{site="sha256"} 1\n'
+        "# HELP bcp_test_depth Current depth\n"
+        "# TYPE bcp_test_depth gauge\n"
+        "bcp_test_depth 4\n"
+        "# HELP bcp_test_latency_seconds Latency\n"
+        "# TYPE bcp_test_latency_seconds histogram\n"
+        'bcp_test_latency_seconds_bucket{le="0.1"} 1\n'
+        'bcp_test_latency_seconds_bucket{le="1"} 3\n'
+        'bcp_test_latency_seconds_bucket{le="+Inf"} 4\n'
+        "bcp_test_latency_seconds_sum 3.05\n"
+        "bcp_test_latency_seconds_count 4\n"
+        "# HELP bcp_test_collected From afar\n"
+        "# TYPE bcp_test_collected gauge\n"
+        'bcp_test_collected{peer="1"} 7.5\n'
+    )
+    assert reg.prometheus_text() == expected
+
+
+def test_snapshot_carries_quantiles_and_buckets():
+    reg = tm.Registry()
+    h = reg.histogram("bcp_test_h", buckets=(1.0, 2.0))
+    h.observe(0.5)
+    h.observe(1.5)
+    snap = reg.snapshot()
+    val = snap["bcp_test_h"]["values"][0]
+    assert val["count"] == 2
+    assert val["buckets"] == {"1.0": 1, "2.0": 1, "+Inf": 0}
+    assert set(val) >= {"p50", "p90", "p99"}
+
+
+def test_registry_rejects_type_redefinition():
+    reg = tm.Registry()
+    reg.counter("bcp_test_x")
+    with pytest.raises(ValueError):
+        reg.gauge("bcp_test_x")
+
+
+def test_off_mode_freezes_metrics(telemetry_mode):
+    telemetry_mode("off")
+    reg = tm.Registry()
+    c = reg.counter("bcp_test_frozen")
+    c.inc(5)
+    assert c._children[()].value == 0  # off = no-op record calls
+    tm.set_mode("counters")
+    c.inc(5)
+    assert c._children[()].value == 5
+
+
+# ---------------------------------------------------------------------------
+# spans: nesting + correlation across the supervised-dispatch boundary
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_parentage(telemetry_mode):
+    telemetry_mode("trace")
+    with tm.span("outer", k=1):
+        with tm.span("inner"):
+            pass
+    evs = {ev["name"]: ev for ev in tm.TRACER.events()}
+    outer, inner = evs["outer"], evs["inner"]
+    assert inner["args"]["corr"] == outer["args"]["corr"]
+    assert inner["args"]["parent"] == outer["args"]["span_id"]
+    assert "parent" not in outer["args"]  # top-level span
+    assert outer["args"]["k"] == 1
+    assert outer["dur"] >= inner["dur"]
+
+
+def test_span_off_mode_is_noop(telemetry_mode):
+    telemetry_mode("counters")
+    with tm.span("nothing"):
+        assert tm.trace_context() is None
+    assert tm.TRACER.events() == []
+
+
+def test_span_correlation_across_thread_handoff(telemetry_mode):
+    telemetry_mode("trace")
+    ctx = {}
+    with tm.span("dispatcher") as sp:
+        ctx["t"] = tm.trace_context()
+        assert ctx["t"] == (sp.corr, sp.span_id)
+
+    def worker():
+        with tm.span("settler", parent=ctx["t"]):
+            pass
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    evs = {ev["name"]: ev for ev in tm.TRACER.events()}
+    disp, settle = evs["dispatcher"], evs["settler"]
+    assert settle["args"]["corr"] == disp["args"]["corr"]
+    assert settle["args"]["parent"] == disp["args"]["span_id"]
+    assert settle["tid"] != disp["tid"]  # genuinely crossed threads
+
+
+def test_supervised_enqueue_settle_correlates_across_threads(
+        telemetry_mode):
+    """The real boundary: supervised_enqueue captures the enqueue span's
+    context into the handle; result() — on ANOTHER thread — opens its
+    settle span with that parent. dumptrace stitches them back together."""
+    from bitcoincashplus_tpu.ops import dispatch
+
+    telemetry_mode("trace")
+    dispatch.reset()
+    try:
+        handle = dispatch.supervised_enqueue(
+            "teletest", lambda: (lambda: 42), cpu_fn=lambda: -1)
+        out = {}
+        t = threading.Thread(target=lambda: out.update(
+            r=handle.result()))
+        t.start()
+        t.join()
+        assert out["r"] == 42 and handle.used_device
+        evs = {ev["name"]: ev for ev in tm.TRACER.events()}
+        enq, settle = evs["dispatch.enqueue"], evs["dispatch.settle"]
+        assert enq["args"]["site"] == settle["args"]["site"] == "teletest"
+        assert settle["args"]["corr"] == enq["args"]["corr"]
+        assert settle["args"]["parent"] == enq["args"]["span_id"]
+        assert settle["tid"] != enq["tid"]
+    finally:
+        dispatch.reset()
+
+
+def test_ring_buffer_bounds_and_chrome_shape(telemetry_mode):
+    telemetry_mode("trace")
+    tracer = tm.Tracer(capacity=8)
+    for i in range(20):
+        with tracer.span("s", i=i):
+            pass
+    st = tracer.stats()
+    assert st["buffered"] == 8 and st["recorded"] == 20
+    assert st["dropped"] == 12
+    trace = tracer.chrome_trace()
+    assert set(trace) >= {"traceEvents", "displayTimeUnit"}
+    for ev in trace["traceEvents"]:
+        assert ev["ph"] == "X"
+        assert {"name", "ts", "dur", "pid", "tid", "args"} <= set(ev)
+    # the ring kept the NEWEST spans
+    assert [ev["args"]["i"] for ev in trace["traceEvents"]] == \
+        list(range(12, 20))
+
+
+def test_dump_roundtrip(tmp_path, telemetry_mode):
+    telemetry_mode("trace")
+    with tm.span("a"):
+        tm.instant("mark", why="test")
+    path = str(tmp_path / "trace.json")
+    n = tm.TRACER.dump(path)
+    data = json.loads(open(path).read())
+    assert len(data["traceEvents"]) == n == 2
+    phases = {ev["ph"] for ev in data["traceEvents"]}
+    assert phases == {"X", "i"}
+
+
+# ---------------------------------------------------------------------------
+# gettpuinfo parity + the new surfaces
+# ---------------------------------------------------------------------------
+
+# the PR-5 gettpuinfo shape: every key here must stay present and equal
+# to its underlying source — telemetry turned the RPC into a superset,
+# never a rewrite
+PR5_KEYS = ("backend", "devices", "ecdsa", "batch", "breakers", "faults",
+            "sigcache", "connectblock", "pipeline", "bip30", "net")
+
+
+def _stub_node():
+    from bitcoincashplus_tpu.validation.sigcache import SignatureCache
+
+    return types.SimpleNamespace(
+        backend="cpu",
+        sigcache=SignatureCache(),
+        chainstate=types.SimpleNamespace(
+            bench={"blocks": 3, "verify_ms": 1.5},
+            pipeline_snapshot=lambda: {"depth": 4, "in_horizon": 0},
+            bip30_stats={"lookups": 9},
+        ),
+        connman=None,
+    )
+
+
+def test_gettpuinfo_parity_and_telemetry_section():
+    from bitcoincashplus_tpu.ops import dispatch, ecdsa_batch
+    from bitcoincashplus_tpu.rpc.control import gettpuinfo
+    from bitcoincashplus_tpu.util import faults
+
+    node = _stub_node()
+    out = gettpuinfo(node, [])
+    for key in PR5_KEYS:
+        assert key in out, f"gettpuinfo lost pre-existing key {key!r}"
+    # equality against the exact sources the PR-5 shape read
+    assert out["batch"] == ecdsa_batch.STATS.snapshot()
+    assert out["breakers"] == dispatch.snapshot()
+    assert out["faults"] == faults.INJECTOR.snapshot()
+    assert out["sigcache"] == node.sigcache.snapshot()
+    assert out["ecdsa"] == ecdsa_batch.kernel_info()
+    assert out["connectblock"] == node.chainstate.bench
+    assert out["pipeline"] == node.chainstate.pipeline_snapshot()
+    assert out["bip30"] == node.chainstate.bip30_stats
+    assert out["net"] == {}
+    # the PR-6 superset: telemetry mode, span stats, accept latency
+    tel = out["telemetry"]
+    assert tel["mode"] in tm.MODES
+    assert {"recorded", "buffered", "dropped"} <= set(tel["spans"])
+    assert {"p50_ms", "p90_ms", "p99_ms", "accepted",
+            "rejected"} <= set(tel["accept_latency"])
+
+
+def test_getmetrics_and_metrics_endpoint_cover_subsystems():
+    """getmetrics + /metrics must expose families for dispatch, ecdsa,
+    pipeline, sigcache, and mempool-accept (net joins once a connman
+    registers its collector — test_connman_tick drives that); the node
+    smoke test (test_telemetry_node) asserts the full set live."""
+    from bitcoincashplus_tpu.rpc.control import getmetrics
+    from bitcoincashplus_tpu.rpc.rest import handle_metrics
+
+    snap = getmetrics(_stub_node(), [])
+    names = set(snap)
+    for prefix in ("bcp_dispatch_latency_seconds", "bcp_ecdsa_",
+                   "bcp_pipeline_", "bcp_mempool_accept_seconds",
+                   "bcp_packer_"):
+        assert any(n.startswith(prefix) for n in names), prefix
+    status, ctype, body = handle_metrics(None)
+    assert status == 200 and ctype.startswith("text/plain")
+    text = body.decode()
+    assert "# TYPE bcp_dispatch_latency_seconds histogram" in text
+    assert "# TYPE bcp_mempool_accept_seconds histogram" in text
+
+
+def test_mempool_accept_latency_lands_in_histogram(telemetry_mode):
+    """The serving-path p50/p99 plumbing: a rejected accept still records
+    an observation (labeled rejected), an accepted one feeds the p50/p99
+    estimate gettpuinfo reports."""
+    from bitcoincashplus_tpu.mempool import accept as accept_mod
+
+    telemetry_mode("counters")
+    acc = accept_mod._ACCEPT_H.labels(result="accepted")
+    rej = accept_mod._ACCEPT_H.labels(result="rejected")
+    base_acc, base_rej = acc.count, rej.count
+
+    class _BoomPool(dict):
+        map_deltas = {}
+
+        def __contains__(self, txid):
+            return False
+
+        def get_spender(self, op):
+            return None
+
+    class _Tip:
+        height = 100
+
+        @staticmethod
+        def get_median_time_past():
+            return 1_600_000_000
+
+    class _Chainstate:
+        class params:
+            require_standard = False
+
+        @staticmethod
+        def tip():
+            return _Tip
+
+    from bitcoincashplus_tpu.consensus.tx import CTransaction
+    from bitcoincashplus_tpu.mempool.mempool import MempoolError
+
+    bad = CTransaction(vin=(), vout=())  # fails check_transaction: empty
+    with pytest.raises(MempoolError):
+        accept_mod.accept_to_memory_pool(_BoomPool(), _Chainstate, bad)
+    assert rej.count == base_rej + 1
+    assert acc.count == base_acc
+    q = accept_mod.accept_latency_quantiles()
+    assert q["rejected"] == rej.count

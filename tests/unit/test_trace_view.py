@@ -1,0 +1,125 @@
+"""tools/trace_view.py — the offline -tracefile summarizer (ISSUE 6
+satellite): per-stage table, measured overlap fraction, top-10 slowest
+settles. Golden-output: the report is deterministic text."""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+
+from tools import trace_view  # noqa: E402
+
+pytestmark = pytest.mark.telemetry
+
+
+def _span(name, ts, dur, **args):
+    return {"name": name, "ph": "X", "ts": ts, "dur": dur,
+            "pid": 1, "tid": 1, "args": args}
+
+
+# A synthetic 3-block pipelined import, microsecond timestamps:
+#   h=1: scan 0..100ms, settle 150..170ms  -> inflight 70ms, blocked 20ms
+#   h=2: scan 100..190ms, settle 250..330ms -> inflight 140ms, blocked 80ms
+#   h=3: scan 190..260ms, settle 330..335ms -> inflight 75ms, blocked 5ms
+EVENTS = [
+    _span("block.scan", 0, 100_000, height=1),
+    _span("block.scan", 100_000, 90_000, height=2),
+    _span("block.scan", 190_000, 70_000, height=3),
+    _span("block.settle", 150_000, 20_000, height=1),
+    _span("block.settle", 250_000, 80_000, height=2),
+    _span("block.settle", 330_000, 5_000, height=3),
+    _span("ecdsa.settle", 150_000, 18_000, lanes=2046),
+    {"name": "block.unwind", "ph": "i", "s": "t", "ts": 400_000,
+     "pid": 1, "tid": 1,
+     "args": {"height": 4, "dropped": 2, "reason": "blk-bad-inputs"}},
+]
+
+GOLDEN = """\
+trace summary: 8 events, 7 spans
+
+per-stage time
+stage                         count    total_ms   mean_ms    p50_ms    p99_ms
+block.scan                        3       260.0     86.67     90.00    100.00
+block.settle                      3       105.0     35.00     20.00     80.00
+ecdsa.settle                      1        18.0     18.00     18.00     18.00
+
+pipeline overlap (block.scan end -> block.settle end)
+blocks measured: 3
+aggregate overlap fraction: 0.6316  (in-flight 285.0 ms, blocked 105.0 ms)
+
+top 10 slowest settles
+  height   settle_ms   overlap
+       2       80.00    0.4286
+       1       20.00    0.7143
+       3        5.00    0.9333
+
+unwinds: 1
+  height 4: dropped 2 block(s) (blk-bad-inputs)
+"""
+
+
+def test_summarize_golden():
+    assert trace_view.summarize(EVENTS) == GOLDEN
+
+
+def test_block_overlap_math():
+    blocks = trace_view.block_overlap(EVENTS)
+    assert [b["height"] for b in blocks] == [1, 2, 3]
+    b1 = blocks[0]
+    # scan end 100ms, settle end 170ms -> 70ms in flight, 20ms blocked
+    assert b1["inflight_ms"] == pytest.approx(70.0)
+    assert b1["settle_ms"] == pytest.approx(20.0)
+    assert b1["overlap"] == pytest.approx(1 - 20.0 / 70.0)
+    # a block missing its settle span (unwound) is skipped
+    partial = [_span("block.scan", 0, 10_000, height=9)]
+    assert trace_view.block_overlap(partial) == []
+
+
+def test_block_overlap_pairs_by_hash_across_unwind():
+    """An unwound block's scan at height 3 must NOT pair with the
+    competing block's settle at the same height — pairing keys on the
+    hash arg when present."""
+    events = [
+        _span("block.scan", 0, 10_000, height=3, hash="aaaa"),   # unwound
+        _span("block.scan", 500_000, 10_000, height=3, hash="bbbb"),
+        _span("block.settle", 520_000, 5_000, height=3, hash="bbbb"),
+    ]
+    blocks = trace_view.block_overlap(events)
+    assert len(blocks) == 1
+    b = blocks[0]
+    # paired with bbbb's scan (end 510ms), not aaaa's (end 10ms):
+    # in-flight = 525 - 510 = 15ms, not 515ms
+    assert b["inflight_ms"] == pytest.approx(15.0)
+    assert b["overlap"] == pytest.approx(1 - 5.0 / 15.0)
+
+
+def test_percentile_nearest_rank():
+    durs = [1.0, 2.0, 3.0, 4.0]
+    assert trace_view.percentile(durs, 0.5) == 2.0
+    assert trace_view.percentile(durs, 0.99) == 4.0
+    assert trace_view.percentile([], 0.5) == 0.0
+
+
+def test_load_accepts_both_dump_forms(tmp_path):
+    wrapped = tmp_path / "w.json"
+    wrapped.write_text(json.dumps({"traceEvents": EVENTS}))
+    bare = tmp_path / "b.json"
+    bare.write_text(json.dumps(EVENTS))
+    assert trace_view.load(str(wrapped)) == EVENTS
+    assert trace_view.load(str(bare)) == EVENTS
+    bad = tmp_path / "x.json"
+    bad.write_text('{"nope": 1}')
+    with pytest.raises((ValueError, KeyError)):
+        trace_view.load(str(bad))
+
+
+def test_main_prints_report(tmp_path, capsys):
+    path = tmp_path / "trace.json"
+    path.write_text(json.dumps({"traceEvents": EVENTS}))
+    assert trace_view.main(["trace_view.py", str(path)]) == 0
+    assert capsys.readouterr().out == GOLDEN
+    assert trace_view.main(["trace_view.py"]) == 2

@@ -1,0 +1,175 @@
+"""Offline summarizer for -tracefile / dumptrace span dumps.
+
+Usage:
+    python tools/trace_view.py <trace.json>
+
+Reads a Chrome-trace/perfetto JSON dump produced by util/telemetry
+(``-tracefile`` at shutdown, or the ``dumptrace`` RPC mid-flight) and
+prints:
+
+  - a per-stage time table (count, total, mean, p50, p99 per span name);
+  - the MEASURED pipeline overlap fraction, per block and aggregate: for
+    every height with both a ``block.scan`` and a ``block.settle`` span,
+    the in-flight window is scan-end -> settle-end (the signature batch
+    is on the device for that whole stretch) and the blocked time is the
+    settle span's duration — overlap = the fraction of the in-flight
+    window the host spent doing useful work instead of waiting;
+  - the top-10 slowest settles (the blocks worth profiling first).
+
+Percentiles are nearest-rank over the raw span durations (exact, no
+interpolation): sorted[ceil(q*n) - 1]. All times are milliseconds.
+
+The report is plain deterministic text (golden-tested by
+tests/unit/test_trace_view.py); pipe it wherever, or load the same JSON
+at ui.perfetto.dev for the interactive view.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+from collections import defaultdict
+
+
+def load(path: str) -> list[dict]:
+    """Events from a dump: accepts both the wrapped {"traceEvents": []}
+    object form and a bare event array."""
+    with open(path) as f:
+        obj = json.load(f)
+    events = obj["traceEvents"] if isinstance(obj, dict) else obj
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: not a Chrome-trace dump")
+    return events
+
+
+def percentile(durs: list[float], q: float) -> float:
+    """Nearest-rank percentile over raw values (exact)."""
+    if not durs:
+        return 0.0
+    s = sorted(durs)
+    return s[min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))]
+
+
+def stage_table(events: list[dict]) -> list[tuple]:
+    """[(name, count, total_ms, mean_ms, p50_ms, p99_ms)], total desc."""
+    by_name: dict[str, list[float]] = defaultdict(list)
+    for ev in events:
+        if ev.get("ph") == "X":
+            by_name[ev["name"]].append(float(ev.get("dur", 0.0)) / 1e3)
+    rows = []
+    for name, durs in by_name.items():
+        total = sum(durs)
+        rows.append((name, len(durs), total, total / len(durs),
+                     percentile(durs, 0.5), percentile(durs, 0.99)))
+    rows.sort(key=lambda r: (-r[2], r[0]))
+    return rows
+
+
+def block_overlap(events: list[dict]) -> list[dict]:
+    """Per-block measured overlap: for each block with one block.scan
+    and one block.settle span, in-flight = settle end - scan end and
+    blocked = the settle span's duration. Returns
+    [{height, scan_ms, settle_ms, inflight_ms, overlap}] height-ordered.
+
+    Pairing keys on the span's ``hash`` arg when present (the pipelined
+    engine stamps both spans with it) and falls back to height — pairing
+    by height alone would marry an UNWOUND block's scan to the competing
+    block's settle at the same height and overstate the in-flight
+    window. Blocks missing either span (unwound blocks never settle) are
+    skipped; a re-scan of the same block keeps the latest pair."""
+    scans: dict[object, dict] = {}
+    settles: dict[object, dict] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args", {})
+        height = args.get("height")
+        if height is None:
+            continue
+        key = args.get("hash", f"h{int(height)}")
+        if ev["name"] == "block.scan":
+            scans[key] = ev
+        elif ev["name"] == "block.settle":
+            settles[key] = ev
+    out = []
+    for key in sorted(
+            set(scans) & set(settles),
+            key=lambda k: int(scans[k]["args"]["height"])):
+        scan, settle = scans[key], settles[key]
+        height = int(scan["args"]["height"])
+        scan_end = float(scan["ts"]) + float(scan.get("dur", 0.0))
+        settle_end = float(settle["ts"]) + float(settle.get("dur", 0.0))
+        inflight = (settle_end - scan_end) / 1e3
+        blocked = float(settle.get("dur", 0.0)) / 1e3
+        if inflight <= 0.0:
+            continue
+        out.append({
+            "height": height,
+            "scan_ms": float(scan.get("dur", 0.0)) / 1e3,
+            "settle_ms": blocked,
+            "inflight_ms": inflight,
+            "overlap": max(0.0, min(1.0, 1.0 - blocked / inflight)),
+        })
+    return out
+
+
+def summarize(events: list[dict]) -> str:
+    """The full text report over one dump."""
+    spans = [ev for ev in events if ev.get("ph") == "X"]
+    lines = [
+        f"trace summary: {len(events)} events, {len(spans)} spans",
+        "",
+        "per-stage time",
+        f"{'stage':<28}{'count':>7}{'total_ms':>12}{'mean_ms':>10}"
+        f"{'p50_ms':>10}{'p99_ms':>10}",
+    ]
+    for name, count, total, mean, p50, p99 in stage_table(events):
+        lines.append(
+            f"{name:<28}{count:>7}{total:>12.1f}{mean:>10.2f}"
+            f"{p50:>10.2f}{p99:>10.2f}")
+
+    blocks = block_overlap(events)
+    lines += ["", "pipeline overlap (block.scan end -> block.settle end)"]
+    if not blocks:
+        lines.append("no block.scan/block.settle pairs in this trace")
+    else:
+        inflight = sum(b["inflight_ms"] for b in blocks)
+        blocked = sum(b["settle_ms"] for b in blocks)
+        agg = max(0.0, min(1.0, 1.0 - blocked / inflight)) if inflight \
+            else 0.0
+        lines.append(f"blocks measured: {len(blocks)}")
+        lines.append(
+            f"aggregate overlap fraction: {agg:.4f}  "
+            f"(in-flight {inflight:.1f} ms, blocked {blocked:.1f} ms)")
+        lines += ["", "top 10 slowest settles",
+                  f"{'height':>8}{'settle_ms':>12}{'overlap':>10}"]
+        slowest = sorted(blocks, key=lambda b: (-b["settle_ms"],
+                                                b["height"]))[:10]
+        for b in slowest:
+            lines.append(f"{b['height']:>8}{b['settle_ms']:>12.2f}"
+                         f"{b['overlap']:>10.4f}")
+
+    unwinds = [ev for ev in events
+               if ev.get("ph") == "i" and ev.get("name") == "block.unwind"]
+    if unwinds:
+        lines += ["", f"unwinds: {len(unwinds)}"]
+        for ev in unwinds:
+            a = ev.get("args", {})
+            lines.append(
+                f"  height {a.get('height')}: dropped {a.get('dropped')} "
+                f"block(s) ({a.get('reason')})")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) != 2:
+        print(__doc__.strip().splitlines()[0], file=sys.stderr)
+        print(f"usage: {argv[0]} <trace.json>", file=sys.stderr)
+        return 2
+    sys.stdout.write(summarize(load(argv[1])))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))
