@@ -61,6 +61,13 @@ _ACCEPT_REJECTS = tm.counter(
     "Transactions rejected at mempool admission")
 
 
+class _StaleContext(Exception):
+    """The validation context moved while cs_main was released for the
+    SigService verdict wait (tip advanced, or an in-pool parent vanished)
+    — the whole acceptance re-runs from scratch (accept_to_memory_pool's
+    retry loop; the final attempt is synchronous and cannot go stale)."""
+
+
 def accept_latency_quantiles() -> dict:
     """gettpuinfo's serving-path latency view: p50/p90/p99 (ms) of
     ACCEPTED transactions, plus accept/reject tallies."""
@@ -108,10 +115,20 @@ def verify_tx_scripts(
     flags: int,
     sigcache: Optional[SignatureCache] = None,
     backend: str = "cpu",
+    sig_service=None,
+    wait_ctx=None,
 ) -> None:
     """CheckInputs (src/validation.cpp:~1300) for a single transaction:
     run the interpreter per input, settle deferred sigchecks in one batch,
-    insert fresh successes into the sigcache. Raises MempoolError."""
+    insert fresh successes into the sigcache. Raises MempoolError.
+
+    With a ``sig_service`` (serving/sigservice.SigService) the deferred
+    records are enqueued into the shared micro-batching lanes and the
+    per-tx future is awaited — inside ``wait_ctx()`` when supplied, so
+    the caller's cs_main hold can be released while the verdict is in
+    flight (concurrent accepts then share one device bucket). The
+    service populates the sigcache at settle; verdicts are identical to
+    the synchronous path by construction (same records, same engines)."""
     records: list[SigCheckRecord] = []
     cache = SighashCache(tx)
     for i, (txin, coin) in enumerate(zip(tx.vin, spent_coins)):
@@ -131,6 +148,21 @@ def verify_tx_scripts(
         SignatureCache.entry_key(r.msg_hash, r.r, r.s, r.pubkey)
         for r in records
     ]
+    if sig_service is not None:
+        fut = sig_service.submit(records, keys)
+        if wait_ctx is not None:
+            with wait_ctx():
+                ok = fut.result()
+        else:
+            ok = fut.result()
+        for k, good in enumerate(ok):
+            if not good:
+                raise MempoolError(
+                    "mandatory-script-verify-flag-failed",
+                    f"signature verification failed input "
+                    f"{records[k].in_idx}",
+                )
+        return  # sigcache populated by the service at settle
     if sigcache is not None:
         fresh = [k for k, key in enumerate(keys) if not sigcache.contains(key)]
     else:
@@ -160,17 +192,34 @@ def accept_to_memory_pool(
     backend: str = "cpu",
     now: Optional[int] = None,
     ancestor_limits: Optional[dict] = None,
+    sig_service=None,
+    wait_ctx=None,
 ) -> MempoolEntry:
     """AcceptToMemoryPool (src/validation.cpp:~400). Returns the entry on
     success; raises MempoolError with the reference's reject reason.
     Per-tx wall-clock lands in the bcp_mempool_accept_seconds histogram
-    (p50/p99 via gettpuinfo.telemetry.accept_latency)."""
+    (p50/p99 via gettpuinfo.telemetry.accept_latency).
+
+    ``sig_service``/``wait_ctx`` route the signature verdict through the
+    micro-batching SigService with the caller's lock released during the
+    wait; a context change in that window (tip moved, in-pool parent
+    evicted) raises _StaleContext internally and the acceptance re-runs —
+    the FINAL attempt synchronously, which cannot go stale, so the
+    verdict always lands and is identical to the service-off path."""
     t0 = _time.monotonic()
     with tm.span("mempool.accept", txid=tx.txid_hex):
         try:
-            entry = _accept_to_memory_pool_inner(
-                pool, chainstate, tx, sigcache, require_standard,
-                min_fee_rate, backend, now, ancestor_limits)
+            # serviced attempts first; a last synchronous attempt bounds
+            # the retry loop (no unlock window => no staleness possible)
+            for svc in (sig_service, sig_service, None):
+                try:
+                    entry = _accept_to_memory_pool_inner(
+                        pool, chainstate, tx, sigcache, require_standard,
+                        min_fee_rate, backend, now, ancestor_limits,
+                        sig_service=svc, wait_ctx=wait_ctx)
+                    break
+                except _StaleContext:
+                    continue
         except MempoolError:
             _ACCEPT_H.labels(result="rejected").observe(
                 _time.monotonic() - t0)
@@ -190,6 +239,8 @@ def _accept_to_memory_pool_inner(
     backend: str,
     now: Optional[int],
     ancestor_limits: Optional[dict],
+    sig_service=None,
+    wait_ctx=None,
 ) -> MempoolEntry:
     params = chainstate.params
     if require_standard is None:
@@ -269,7 +320,29 @@ def _accept_to_memory_pool_inner(
                                            **(ancestor_limits or {}))
 
     flags = standard_script_flags(params, height)
-    verify_tx_scripts(tx, spent_coins, flags, sigcache, backend=backend)
+    verify_tx_scripts(tx, spent_coins, flags, sigcache, backend=backend,
+                      sig_service=sig_service, wait_ctx=wait_ctx)
+    if sig_service is not None and wait_ctx is not None:
+        # cs_main may have been released during the SigService verdict
+        # wait — every pool/chain fact above is a pre-wait snapshot.
+        # Re-derive the cheap context; anything that moved retries the
+        # whole acceptance (the sigcache now holds the verdicts, so the
+        # re-run's verify is pure cache hits).
+        if chainstate.tip() is not tip:
+            raise _StaleContext
+        if txid in pool:
+            raise MempoolError("txn-already-in-mempool")
+        for txin in tx.vin:
+            spender = pool.get_spender(txin.prevout)
+            if spender is not None:
+                raise MempoolError("txn-mempool-conflict")
+        for txin, coin in zip(tx.vin, spent_coins):
+            if (coin.height == MEMPOOL_HEIGHT
+                    and pool.get_output(txin.prevout) is None):
+                raise _StaleContext  # in-pool parent vanished mid-wait
+        # the ancestor package may have grown while unlocked
+        ancestors = pool.check_ancestor_limits(tx, fee,
+                                               **(ancestor_limits or {}))
 
     entry = MempoolEntry(
         tx,

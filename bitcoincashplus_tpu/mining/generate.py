@@ -28,20 +28,28 @@ def mine_block(assembler: BlockAssembler, script_pubkey: bytes,
                max_tries: int = MAX_TRIES_DEFAULT,
                tile: int = DEFAULT_TILE,
                sweep=None,
-               time_override: Optional[int] = None) -> Optional[CBlock]:
+               time_override: Optional[int] = None,
+               extranonce_start: int = 0) -> Optional[CBlock]:
     """Assemble + PoW-search one block. Returns the mined block or None if
     max_tries hashes were exhausted. `sweep` is injectable (single-chip
     default; parallel.nonce_shard.sweep_header_sharded for a mesh); the
     default is the SUPERVISED single-chip sweep (ops/dispatch): a claimed
     hit is host re-verified and a dead device degrades to the scalar CPU
-    loop under the miner circuit breaker."""
+    loop under the miner circuit breaker.
+
+    ``extranonce_start`` seeds the coinbase extranonce counter: two nodes
+    assembling from the same parent with the same payout script and a
+    MTP-pinned header time would otherwise mine byte-identical blocks
+    (sub-second regtest mining made that collision real — the node layer
+    passes per-block entropy; the default 0 keeps unit-test chains
+    deterministic)."""
     if sweep is None:
         sweep = supervised_sweep()
     tmpl = assembler.create_new_block(script_pubkey, time_override)
     height, target = tmpl.height, tmpl.target
     block = tmpl.block
     tries_left = max_tries
-    extranonce = 0
+    extranonce = extranonce_start
     while tries_left > 0:
         extranonce += 1
         block = increment_extranonce(block, height, extranonce)

@@ -61,6 +61,17 @@ Options:
                          ladder + fixed-base G comb (default), w4 = the
                          64-window kernel (kept as oracle/fallback); unknown
                          values are rejected at startup
+  -sigservice=<on|off>   Run the always-on micro-batching signature service:
+                         mempool ingest and tip relay enqueue script checks
+                         into shared device lanes behind a flush deadline
+                         (default: on; off = synchronous verification,
+                         verdicts identical)
+  -sigservicedeadline=<ms>  Max milliseconds a partial signature bucket may
+                         wait for more lanes before flushing (default: 4;
+                         0 = flush on every enqueue)
+  -sigservicelanes=<n>   Signature-service bucket size in lanes (default:
+                         2046 — fills the 2048 device bucket with the two
+                         known-answer probe lanes)
   -port=<port>           Listen for P2P connections on <port>
   -listen                Accept P2P connections from outside (default: 1 when P2P enabled)
   -connect=<ip:port>     Connect only to the specified node (may be repeated)

@@ -15,6 +15,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from contextlib import contextmanager
 from typing import Optional
 
 from ..consensus.block import CBlock
@@ -215,6 +216,31 @@ class Node:
             self.params, self.coins_db, self.block_store,
             script_verifier=verifier, index_db=self.index_db,
         )
+        # -sigservice=<on|off> / -sigservicedeadline=<ms> /
+        # -sigservicelanes=<n>: the always-on micro-batching signature
+        # service (serving/sigservice). Default ON — with the service off
+        # every caller runs the unchanged synchronous path (verdicts
+        # identical by construction). Validated here: junk must fail init,
+        # not surface at the first transaction.
+        svc_mode = config.get("sigservice", "on")
+        if svc_mode not in ("on", "off", "1", "0"):
+            raise ConfigError(
+                f"-sigservice={svc_mode!r}: must be on or off")
+        self.sigservice = None
+        if svc_mode in ("on", "1"):
+            from ..serving import SigService
+
+            try:
+                self.sigservice = SigService(
+                    sigcache=self.sigcache,
+                    backend="cpu" if backend == "cpu" else "auto",
+                    kernel=self.ecdsa_kernel,
+                    deadline_ms=config.get_int("sigservicedeadline", 4),
+                    lanes=config.get_int("sigservicelanes", 2046),
+                ).start()
+            except ValueError as e:
+                raise ConfigError(str(e)) from None
+            self.chainstate.sig_service = self.sigservice
         # -pipelinedepth=<n>: settle-horizon depth for the Python IBD
         # engine — up to n blocks speculatively connected while their
         # signature batches are in flight (1 = serial; see README
@@ -260,6 +286,8 @@ class Node:
         telemetry.register_collector("sigcache", self._sigcache_families)
         telemetry.register_collector("pipeline", self._pipeline_families)
         telemetry.register_collector("mempool", self._mempool_families)
+        if self.sigservice is not None:
+            telemetry.register_collector("serving", self._serving_families)
         # P2P adversarial-supervision limits (p2p/connman.py): the
         # ban-score discharge threshold, the block-download stall timeout,
         # the supervision tick cadence, the per-peer receive-rate ceiling
@@ -386,6 +414,24 @@ class Node:
             help="BIP30 pre-scan fast-path counters")
         return out
 
+    def _serving_families(self) -> list:
+        snap = self.sigservice.snapshot()
+        # queue_depth excluded: the native bcp_sigservice_queue_depth
+        # gauge owns that name (re-emitting it here would duplicate the
+        # family with a conflicting TYPE — the PR 6 in_flight lesson).
+        # typ="gauge" like the sibling sigcache collector: the snapshot
+        # mixes monotonic tallies with genuinely non-monotonic values
+        # (priority_depth, inflight_keys) and config scalars — a TYPE of
+        # counter would make rate()/increase() fabricate resets on every
+        # decrease.
+        snap.pop("queue_depth", None)
+        scalars = {k: v for k, v in snap.items()
+                   if isinstance(v, (int, float)) and not isinstance(v, bool)}
+        return telemetry.flat_families(
+            "bcp_sigservice", scalars, typ="gauge",
+            help="serving/sigservice micro-batching state (flush reasons, "
+                 "dedup/cache hits, preemptions, config)")
+
     def _mempool_families(self) -> list:
         return [
             {"name": "bcp_mempool_size", "type": "gauge",
@@ -462,8 +508,11 @@ class Node:
         # (reference: DisconnectTip -> mempool resurrection)
         for tx in block.vtx[1:]:
             try:
-                # resurrection: entry height unknowable -> no fee sample
-                self.accept_to_mempool(tx, fee_estimate=False)
+                # resurrection: entry height unknowable -> no fee sample;
+                # use_service=False — this runs mid-disconnect and must
+                # never release cs_main around the verdict
+                self.accept_to_mempool(tx, fee_estimate=False,
+                                       use_service=False)
             except MempoolError:
                 pass  # no-longer-valid txs just drop
 
@@ -487,14 +536,39 @@ class Node:
 
     # -- mempool entry point -------------------------------------------
 
+    @contextmanager
+    def _verify_wait(self):
+        """SigService verdict-wait window: release cs_main (when held by
+        this thread, exactly one level deep) so concurrent accepts can
+        scan and share the in-flight device bucket; reacquire before the
+        caller resumes. A deeper re-entrant hold just skips the release —
+        correct (the post-wait stale-context re-check finds an unchanged
+        world), only less concurrent."""
+        released = False
+        try:
+            self.cs_main.release()
+            released = True
+        except RuntimeError:
+            pass  # not held by us — nothing to release
+        try:
+            yield
+        finally:
+            if released:
+                self.cs_main.acquire()
+
     def accept_to_mempool(self, tx, now: Optional[int] = None,
-                          fee_estimate: bool = True):
+                          fee_estimate: bool = True,
+                          use_service: bool = True):
         """AcceptToMemoryPool with this node's policy knobs; caller holds
         cs_main (or is single-threaded). fee_estimate=False for replayed
         txs (mempool.dat reload, reorg resurrection) — their true entry
         height is unknown, and counting them from the current tip would
         bias tight-target estimates low (the reference's
-        validFeeEstimate=false)."""
+        validFeeEstimate=false). use_service=False keeps the verdict
+        synchronous AND the lock held throughout — required on the reorg
+        resurrection path, where releasing cs_main mid-disconnect would
+        expose half-reorged chainstate to other threads."""
+        svc = self.sigservice if use_service else None
         entry = accept_to_memory_pool(
             self.mempool, self.chainstate, tx,
             sigcache=self.sigcache,
@@ -502,6 +576,8 @@ class Node:
             backend="cpu" if self.backend == "cpu" else "auto",
             now=now,
             ancestor_limits=self.ancestor_limits,
+            sig_service=svc,
+            wait_ctx=self._verify_wait if svc is not None else None,
         )
         # fee estimator: track entry height + what the tx actually pays
         # (base fee, not prioritisetransaction-modified fees)
@@ -536,7 +612,15 @@ class Node:
         XLA compile is pathologically slow (ops/sha256._use_unrolled).
         Either choice runs under miner-breaker supervision
         (ops/dispatch.supervised_sweep): failures degrade to the scalar
-        host loop without stalling block production."""
+        host loop without stalling block production.
+
+        Regtest on a CPU backend takes the scalar host loop DIRECTLY: the
+        trivial target hits within a couple of nonces, so the batched
+        sweep's per-dispatch latency (~160 ms of device round-trip per
+        block on the CPU jit) dominates a ~2-hash search — generatetoaddress
+        at functional-test scale was paying minutes of pure dispatch
+        overhead. Real networks keep the batched sweep, where throughput,
+        not latency, is what matters."""
         from ..ops.dispatch import supervised_sweep
 
         inner = None
@@ -547,6 +631,14 @@ class Node:
                 from ..ops.sha256_sweep import sweep_header_fast
 
                 inner = sweep_header_fast
+            elif self.params.network == "regtest":
+                from ..ops.miner import sweep_header_cpu
+
+                def inner(header80, target, start_nonce=0,
+                          max_nonces=1 << 32, tile=None):
+                    return sweep_header_cpu(header80, target,
+                                            start_nonce=start_nonce,
+                                            max_nonces=max_nonces)
         except Exception:
             pass
         return supervised_sweep(inner)
@@ -558,8 +650,14 @@ class Node:
         asm = self.assembler()
         sweep = self._select_sweep()
         for _ in range(n_blocks):
+            # per-block extranonce entropy: with sub-second mining the
+            # header time pins to MTP+1, and two nodes extending the same
+            # parent toward the same script would otherwise assemble
+            # byte-identical blocks — a reorg race that never forks
             block = mine_block(asm, script_pubkey, max_tries=max_tries,
-                               sweep=sweep)
+                               sweep=sweep,
+                               extranonce_start=int.from_bytes(
+                                   os.urandom(4), "little"))
             if block is None:
                 break
             self.chainstate.process_new_block(block)
@@ -671,6 +769,7 @@ class Node:
             script_verifier=verifier, index_db=self.index_db,
         )
         self.chainstate.pipeline_depth = self.pipeline_depth
+        self.chainstate.sig_service = self.sigservice
         self.chainstate.load_block_index()
 
     def _import_block_files_native(self) -> int:
@@ -1501,6 +1600,10 @@ class Node:
         if self.connman is not None:
             self.connman.close()
             self.connman = None
+        if self.sigservice is not None:
+            # drain pending lanes before the stores close (a late settle
+            # still inserts into the in-memory sigcache — harmless)
+            self.sigservice.stop()
         with self.cs_main:
             if self.persist_mempool:
                 from ..mempool.persist import dump_mempool
@@ -1524,7 +1627,7 @@ class Node:
         # otherwise keep the closed node's whole object graph (coins
         # cache, mempool, block index) alive in the process-global
         # REGISTRY for the rest of the process
-        for name in ("sigcache", "pipeline", "mempool"):
+        for name in ("sigcache", "pipeline", "mempool", "serving"):
             telemetry.REGISTRY.unregister_collector(name)
         if self.tracefile:
             # -tracefile: the span ring buffer as Chrome/perfetto JSON,

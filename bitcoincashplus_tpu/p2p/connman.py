@@ -1611,6 +1611,18 @@ class CConnman:
         h = block.get_hash()
         peer.known_invs.add(h)
         with self.node.cs_main:
+            # tip-relay serving (serving/sigservice): a reconstructed
+            # block's non-mempool transactions get their sigchecks settled
+            # through the shared service lanes first, so the connect below
+            # probes them out of the sigcache instead of verifying inline.
+            # Advisory only — prewarm gates itself (tip extension, live
+            # mempool, REAL header PoW, merkle commitment) so garbage
+            # bodies never buy interpreter time, and the connect stays
+            # the authoritative verdict either way.
+            if getattr(self.node, "sigservice", None) is not None:
+                from ..serving import prewarm_block_sigs
+
+                prewarm_block_sigs(self.node, block)
             try:
                 self.node.chainstate.process_new_block(block)
                 self._block_sources.pop(h, None)  # landed — tracking done

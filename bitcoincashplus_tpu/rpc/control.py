@@ -101,9 +101,12 @@ def gettpuinfo(node, params):
     pipelined-IBD settle horizon (``pipeline``: depth/occupancy, per-leg
     times, unwind count, cross-block lane fill and overlap fraction), the
     BIP30 pre-scan fast-path counters (``bip30``), the active
-    backend/device, and — when P2P is running — the peer-supervision
-    ledger (``net``: misbehavior charges, discharge reasons, stall
-    re-requests, flood charges, orphan pool accounting, banlist size)."""
+    backend/device, the always-on signature service (``serving``: flush
+    reasons, queue depth, dedup/cache hits, import-priority preemptions,
+    enqueue->verdict wait quantiles), and — when P2P is running — the
+    peer-supervision ledger (``net``: misbehavior charges, discharge
+    reasons, stall re-requests, flood charges, orphan pool accounting,
+    banlist size)."""
     from ..ops import dispatch, ecdsa_batch
     from ..util import faults
 
@@ -137,6 +140,13 @@ def gettpuinfo(node, params):
         "bip30": dict(getattr(node.chainstate, "bip30_stats", {})),
         "net": (node.connman.net_snapshot()
                 if getattr(node, "connman", None) is not None else {}),
+        # the always-on signature service (serving/sigservice): flush
+        # reasons, queue depth, dedup/cache hits, preemptions, and the
+        # enqueue->verdict wait quantiles; {"enabled": False} when
+        # -sigservice=off
+        "serving": (node.sigservice.snapshot()
+                    if getattr(node, "sigservice", None) is not None
+                    else {"enabled": False}),
         # unified-telemetry view (util/telemetry): the active level, span
         # ring-buffer occupancy, and the serving path's p50/p90/p99
         # mempool accept latency (the registry's histogram — getmetrics /

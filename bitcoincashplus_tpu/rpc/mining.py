@@ -57,6 +57,17 @@ def getblocktemplate(node, params):
                 return "inconclusive-not-best-prevblk"
             from ..validation.chainstate import BlockValidationError
 
+            # proposal re-validation rides the signature service: any
+            # non-mempool transactions in the proposed block settle
+            # through the shared lanes first, so TestBlockValidity's
+            # script pass is sigcache hits (serving/sigservice).
+            # require_pow=False: proposals are legitimately unmined and
+            # the RPC surface is local/authenticated; the merkle gate
+            # inside prewarm still applies
+            if getattr(node, "sigservice", None) is not None:
+                from ..serving import prewarm_block_sigs
+
+                prewarm_block_sigs(node, block, require_pow=False)
             try:
                 cs.test_block_validity(block)
             except BlockValidationError as e:

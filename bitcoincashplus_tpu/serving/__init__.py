@@ -1,0 +1,14 @@
+"""Always-on signature serving (ISSUE 7).
+
+The persistent micro-batching SigService generalizes the pipelined IBD
+engine's cross-block LanePacker into a serving front-end for live
+traffic: mempool acceptance, compact-block reconstruction, and
+getblocktemplate re-validation enqueue per-input script checks into
+shared device lanes and await per-tx futures.
+"""
+
+from .sigservice import (  # noqa: F401
+    SigService,
+    TxSigFuture,
+    prewarm_block_sigs,
+)

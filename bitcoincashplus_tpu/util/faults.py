@@ -237,6 +237,15 @@ class ChaosSchedule:
     def randint(self, lo: int, hi: int) -> int:
         return self._rng.randint(lo, hi)
 
+    def shuffle(self, items: list) -> list:
+        """In-place seeded shuffle (tx-storm delivery order); returns the
+        list for chaining."""
+        self._rng.shuffle(items)
+        return items
+
+    def rand(self) -> float:
+        return self._rng.random()
+
 
 def retry_call(fn, attempts: int = 3, backoff: Optional[Backoff] = None,
                retry_on: tuple = (Exception,), sleep=time.sleep):

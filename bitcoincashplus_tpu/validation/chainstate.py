@@ -130,6 +130,10 @@ class ChainstateManager:
         self.pipeline_depth = 1
         self._horizon: list[dict] = []
         self._packer = None  # ops/ecdsa_batch.LanePacker, built lazily
+        # serving/sigservice.SigService (node wires it): block connects
+        # run under its import_priority() so live mempool lanes dispatch
+        # on the CPU lane while the block's own batches own the device
+        self.sig_service = None
         self._settling = False  # reentrancy guard (flush <-> settle hooks)
         self.pipeline_stats = {
             "settled_blocks": 0, "unwinds": 0, "unwound_blocks": 0,
@@ -729,9 +733,20 @@ class ChainstateManager:
         block was accepted into the tree (not necessarily the active chain).
         Raises BlockValidationError for invalid blocks (callers that need
         the reference's bool-only contract catch it)."""
-        self.accept_block(block)
-        self.activate_best_chain()
+        with self._import_priority():
+            self.accept_block(block)
+            self.activate_best_chain()
         return True
+
+    def _import_priority(self):
+        """Block-import preemption over the live signature service: while
+        a connect is in flight, mempool lanes take the CPU path so the
+        block's own batches keep the device (serving/sigservice)."""
+        if self.sig_service is not None:
+            return self.sig_service.import_priority()
+        from contextlib import nullcontext
+
+        return nullcontext()
 
     # ------------------------------------------------------------------
     # pipelined connect — the IBD settle horizon (overlaps the host scan,
@@ -770,6 +785,10 @@ class ChainstateManager:
         raise/return contract as process_new_block."""
         if self.pipeline_depth <= 1:
             return self.process_new_block(block)
+        with self._import_priority():
+            return self._process_new_block_pipelined_inner(block)
+
+    def _process_new_block_pipelined_inner(self, block: CBlock) -> bool:
         idx = self.accept_block(block)
         # backpressure: bound the horizon BEFORE connecting another block
         while len(self._horizon) >= self.pipeline_depth:

@@ -17,6 +17,7 @@ first; hit/miss/insert/eviction counters feed gettpuinfo.sigcache.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Optional
 
@@ -31,10 +32,21 @@ class SignatureCache:
         self.max_entries = max_entries
         self.max_bytes = max_bytes  # None = entry cap only
         self._set: OrderedDict[bytes, None] = OrderedDict()
+        # the SigService settle thread inserts verdicts concurrently with
+        # accept/connect threads probing under cs_main: the compound
+        # probe (membership + move_to_end) and insert (set + evict) are
+        # NOT GIL-atomic — an unguarded probe could move_to_end a key the
+        # settle thread's eviction just popped (KeyError out of a valid
+        # block's validation)
+        self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
         self.inserts = 0
         self.evictions = 0
+        # serving-path in-flight dedup (serving/sigservice): records that
+        # missed the cache but joined an already-in-flight lane for the
+        # same (sighash, r, s, pubkey) key — verified once, served twice
+        self.service_dedup_hits = 0
 
     @staticmethod
     def entry_key(msg_hash: int, r: int, s: int, pubkey: tuple) -> bytes:
@@ -46,13 +58,19 @@ class SignatureCache:
             + (pubkey[1] & 1).to_bytes(1, "big")
         )
 
+    def note_dedup(self) -> None:
+        """A SigService in-flight dedup hit (the cache itself missed, but
+        the verdict was already being computed)."""
+        self.service_dedup_hits += 1
+
     def contains(self, key: bytes) -> bool:
-        if key in self._set:
-            self.hits += 1
-            self._set.move_to_end(key)  # LRU refresh
-            return True
-        self.misses += 1
-        return False
+        with self._lock:
+            if key in self._set:
+                self.hits += 1
+                self._set.move_to_end(key)  # LRU refresh
+                return True
+            self.misses += 1
+            return False
 
     def _over_budget(self) -> bool:
         if len(self._set) > self.max_entries:
@@ -61,13 +79,14 @@ class SignatureCache:
                 and len(self._set) * ENTRY_COST_BYTES > self.max_bytes)
 
     def add(self, key: bytes) -> None:
-        if key not in self._set:
-            self.inserts += 1
-        self._set[key] = None
-        self._set.move_to_end(key)
-        while self._set and self._over_budget():
-            self._set.popitem(last=False)  # stalest first
-            self.evictions += 1
+        with self._lock:
+            if key not in self._set:
+                self.inserts += 1
+            self._set[key] = None
+            self._set.move_to_end(key)
+            while self._set and self._over_budget():
+                self._set.popitem(last=False)  # stalest first
+                self.evictions += 1
 
     def estimated_bytes(self) -> int:
         return len(self._set) * ENTRY_COST_BYTES
@@ -84,6 +103,7 @@ class SignatureCache:
             "misses": self.misses,
             "inserts": self.inserts,
             "evictions": self.evictions,
+            "service_dedup_hits": self.service_dedup_hits,
             "hit_rate": round(self.hits / probes, 4) if probes else 0.0,
         }
 

@@ -55,15 +55,18 @@ def pytest_collection_modifyitems(config, items):
     (group 0) on purpose — fast, ordered with the unit run. The
     ``telemetry`` suite runs after ``pipeline`` (its registry-zeroing
     fixture must not interleave with suites asserting on live counters)
-    and before the functional groups. Stable sort: order within each
-    group is unchanged."""
+    and the ``serving`` suite (SigService flush policy / serviced-accept
+    differentials) after ``telemetry``, both before the functional
+    groups. Stable sort: order within each group is unchanged."""
 
     def group(item) -> int:
         if "functional" not in str(item.fspath):
+            if item.get_closest_marker("serving"):
+                return 3
             if item.get_closest_marker("telemetry"):
                 return 2
             return 1 if item.get_closest_marker("pipeline") else 0
-        return 4 if item.get_closest_marker("adversarial") else 3
+        return 5 if item.get_closest_marker("adversarial") else 4
 
     items.sort(key=group)
 

@@ -187,18 +187,25 @@ class ChaosPeer(threading.Thread):
                       silent, and disconnect/reconnect at scripted points
                       (accumulates graduated non-connecting-headers
                       charges)
+      - ``txstorm`` — sustained tx flood: replay the supplied raw
+                      transactions at ~``tx_rate``/s in seeded-shuffled
+                      order with seeded pacing jitter (out-of-order
+                      delivery exercises the orphan pool; the mempool
+                      accept path absorbs the load — the ISSUE 7 serving
+                      workload)
 
     The thread records ``evicted`` (the node closed the connection) and
     ``rounds_done`` for assertions; ``stop()`` ends the campaign."""
 
     def __init__(self, p2p_port: int, behavior: str, seed: int = 0,
                  headers: list[bytes] | None = None,
-                 rounds: int | None = None, flood_payload: int = 262_144):
+                 rounds: int | None = None, flood_payload: int = 262_144,
+                 txs: list[bytes] | None = None, tx_rate: float = 200.0):
         super().__init__(daemon=True, name=f"chaos-{behavior}-{seed}")
         from bitcoincashplus_tpu.consensus.params import regtest_params
         from bitcoincashplus_tpu.util.faults import ChaosSchedule
 
-        assert behavior in ("flood", "stall", "garbage"), behavior
+        assert behavior in ("flood", "stall", "garbage", "txstorm"), behavior
         self.magic = regtest_params().netmagic
         self.port = p2p_port
         self.behavior = behavior
@@ -206,6 +213,8 @@ class ChaosPeer(threading.Thread):
         self.headers = list(headers or [])  # raw 80-byte header blobs
         self.rounds = rounds if rounds is not None else default_chaos_rounds()
         self.flood_payload = flood_payload
+        self.txs = list(txs or [])  # raw serialized transactions
+        self.tx_rate = tx_rate
         self.evicted = False
         self.rounds_done = 0
         self.error: BaseException | None = None
@@ -326,6 +335,23 @@ class ChaosPeer(threading.Thread):
         while not self._halt.is_set():
             self._drain(0.5)  # read getdata/pings, answer nothing
             self.rounds_done += 1
+
+    def _run_txstorm(self) -> None:
+        """Drive the supplied transactions at the target rate in a
+        seeded-shuffled order. The SAME (seed, txs) pair replays the
+        identical storm against a control node — the zero-divergence
+        assertion the serving flood test is built on."""
+        order = self.schedule.shuffle(list(self.txs))
+        interval = 1.0 / max(self.tx_rate, 1e-6)
+        for raw in order:
+            if self._halt.is_set():
+                return
+            self._send("tx", raw)
+            self.rounds_done += 1
+            # seeded jitter around the nominal rate (bursts + gaps, same
+            # shape on every node fed this seed)
+            time.sleep(interval * (0.5 + self.schedule.rand()))
+        self._drain(0.5)  # let the node chew; collect rejects/pings
 
     def _run_garbage(self) -> None:
         """Replay garbage on a schedule: valid-PoW headers on unknown

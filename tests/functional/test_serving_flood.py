@@ -1,0 +1,149 @@
+"""Sustained tx-flood serving harness (ISSUE 7 satellite).
+
+Two real bcpd nodes on the same 110-block regtest chain: node0 runs the
+always-on SigService (the default), node1 is the `-sigservice=off`
+synchronous control. A seeded ChaosPeer ``txstorm`` drives the IDENTICAL
+transaction storm (same seed => same shuffled order, same pacing jitter)
+at both nodes, including out-of-order child-before-parent deliveries
+that bounce through the orphan pool.
+
+Asserts:
+  * zero verdict divergence — both mempools converge to the same txid
+    set, and a block mined over the serviced mempool connects on the
+    control node (identical chainstate by block-hash identity);
+  * the PR 6 accept-latency histogram measured the storm (p99 under a
+    CI-safe budget, accepted count == storm size) on the serviced node;
+  * the serving surface reports the work (dispatches, flush reasons).
+"""
+
+import pytest
+
+from .framework import ChaosPeer, FunctionalFramework, wait_until
+
+pytestmark = [pytest.mark.functional, pytest.mark.serving]
+
+N_COINS = 4          # mature coinbases spent by the storm
+N_BLOCKS = 104       # N_COINS + coinbase maturity (100) headroom
+TX_RATE = 150.0      # offered load, tx/s nominal
+STORM_SEED = 1107
+P99_BUDGET_MS = 1500.0  # CI-safe: CPU-lower-bound accepts are ~ms-scale
+
+
+def _build_storm_txs(node):
+    """parent+child spend chains over the first N_COINS coinbases (all
+    keys known to the test): 2*N_COINS raw transactions."""
+    from bitcoincashplus_tpu.consensus.block import CBlock
+    from bitcoincashplus_tpu.consensus.tx import (
+        COutPoint,
+        CTransaction,
+        CTxIn,
+        CTxOut,
+    )
+    from bitcoincashplus_tpu.wallet.keys import CKey
+    from bitcoincashplus_tpu.wallet.signing import sign_transaction
+
+    key = CKey(0x53657276)
+    spk = key.p2pkh_script()
+
+    def spend(op, value, fee=10_000, n_out=1):
+        per_out = (value - fee) // n_out
+        tx = CTransaction(
+            vin=(CTxIn(op, b""),),
+            vout=tuple(CTxOut(per_out, spk) for _ in range(n_out)),
+        )
+        return sign_transaction(
+            tx, [(spk, value)],
+            lambda h: key if h == key.pubkey_hash else None,
+            enable_forkid=True,
+        )
+
+    txs = []
+    expected = set()
+    for height in range(1, N_COINS + 1):
+        raw = bytes.fromhex(
+            node.rpc.getblock(node.rpc.getblockhash(height), 0))
+        cb = CBlock.from_bytes(raw).vtx[0]
+        parent = spend(COutPoint(cb.txid, 0), cb.vout[0].value, n_out=2)
+        child = spend(COutPoint(parent.txid, 0), parent.vout[0].value)
+        for tx in (parent, child):
+            txs.append(tx.serialize())
+            expected.add(tx.txid_hex)
+    return key, txs, expected
+
+
+def test_tx_flood_serviced_vs_sync_control():
+    from bitcoincashplus_tpu.wallet.keys import CKey, script_to_address
+    from bitcoincashplus_tpu.consensus.params import regtest_params
+
+    key = CKey(0x53657276)
+    addr = script_to_address(key.p2pkh_script(), regtest_params())
+    with FunctionalFramework(
+        num_nodes=2,
+        extra_args=[[], ["-sigservice=off"]],
+    ) as fw:
+        serviced, control = fw.nodes
+        # one shared chain, synced by block submission (no P2P link: each
+        # node's verdicts must come from its own accept path)
+        serviced.rpc.generatetoaddress(N_BLOCKS, addr)
+        for height in range(1, N_BLOCKS + 1):
+            raw = serviced.rpc.getblock(
+                serviced.rpc.getblockhash(height), 0)
+            assert control.rpc.submitblock(raw) is None
+        assert (serviced.rpc.getbestblockhash()
+                == control.rpc.getbestblockhash())
+
+        _key, txs, expected = _build_storm_txs(serviced)
+
+        # the serviced node really is serving, the control really is not
+        assert serviced.rpc.gettpuinfo()["serving"]["enabled"] is True
+        assert control.rpc.gettpuinfo()["serving"] == {"enabled": False}
+
+        storms = [
+            ChaosPeer(n.p2p_port, "txstorm", seed=STORM_SEED, txs=txs,
+                      tx_rate=TX_RATE)
+            for n in (serviced, control)
+        ]
+        for s in storms:
+            s.start()
+        try:
+            wait_until(
+                lambda: all(
+                    set(n.rpc.getrawmempool()) >= expected
+                    for n in (serviced, control)),
+                timeout=90, sleep=0.5)
+        finally:
+            for s in storms:
+                s.stop()
+                s.join(10)
+        for s in storms:
+            assert s.error is None, f"storm peer error: {s.error!r}"
+            assert s.rounds_done == len(txs)
+
+        # zero verdict divergence: identical mempools
+        assert (set(serviced.rpc.getrawmempool())
+                == set(control.rpc.getrawmempool()))
+
+        # the PR 6 histogram measured the storm on the serviced node
+        info = serviced.rpc.gettpuinfo()
+        lat = info["telemetry"]["accept_latency"]
+        assert lat["accepted"] >= len(txs)
+        assert 0.0 < lat["p99_ms"] < P99_BUDGET_MS, lat
+        # the serving engine did the verifying (flush policy fired)
+        serving = info["serving"]
+        assert serving["dispatches"] >= 1
+        assert serving["lanes_enqueued"] >= len(txs)
+        flushes = sum(serving[f"flush_{r}"]
+                      for r in ("full", "deadline", "kick", "stop"))
+        assert flushes == serving["dispatches"]
+        # control node verified the same load synchronously
+        clat = control.rpc.gettpuinfo()["telemetry"]["accept_latency"]
+        assert clat["accepted"] >= len(txs)
+
+        # a block mined over the serviced mempool connects on the control
+        # node: the serviced verdicts externalize to an identical chain
+        (block_hash,) = serviced.rpc.generatetoaddress(1, addr)
+        raw = serviced.rpc.getblock(block_hash, 0)
+        assert control.rpc.submitblock(raw) is None
+        assert (serviced.rpc.getbestblockhash()
+                == control.rpc.getbestblockhash())
+        assert serviced.rpc.getrawmempool() == []

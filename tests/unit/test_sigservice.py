@@ -1,0 +1,544 @@
+"""SigService — the always-on micro-batching signature service (ISSUE 7).
+
+Covers the flush policy (full / deadline / kick / stop), sigcache
+awareness (pre-enqueue hits, in-flight dedup, settle-side insertion),
+block-import preemption, degradation (flush failure -> caller-side CPU
+re-verify; programming error -> visible thread death with inline
+fallback), the serviced AcceptToMemoryPool path (verdicts identical to
+the synchronous path, stale-context retry), and the -sigservice* node
+knobs. Tier-1: JAX_PLATFORMS=cpu, no device needed.
+"""
+
+import hashlib
+import threading
+import time
+from contextlib import contextmanager
+
+import numpy as np
+import pytest
+
+from bitcoincashplus_tpu.consensus.params import regtest_params
+from bitcoincashplus_tpu.consensus.tx import COutPoint, CTransaction, CTxIn, CTxOut
+from bitcoincashplus_tpu.crypto import secp256k1 as oracle
+from bitcoincashplus_tpu.mempool import CTxMemPool, MempoolError
+from bitcoincashplus_tpu.mempool.accept import accept_to_memory_pool
+from bitcoincashplus_tpu.mining.generate import generate_blocks
+from bitcoincashplus_tpu.ops import ecdsa_batch
+from bitcoincashplus_tpu.script.interpreter import SigCheckRecord
+from bitcoincashplus_tpu.serving import SigService, prewarm_block_sigs
+from bitcoincashplus_tpu.store.blockstore import MemoryBlockStore
+from bitcoincashplus_tpu.validation.chainstate import ChainstateManager
+from bitcoincashplus_tpu.validation.coins import MemoryCoinsView
+from bitcoincashplus_tpu.validation.scriptcheck import BlockScriptVerifier
+from bitcoincashplus_tpu.validation.sigcache import SignatureCache
+from bitcoincashplus_tpu.wallet.keys import CKey
+from bitcoincashplus_tpu.wallet.signing import sign_transaction
+
+from test_validation import TILE
+
+pytestmark = pytest.mark.serving
+
+KEY = CKey(0xC0FFEE)
+SPK_KEY = KEY.p2pkh_script()
+
+
+def _record(i: int, good: bool = True) -> SigCheckRecord:
+    d = 0x2222 + i
+    e = int.from_bytes(hashlib.sha256(b"svc%d" % i).digest(),
+                       "big") % oracle.N
+    r, s = oracle.ecdsa_sign(d, e)
+    pub = oracle.point_mul(d, oracle.G)
+    return SigCheckRecord(pub, r, s, e if good else (e + 1) % oracle.N)
+
+
+def _key_of(rec) -> bytes:
+    return SignatureCache.entry_key(rec.msg_hash, rec.r, rec.s, rec.pubkey)
+
+
+@contextmanager
+def _service(**kw):
+    kw.setdefault("backend", "cpu")
+    svc = SigService(**kw).start()
+    try:
+        yield svc
+    finally:
+        svc.stop()
+
+
+# ----------------------------------------------------------------------
+# flush policy
+# ----------------------------------------------------------------------
+
+
+class TestFlushPolicy:
+    def test_flush_on_full(self):
+        with _service(lanes=4, deadline_ms=60_000) as svc:
+            fut = svc.submit([_record(i) for i in range(4)])
+            deadline = time.monotonic() + 10
+            while not fut.done() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert fut.done(), "full bucket must flush without a kick"
+            assert svc.stats["flush_full"] == 1
+            assert fut.result().all()
+
+    def test_flush_on_deadline(self):
+        with _service(lanes=10_000, deadline_ms=30) as svc:
+            fut = svc.submit([_record(10)])
+            deadline = time.monotonic() + 10
+            while not fut.done() and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert fut.done(), "lone tx must not starve behind the bucket"
+            assert svc.stats["flush_deadline"] == 1
+
+    def test_kick_on_result(self):
+        with _service(lanes=10_000, deadline_ms=60_000) as svc:
+            fut = svc.submit([_record(20)])
+            t0 = time.monotonic()
+            assert fut.result().all()
+            # a blocked waiter flushes immediately, not at the deadline
+            assert time.monotonic() - t0 < 30.0
+            assert svc.stats["flush_kick"] == 1
+
+    def test_stop_drains_pending(self):
+        svc = SigService(backend="cpu", lanes=10_000,
+                         deadline_ms=60_000).start()
+        fut = svc.submit([_record(30)])
+        svc.stop()
+        assert svc.stats["flush_stop"] == 1
+        assert fut.result().all()
+
+    def test_submit_after_stop_runs_inline(self):
+        svc = SigService(backend="cpu").start()
+        svc.stop()
+        assert svc.submit([_record(40)]).result().all()
+        assert not svc.submit([_record(41, good=False)]).result().any()
+
+    def test_bad_lane_verdict(self):
+        with _service(lanes=4, deadline_ms=60_000) as svc:
+            good = [_record(50 + i) for i in range(3)]
+            fut = svc.submit(good + [_record(59, good=False)])
+            assert fut.result().tolist() == [True, True, True, False]
+
+
+# ----------------------------------------------------------------------
+# sigcache awareness
+# ----------------------------------------------------------------------
+
+
+class TestSigcache:
+    def test_pre_enqueue_hit_skips_lane(self):
+        sc = SignatureCache()
+        rec = _record(60)
+        sc.add(_key_of(rec))
+        with _service(sigcache=sc) as svc:
+            fut = svc.submit([rec])
+            # resolved inline: no lane, no dispatch needed
+            assert fut.done()
+            assert fut.result().all()
+            assert svc.stats["cache_hits"] == 1
+            assert svc.stats["lanes_enqueued"] == 0
+
+    def test_settle_inserts_true_verdicts_only(self):
+        sc = SignatureCache()
+        good, bad = _record(61), _record(62, good=False)
+        with _service(sigcache=sc) as svc:
+            svc.submit([good, bad]).result()
+        assert sc.snapshot()["inserts"] == 1
+        assert _key_of(good) in sc._set
+        assert _key_of(bad) not in sc._set
+
+    def test_inflight_dedup_shares_one_lane(self):
+        sc = SignatureCache()
+        rec = _record(63)
+        with _service(sigcache=sc, lanes=10_000,
+                      deadline_ms=60_000) as svc:
+            f1 = svc.submit([rec])
+            f2 = svc.submit([rec])  # parked: joins f1's lane
+            assert svc.stats["dedup_hits"] == 1
+            assert svc.stats["lanes_enqueued"] == 1
+            assert f1.result().all() and f2.result().all()
+            assert svc.stats["dispatches"] == 1
+        # the dedup is surfaced in the sigcache snapshot
+        assert sc.snapshot()["service_dedup_hits"] == 1
+
+    def test_dedup_within_one_submit(self):
+        rec = _record(64)
+        with _service() as svc:
+            fut = svc.submit([rec, rec])
+            assert fut.result().tolist() == [True, True]
+            assert svc.stats["dedup_hits"] == 1
+
+
+# ----------------------------------------------------------------------
+# preemption + degradation
+# ----------------------------------------------------------------------
+
+
+class TestDegradation:
+    def test_import_priority_preempts(self):
+        with _service() as svc:
+            with svc.import_priority():
+                assert svc.snapshot()["priority_depth"] == 1
+                with svc.import_priority():  # re-entrant
+                    assert svc.submit([_record(70)]).result().all()
+            assert svc.snapshot()["priority_depth"] == 0
+        assert svc.stats["preempted_dispatches"] >= 1
+
+    def test_flush_error_degrades_to_caller_cpu(self, monkeypatch):
+        calls = {"n": 0}
+        real = ecdsa_batch.dispatch_batch
+
+        def boom(records, backend="auto", kernel=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ValueError("injected flush failure")
+            return real(records, backend=backend, kernel=kernel)
+
+        monkeypatch.setattr(ecdsa_batch, "dispatch_batch", boom)
+        with _service() as svc:
+            fut = svc.submit([_record(71), _record(72, good=False)])
+            # verdicts survive the failed flush via caller-side CPU
+            # re-verify — never dropped, never fabricated
+            assert fut.result().tolist() == [True, False]
+            assert svc.stats["flush_errors"] == 1
+            assert svc.running()  # a non-programming error is survivable
+
+    def test_degraded_path_caches_and_dedups(self, monkeypatch):
+        """A failed flush's caller-side re-verify is ONE batched call,
+        TRUE verdicts land in the sigcache, and a second future sharing
+        the errored lane resolves from the cache without re-verifying."""
+        calls = []
+        real = ecdsa_batch.dispatch_batch
+
+        def boom(records, backend="auto", kernel=None):
+            calls.append(len(records))
+            if len(calls) == 1:
+                raise ValueError("injected flush failure")
+            return real(records, backend=backend, kernel=kernel)
+
+        monkeypatch.setattr(ecdsa_batch, "dispatch_batch", boom)
+        sc = SignatureCache()
+        rec_a, rec_b = _record(80), _record(81)
+        with _service(sigcache=sc, lanes=10_000,
+                      deadline_ms=60_000) as svc:
+            f1 = svc.submit([rec_a, rec_b])
+            f2 = svc.submit([rec_a])  # dedup: shares the doomed lane
+            assert f1.result().tolist() == [True, True]
+            # ONE batched re-verify covered both records of f1
+            assert calls == [2, 2]
+            # the degraded path still populated the sigcache...
+            assert sc.snapshot()["inserts"] == 2
+            # ...so the sharing future resolves from it, no third call
+            assert f2.result().tolist() == [True]
+            assert calls == [2, 2]
+
+    def test_wait_is_advisory(self, monkeypatch):
+        """wait() never re-verifies: on timeout it just reports False
+        (the prewarm contract — a backlogged service costs the relay
+        path the timeout, not a serial CPU pass)."""
+
+        def never(records, backend="auto", kernel=None):
+            raise ValueError("wedged")
+
+        monkeypatch.setattr(ecdsa_batch, "dispatch_batch", never)
+        with _service(lanes=10_000, deadline_ms=60_000) as svc:
+            fut = svc.submit([_record(85)])
+            # errored lanes settle (err set) -> wait returns True fast,
+            # and crucially performs no verification of its own
+            assert fut.wait(5.0) is True
+            assert fut._sources[0].err is not None
+
+    def test_programming_error_kills_thread_visibly(self, monkeypatch):
+        calls = {"n": 0}
+        real = ecdsa_batch.dispatch_batch
+
+        def boom(records, backend="auto", kernel=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise NameError("refactor broke the dispatch layer")
+            return real(records, backend=backend, kernel=kernel)
+
+        monkeypatch.setattr(ecdsa_batch, "dispatch_batch", boom)
+        with _service() as svc:
+            fut = svc.submit([_record(73)])
+            ok = fut.result()  # caller-side CPU re-verify still lands
+            assert ok.all()
+            deadline = time.monotonic() + 5
+            while svc.running() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert not svc.running(), "NameError must not degrade silently"
+            # with the thread dead, later submits flush inline
+            assert svc.submit([_record(74)]).result().all()
+
+    def test_concurrent_submissions_share_one_bucket(self):
+        # six transactions enqueue BEFORE anyone awaits (the open-loop
+        # storm shape): the first result() kick must flush every parked
+        # lane as one shared bucket, not one dispatch per submitter
+        with _service(lanes=10_000, deadline_ms=60_000) as svc:
+            futs = [svc.submit([_record(100 + i * 4 + j) for j in range(4)])
+                    for i in range(6)]
+            assert all(f.result().all() for f in futs)
+            assert svc.stats["dispatches"] == 1
+            assert svc.stats["lanes_real"] == 24
+
+
+# ----------------------------------------------------------------------
+# serviced AcceptToMemoryPool — verdicts identical to the sync path
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def chain():
+    """chainstate trio with 103 mined blocks (module-scoped: the mining
+    cost is paid once; each test gets a FRESH pool + sigcache)."""
+    params = regtest_params()
+    t = [1_600_000_000]
+
+    def fake_time():
+        t[0] += 60
+        return t[0]
+
+    cs = ChainstateManager(
+        params, MemoryCoinsView(), MemoryBlockStore(),
+        script_verifier=BlockScriptVerifier(params, backend="cpu",
+                                            sigcache=SignatureCache()),
+        get_time=fake_time,
+    )
+    generate_blocks(cs, SPK_KEY, 110, tile=TILE)  # heights 1-10 mature
+    return cs
+
+
+def _coinbase_out(cs, height):
+    blk = cs.get_block(cs.chain[height].hash)
+    return COutPoint(blk.vtx[0].txid, 0), blk.vtx[0].vout[0].value
+
+
+def _spend(op, value, fee=10_000, n_out=1):
+    per_out = (value - fee) // n_out
+    tx = CTransaction(
+        vin=(CTxIn(op, b""),),
+        vout=tuple(CTxOut(per_out, SPK_KEY) for _ in range(n_out)),
+    )
+    return sign_transaction(
+        tx, [(SPK_KEY, value)], lambda i: KEY if i == KEY.pubkey_hash else None,
+        enable_forkid=True,
+    )
+
+
+class TestServicedAccept:
+    def test_accept_matches_sync_path(self, chain):
+        cs = chain
+        op, value = _coinbase_out(cs, 1)
+        tx = _spend(op, value)
+        sync_pool, svc_pool = CTxMemPool(), CTxMemPool()
+        sync_sc, svc_sc = SignatureCache(), SignatureCache()
+        e_sync = accept_to_memory_pool(sync_pool, cs, tx, sigcache=sync_sc)
+        with _service(sigcache=svc_sc) as svc:
+            e_svc = accept_to_memory_pool(svc_pool, cs, tx, sigcache=svc_sc,
+                                          sig_service=svc)
+        assert e_svc.txid == e_sync.txid
+        assert e_svc.fee == e_sync.fee and e_svc.sigops == e_sync.sigops
+        # both paths populated the sigcache for the eventual connect
+        assert len(sync_sc) == len(svc_sc) == 1
+
+    def test_bad_signature_rejected_identically(self, chain):
+        cs = chain
+        op, value = _coinbase_out(cs, 2)
+        tx = _spend(op, value)
+        ss = bytearray(tx.vin[0].script_sig)
+        ss[40] ^= 1
+        bad = CTransaction(tx.version, (CTxIn(op, bytes(ss)),), tx.vout,
+                           tx.locktime)
+        pool, sc = CTxMemPool(), SignatureCache()
+        with _service(sigcache=sc) as svc:
+            with pytest.raises(MempoolError, match="script-verify"):
+                accept_to_memory_pool(pool, cs, bad, sigcache=sc,
+                                      sig_service=svc)
+        assert bad.txid not in pool and len(sc) == 0
+
+    def test_stale_parent_retries_to_missing_inputs(self, chain):
+        """An in-pool parent evicted during the verdict wait: the accept
+        retries and the FINAL synchronous attempt derives missing-inputs
+        — never a phantom entry over a vanished coin."""
+        cs = chain
+        op, value = _coinbase_out(cs, 3)
+        parent = _spend(op, value, n_out=2)
+        pool, sc = CTxMemPool(), SignatureCache()
+        with _service(sigcache=sc) as svc:
+            accept_to_memory_pool(pool, cs, parent, sigcache=sc,
+                                  sig_service=svc)
+            child = _spend(COutPoint(parent.txid, 0),
+                           parent.vout[0].value)
+            evicted = {"done": False}
+
+            @contextmanager
+            def evict_parent_mid_wait():
+                if not evicted["done"]:
+                    evicted["done"] = True
+                    pool.remove_recursive(parent.txid)
+                yield
+
+            with pytest.raises(MempoolError, match="missing-inputs"):
+                accept_to_memory_pool(pool, cs, child, sigcache=sc,
+                                      sig_service=svc,
+                                      wait_ctx=evict_parent_mid_wait)
+        assert child.txid not in pool
+
+    def test_conflict_added_mid_wait_rejected(self, chain):
+        cs = chain
+        op, value = _coinbase_out(cs, 4)
+        tx = _spend(op, value)
+        rival = _spend(op, value, fee=20_000)
+        pool, sc = CTxMemPool(), SignatureCache()
+        with _service(sigcache=sc) as svc:
+            injected = {"done": False}
+
+            @contextmanager
+            def add_rival_mid_wait():
+                if not injected["done"]:
+                    injected["done"] = True
+                    accept_to_memory_pool(pool, cs, rival, sigcache=sc)
+                yield
+
+            with pytest.raises(MempoolError, match="mempool-conflict"):
+                accept_to_memory_pool(pool, cs, tx, sigcache=sc,
+                                      sig_service=svc,
+                                      wait_ctx=add_rival_mid_wait)
+        assert rival.txid in pool and tx.txid not in pool
+
+
+# ----------------------------------------------------------------------
+# prewarm (tip relay / getblocktemplate re-validation)
+# ----------------------------------------------------------------------
+
+
+class _StubNode:
+    def __init__(self, cs, pool, svc):
+        self.chainstate = cs
+        self.mempool = pool
+        self.sigservice = svc
+
+
+class TestPrewarm:
+    def test_prewarm_populates_sigcache(self, chain):
+        cs = chain
+        op, value = _coinbase_out(cs, 5)
+        tx = _spend(op, value)
+        pool = CTxMemPool()
+        # a decoy entry: the prewarm gate requires a live mempool
+        d_op, d_val = _coinbase_out(cs, 6)
+        sc = SignatureCache()
+        with _service(sigcache=sc) as svc:
+            node = _StubNode(cs, pool, svc)
+            accept_to_memory_pool(pool, cs, _spend(d_op, d_val),
+                                  sigcache=sc)
+            # a tip-extending block carrying a NON-mempool tx
+            from dataclasses import replace
+
+            from bitcoincashplus_tpu.mining.assembler import BlockAssembler
+
+            from bitcoincashplus_tpu.consensus.merkle import (
+                block_merkle_root,
+            )
+
+            blk = BlockAssembler(cs, pool).create_new_block(SPK_KEY).block
+            blk = replace(blk, vtx=(blk.vtx[0], tx))
+            # re-commit the swapped body (prewarm's merkle gate is real)
+            blk = replace(blk, header=replace(
+                blk.header, hash_merkle_root=block_merkle_root(blk)[0]))
+            inserts_before = sc.snapshot()["inserts"]
+            # the template is unmined — proposal-mode shape, PoW waived
+            n = prewarm_block_sigs(node, blk, require_pow=False)
+            assert n == 1
+            assert sc.snapshot()["inserts"] == inserts_before + 1
+            assert svc.stats["prewarm_txs"] == 1
+            # P2P shape: real PoW required; a mainnet-difficulty header
+            # (impossible for this unmined template) gates the prewarm
+            hdr = replace(blk.header, bits=0x1803A30C)
+            assert prewarm_block_sigs(node, replace(blk, header=hdr)) == 0
+            # a body not committed by the merkle root is gated too
+            bad = replace(blk, vtx=(blk.vtx[0], tx, tx))
+            assert prewarm_block_sigs(node, bad, require_pow=False) == 0
+
+    def test_prewarm_skips_without_mempool(self, chain):
+        cs = chain
+        op, value = _coinbase_out(cs, 7)
+        tx = _spend(op, value)
+        with _service(sigcache=SignatureCache()) as svc:
+            node = _StubNode(cs, CTxMemPool(), svc)
+            from dataclasses import replace
+
+            from bitcoincashplus_tpu.mining.assembler import BlockAssembler
+
+            blk = BlockAssembler(cs, node.mempool) \
+                .create_new_block(SPK_KEY).block
+            blk = replace(blk, vtx=(blk.vtx[0], tx))
+            # IBD gate: empty mempool bails before PoW/merkle work
+            assert prewarm_block_sigs(node, blk, require_pow=False) == 0
+
+
+# ----------------------------------------------------------------------
+# node knobs + observability
+# ----------------------------------------------------------------------
+
+
+class TestNodeWiring:
+    def _mk_config(self, tmp_path, **args):
+        from bitcoincashplus_tpu.node.config import Config
+
+        cfg = Config()
+        cfg.args["datadir"] = [str(tmp_path)]
+        cfg.args["regtest"] = ["1"]
+        for k, v in args.items():
+            cfg.args[k] = [str(v)]
+        return cfg
+
+    def test_bad_sigservice_flag_rejected(self, tmp_path):
+        from bitcoincashplus_tpu.node.config import ConfigError
+        from bitcoincashplus_tpu.node.node import Node
+
+        with pytest.raises(ConfigError, match="sigservice"):
+            Node(config=self._mk_config(tmp_path / "a", sigservice="maybe"))
+        with pytest.raises(ConfigError, match="sigservicedeadline"):
+            Node(config=self._mk_config(tmp_path / "b",
+                                        sigservicedeadline="-5"))
+        with pytest.raises(ConfigError, match="sigservicelanes"):
+            Node(config=self._mk_config(tmp_path / "c", sigservicelanes="0"))
+
+    def test_service_default_on_and_off_knob(self, tmp_path):
+        from bitcoincashplus_tpu.node.node import Node
+        from bitcoincashplus_tpu.rpc.control import gettpuinfo
+
+        node = Node(config=self._mk_config(tmp_path / "on"))
+        try:
+            assert node.sigservice is not None and node.sigservice.running()
+            assert node.chainstate.sig_service is node.sigservice
+            info = gettpuinfo(node, [])
+            assert info["serving"]["enabled"] is True
+            assert info["serving"]["lanes"] == 2046
+        finally:
+            node.close()
+        assert not node.sigservice.running()  # close() stopped the thread
+
+        node = Node(config=self._mk_config(tmp_path / "off",
+                                           sigservice="off"))
+        try:
+            assert node.sigservice is None
+            assert gettpuinfo(node, [])["serving"] == {"enabled": False}
+        finally:
+            node.close()
+
+    def test_snapshot_and_registry_families(self):
+        from bitcoincashplus_tpu.util import telemetry
+
+        with _service() as svc:
+            svc.submit([_record(90)]).result()
+            snap = svc.snapshot()
+            for key in ("queue_depth", "dispatches", "flush_kick",
+                        "dedup_hits", "cache_hits", "deadline_ms",
+                        "wait_ms", "preempted_dispatches"):
+                assert key in snap, key
+        text = telemetry.REGISTRY.prometheus_text()
+        for fam in ("bcp_sigservice_queue_depth", "bcp_sigservice_flush_total",
+                    "bcp_sigservice_wait_seconds"):
+            assert fam in text, fam

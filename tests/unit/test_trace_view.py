@@ -123,3 +123,49 @@ def test_main_prints_report(tmp_path, capsys):
     assert trace_view.main(["trace_view.py", str(path)]) == 0
     assert capsys.readouterr().out == GOLDEN
     assert trace_view.main(["trace_view.py"]) == 2
+
+
+# -- signature serving section (ISSUE 7) -------------------------------
+
+
+SERVING_EVENTS = [
+    _span("serving.flush", 10_000, 3_000, reason="deadline", lanes=4),
+    _span("serving.flush", 50_000, 8_000, reason="full", lanes=2046),
+    _span("serving.flush", 70_000, 2_000, reason="kick", lanes=2),
+    _span("serving.flush", 90_000, 2_500, reason="kick", lanes=3),
+    _span("serving.settle", 10_500, 2_000, lanes=4),
+    _span("serving.settle", 50_500, 7_000, lanes=2046),
+    _span("serving.settle", 70_500, 1_500, lanes=2),
+    _span("serving.settle", 90_500, 2_000, lanes=3),
+    {"name": "serving.deadline_miss", "ph": "i", "s": "t", "ts": 9_000,
+     "pid": 1, "tid": 1,
+     "args": {"age_ms": 12.5, "deadline_ms": 4.0, "lanes": 4}},
+]
+
+
+def test_serving_section_reports_flush_breakdown():
+    lines = trace_view.serving_section(SERVING_EVENTS)
+    text = "\n".join(lines)
+    assert "signature serving" in text
+    # flush-reason breakdown, most-frequent reason first
+    kick_row = next(ln for ln in lines if ln.startswith("kick"))
+    assert "2" in kick_row.split()[1]  # count
+    full_row = next(ln for ln in lines if ln.startswith("full"))
+    assert "2046" in full_row
+    # the flush -> settle chain
+    assert "4 flush / 4 settle spans" in text
+    # the deadline-miss list
+    assert "deadline misses: 1" in text
+    assert "age 12.5 ms vs deadline 4.0 ms (4 lane(s))" in text
+
+
+def test_serving_section_absent_without_serving_spans():
+    # pre-serving dumps keep their byte-stable golden report
+    assert trace_view.serving_section(EVENTS) == []
+    assert "signature serving" not in trace_view.summarize(EVENTS)
+
+
+def test_summarize_includes_serving_when_present():
+    out = trace_view.summarize(EVENTS + SERVING_EVENTS)
+    assert "signature serving" in out
+    assert out.index("signature serving") < out.index("unwinds:")

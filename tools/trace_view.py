@@ -14,7 +14,12 @@ prints:
     is on the device for that whole stretch) and the blocked time is the
     settle span's duration — overlap = the fraction of the in-flight
     window the host spent doing useful work instead of waiting;
-  - the top-10 slowest settles (the blocks worth profiling first).
+  - the top-10 slowest settles (the blocks worth profiling first);
+  - a "signature serving" section when the dump carries SigService spans
+    (serving.flush / serving.settle, ISSUE 7): flush-reason breakdown
+    with lane counts, the flush->settle span-chain timing, and the list
+    of deadline-miss instants (flushes that fired later than 2x the
+    configured deadline).
 
 Percentiles are nearest-rank over the raw span durations (exact, no
 interpolation): sorted[ceil(q*n) - 1]. All times are milliseconds.
@@ -114,6 +119,59 @@ def block_overlap(events: list[dict]) -> list[dict]:
     return out
 
 
+def serving_section(events: list[dict]) -> list[str]:
+    """The SigService report lines (empty when the dump has no serving
+    spans — keeps pre-serving dumps' reports byte-stable).
+
+    The enqueue -> flush -> settle chain is read off the span structure:
+    every serving.flush span is parented on its oldest lane's enqueue
+    context and nests one serving.settle span, so flush duration minus
+    settle duration is the host-side dispatch overhead."""
+    flushes = [ev for ev in events
+               if ev.get("ph") == "X" and ev.get("name") == "serving.flush"]
+    settles = [ev for ev in events
+               if ev.get("ph") == "X" and ev.get("name") == "serving.settle"]
+    misses = [ev for ev in events
+              if ev.get("ph") == "i"
+              and ev.get("name") == "serving.deadline_miss"]
+    if not (flushes or settles or misses):
+        return []
+    lines = ["", "signature serving (SigService)"]
+    by_reason: dict[str, list[dict]] = defaultdict(list)
+    for ev in flushes:
+        by_reason[str(ev.get("args", {}).get("reason", "?"))].append(ev)
+    lines.append(
+        f"{'flush reason':<14}{'count':>7}{'lanes':>9}{'mean_ms':>10}"
+        f"{'p99_ms':>10}")
+    for reason in sorted(by_reason, key=lambda r: -len(by_reason[r])):
+        evs = by_reason[reason]
+        durs = [float(ev.get("dur", 0.0)) / 1e3 for ev in evs]
+        lanes = sum(int(ev.get("args", {}).get("lanes", 0)) for ev in evs)
+        lines.append(
+            f"{reason:<14}{len(evs):>7}{lanes:>9}"
+            f"{sum(durs) / len(durs):>10.2f}{percentile(durs, 0.99):>10.2f}")
+    if flushes and settles:
+        fd = [float(ev.get("dur", 0.0)) / 1e3 for ev in flushes]
+        sd = [float(ev.get("dur", 0.0)) / 1e3 for ev in settles]
+        lines += [
+            "",
+            "flush -> settle chain: "
+            f"{len(flushes)} flush / {len(settles)} settle spans, "
+            f"settle p50 {percentile(sd, 0.5):.2f} ms "
+            f"p99 {percentile(sd, 0.99):.2f} ms, "
+            f"dispatch overhead mean "
+            f"{max(0.0, sum(fd) / len(fd) - sum(sd) / len(sd)):.2f} ms",
+        ]
+    if misses:
+        lines += ["", f"deadline misses: {len(misses)}"]
+        for ev in misses:
+            a = ev.get("args", {})
+            lines.append(
+                f"  age {a.get('age_ms')} ms vs deadline "
+                f"{a.get('deadline_ms')} ms ({a.get('lanes')} lane(s))")
+    return lines
+
+
 def summarize(events: list[dict]) -> str:
     """The full text report over one dump."""
     spans = [ev for ev in events if ev.get("ph") == "X"]
@@ -149,6 +207,8 @@ def summarize(events: list[dict]) -> str:
         for b in slowest:
             lines.append(f"{b['height']:>8}{b['settle_ms']:>12.2f}"
                          f"{b['overlap']:>10.4f}")
+
+    lines += serving_section(events)
 
     unwinds = [ev for ev in events
                if ev.get("ph") == "i" and ev.get("name") == "block.unwind"]
