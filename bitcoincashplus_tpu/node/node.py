@@ -226,6 +226,18 @@ class Node:
         if svc_mode not in ("on", "off", "1", "0"):
             raise ConfigError(
                 f"-sigservice={svc_mode!r}: must be on or off")
+        # -watchdogquiet=<seconds>: stall-watchdog quiet period for the
+        # SigService flush thread and the pipeline settle horizon
+        # (util/devicewatch; observe-only — a stall fires a gauge, a log
+        # warning, and a trace instant, never a kill). 0 disables
+        # detection; the gauges still export.
+        self.watchdog_quiet = config.get_int("watchdogquiet", 10)
+        from ..util import devicewatch as _dw
+
+        _dw.WATCHDOG.register(
+            "pipeline",
+            pending_fn=lambda: len(self.chainstate._horizon),
+            quiet_s=self.watchdog_quiet)
         self.sigservice = None
         if svc_mode in ("on", "1"):
             from ..serving import SigService
@@ -237,6 +249,7 @@ class Node:
                     kernel=self.ecdsa_kernel,
                     deadline_ms=config.get_int("sigservicedeadline", 4),
                     lanes=config.get_int("sigservicelanes", 2046),
+                    watchdog_quiet=self.watchdog_quiet,
                 ).start()
             except ValueError as e:
                 raise ConfigError(str(e)) from None
@@ -770,6 +783,14 @@ class Node:
         )
         self.chainstate.pipeline_depth = self.pipeline_depth
         self.chainstate.sig_service = self.sigservice
+        # the fresh manager re-registered the pipeline watchdog with the
+        # env default quiet — restore this node's -watchdogquiet wiring
+        from ..util import devicewatch as _dw
+
+        _dw.WATCHDOG.register(
+            "pipeline",
+            pending_fn=lambda: len(self.chainstate._horizon),
+            quiet_s=getattr(self, "watchdog_quiet", None))
         self.chainstate.load_block_index()
 
     def _import_block_files_native(self) -> int:
@@ -1629,6 +1650,12 @@ class Node:
         # REGISTRY for the rest of the process
         for name in ("sigcache", "pipeline", "mempool", "serving"):
             telemetry.REGISTRY.unregister_collector(name)
+        # same lesson for the watchdog: its pending_fn closures must not
+        # keep a closed node alive (sigservice.stop() already dropped its
+        # own registration above)
+        from ..util import devicewatch as _dw
+
+        _dw.WATCHDOG.unregister("pipeline")
         if self.tracefile:
             # -tracefile: the span ring buffer as Chrome/perfetto JSON,
             # written LAST so shutdown's own flush spans are included

@@ -41,6 +41,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
+from ..util import devicewatch as dw
 from ..util import telemetry as tm
 from ..util.faults import INJECTOR, Backoff, PoisonedOutput, retry_call
 from ..util.log import log_print, log_printf
@@ -300,8 +301,13 @@ def supervised_call(site: str, device_fn: Callable, cpu_fn: Callable,
             br.record_success()
             if calls[0] > 1:
                 _RETRIES.labels(site=site).inc(calls[0] - 1)
-            _LAT.labels(site=site, path="device").observe(
-                time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            _LAT.labels(site=site, path="device").observe(dt)
+            # synchronous crossing: the whole device leg (dispatch +
+            # blocking materialization inside device_fn) is one
+            # "execute" phase — async sites split execute/fetch
+            # themselves (util/devicewatch phase vocabulary)
+            dw.note_phase(site, "execute", dt)
             return out, True
         except (KeyboardInterrupt, SystemExit):
             raise
@@ -368,8 +374,11 @@ class SupervisedHandle:
                 raise PoisonedOutput(
                     f"{self._site}: device output failed validation probe")
             br.record_success()
-            _LAT.labels(site=self._site, path="settle").observe(
-                time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            _LAT.labels(site=self._site, path="settle").observe(dt)
+            # async crossing: result() blocks on materialization — the
+            # "fetch" phase of the dispatch decomposition
+            dw.note_phase(self._site, "fetch", dt)
             self._result = out
         except (KeyboardInterrupt, SystemExit):
             raise

@@ -33,6 +33,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from ..crypto import secp256k1 as oracle
+from ..util import devicewatch as dw
 from ..util import telemetry as tm
 from ..util.faults import INJECTOR, Backoff, PoisonedOutput
 from ..util.log import log_printf
@@ -97,6 +98,45 @@ tm.register_collector("ecdsa_stats", _collect_ecdsa_stats)
 BUCKETS = (32, 128, 512, 2048, 8192, 16384, 32768)
 # Below this lane count a device round-trip costs more than host verify.
 CPU_FLOOR = 8
+
+# ---- device-lane watches (util/devicewatch) --------------------------------
+# The bucket design's WHOLE POINT is a bounded compiled-shape set; these
+# declared budgets turn that invariant into a runtime check (a dispatch
+# that mints a shape beyond its program's budget fires
+# bcp_xla_retrace_unexpected_total + a log warning + a trace instant).
+# The byte-pipeline ladder is {1024, 2048, 4096} then 2048-granular to
+# 16384 = 9 shapes (_bucket_for pallas=True; >16384 splits per program
+# call, so no extra shapes); the plane/ladder programs pad to BUCKETS.
+PALLAS_SHAPE_BUDGET = 9
+_PW_GLV = dw.program("ecdsa_glv", shape_budget=PALLAS_SHAPE_BUDGET)
+_PW_W4_BYTES = dw.program("ecdsa_w4_bytes", shape_budget=PALLAS_SHAPE_BUDGET)
+_PW_W4 = dw.program("ecdsa_w4", shape_budget=len(BUCKETS))
+_PW_XLA = dw.program("ecdsa_xla", shape_budget=len(BUCKETS))
+
+
+def _watched_kernel(pw, bucket: int, arrays, fn, jitfn=None, kwargs=None,
+                    split: int | None = 16384):
+    """One watched kernel call: the program watch sees the compiled-shape
+    signature and attributes compile time; h2d staging bytes and the
+    execute phase land in the transfer/phase accounting. ``arrays`` are
+    the packed host-side numpy inputs (their nbytes IS the staging
+    payload); ``jitfn`` enables first-compile cost-analysis capture.
+
+    ``split`` is the wrapper's per-program-call cap: the glv / w4-bytes
+    entry points slice batches beyond 16384 lanes into 16384-lane
+    program calls, so the COMPILED shape — the signature the retrace
+    sentinel must see — is min(bucket, split), never the raw bucket (an
+    unclamped 32768 would read as a fresh shape and fire a false
+    invariant alarm). Pass split=None for programs that do not slice
+    (the XLA ladder compiles the padded bucket as-is)."""
+    dw.note_transfer("ecdsa", "h2d",
+                     sum(int(a.nbytes) for a in arrays))
+    sig = bucket if split is None else min(bucket, split)
+    t0 = time.monotonic()
+    with pw.dispatch(sig, jitfn=jitfn, args=arrays, kwargs=kwargs):
+        out = fn()
+    dw.note_phase("ecdsa", "execute", time.monotonic() - t0)
+    return out
 
 # ---- kernel selection (-ecdsakernel=glv|w4) --------------------------------
 # "glv": the λ-endomorphism split verifier (ops/secp256k1 GLV core — 32
@@ -633,8 +673,14 @@ class BatchHandle:
         # overlap is doing its job the host hid the latency and this is
         # near zero; summing dispatch->settle spans would double-count
         # concurrent chunks and absorb host interpreter time.
-        STATS.device_seconds += time.monotonic() - t0
-        _SETTLE_H.observe(time.monotonic() - t0)
+        wait = time.monotonic() - t0
+        STATS.device_seconds += wait
+        _SETTLE_H.observe(wait)
+        # result fetch: the d2h crossing this settle actually paid
+        # (validity mask bytes; the wait is the isolatable transfer time)
+        dw.note_transfer("ecdsa", "d2h", int(np.asarray(ok).nbytes),
+                         seconds=wait)
+        dw.note_phase("ecdsa", "fetch", wait)
         STATS.in_flight = max(0, STATS.in_flight - 1)
         _IN_FLIGHT_G.set(STATS.in_flight)
         self._device_ok = None
@@ -763,8 +809,12 @@ def _dispatch_device(records: Sequence, br,
                 bucket = max(1024, _bucket_for(len(wire), pallas=True))
                 try:
                     INJECTOR.on_call(GLV_SITE)
-                    arrays = pack_records_glv(wire, bucket)
-                    device_ok, degen = dev.ecdsa_verify_batch_glv(*arrays)
+                    with dw.phase("ecdsa", "pack"):
+                        arrays = pack_records_glv(wire, bucket)
+                    device_ok, degen = _watched_kernel(
+                        _PW_GLV, bucket, arrays,
+                        lambda: dev.ecdsa_verify_batch_glv(*arrays),
+                        jitfn=dev._glv_program if bucket <= 16384 else None)
                     if INJECTOR.should_poison(GLV_SITE):
                         device_ok = ~device_ok
                     STATS.glv_dispatches += 1
@@ -781,23 +831,37 @@ def _dispatch_device(records: Sequence, br,
                         # exact-vreg tiles over a grid, device-side
                         # expansion — the whole batch is one program/round
                         # trip (ops/secp256k1.py)
-                        arrays = pack_records_w4_bytes(wire, bucket)
-                        device_ok, degen = \
-                            dev.ecdsa_verify_batch_pallas_w4_bytes(
-                                *arrays, interpret=_interpret_kernels())
+                        with dw.phase("ecdsa", "pack"):
+                            arrays = pack_records_w4_bytes(wire, bucket)
+                        interp = _interpret_kernels()
+                        device_ok, degen = _watched_kernel(
+                            _PW_W4_BYTES, bucket, arrays,
+                            lambda: dev.ecdsa_verify_batch_pallas_w4_bytes(
+                                *arrays, interpret=interp),
+                            jitfn=(dev._w4_bytes_program
+                                   if bucket <= 16384 else None),
+                            kwargs={"interpret": interp})
                     else:
-                        arrays = pack_records_w4(wire, bucket)
-                        device_ok, degen = dev.ecdsa_verify_batch_pallas_w4(
-                            *map(np.asarray, arrays)
-                        )
+                        with dw.phase("ecdsa", "pack"):
+                            arrays = [np.asarray(a) for a in
+                                      pack_records_w4(wire, bucket)]
+                        device_ok, degen = _watched_kernel(
+                            _PW_W4, bucket, arrays,
+                            lambda: dev.ecdsa_verify_batch_pallas_w4(
+                                *arrays),
+                            split=None)
                 except Exception as e:
                     _note_pallas_failure(e)
                     device_ok = None
             if device_ok is None:
                 bucket = _bucket_for(len(wire), pallas=False)
-                arrays = pack_records(wire, bucket)
-                device_ok = dev.ecdsa_verify_batch_jit(
-                    *map(np.asarray, arrays))
+                with dw.phase("ecdsa", "pack"):
+                    arrays = [np.asarray(a) for a in
+                              pack_records(wire, bucket)]
+                device_ok = _watched_kernel(
+                    _PW_XLA, bucket, arrays,
+                    lambda: dev.ecdsa_verify_batch_jit(*arrays),
+                    jitfn=dev.ecdsa_verify_batch_jit, split=None)
             _note_device_dispatch(len(records), bucket)
             return BatchHandle(len(records), bucket, device_ok, degen=degen,
                                records=wire, breaker=br, kat=True, ctx=ctx)
@@ -1295,10 +1359,14 @@ def _dispatch_packed_device(pub, rs, msg, rn, wrap, n: int,
                         int.from_bytes(pub2[i, 32:].tobytes(), "big")
                         for i in range(m)
                     ]
-                    arrays = _glv_pack_parts(
-                        u1, u2, pub2[:, :32], qy_ints, rs2[:, :32], rn2,
-                        wrap2.astype(bool), range_bad, bucket)
-                    device_ok, degen = dev.ecdsa_verify_batch_glv(*arrays)
+                    with dw.phase("ecdsa", "pack"):
+                        arrays = _glv_pack_parts(
+                            u1, u2, pub2[:, :32], qy_ints, rs2[:, :32],
+                            rn2, wrap2.astype(bool), range_bad, bucket)
+                    device_ok, degen = _watched_kernel(
+                        _PW_GLV, bucket, arrays,
+                        lambda: dev.ecdsa_verify_batch_glv(*arrays),
+                        jitfn=dev._glv_program if bucket <= 16384 else None)
                     if INJECTOR.should_poison(GLV_SITE):
                         device_ok = ~device_ok
                     STATS.glv_dispatches += 1
@@ -1309,12 +1377,20 @@ def _dispatch_packed_device(pub, rs, msg, rn, wrap, n: int,
                     device_ok = degen = None
             if device_ok is None:
                 try:
-                    device_ok, degen = \
-                        dev.ecdsa_verify_batch_pallas_w4_bytes(
-                            pad(u1, 32), pad(u2, 32), pad(pub2[:, :32], 32),
-                            pad(pub2[:, 32:], 32), q_inf,
-                            pad(rs2[:, :32], 32), pad(rn2, 32), wrap8,
-                            interpret=_interpret_kernels())
+                    with dw.phase("ecdsa", "pack"):
+                        arrays = [pad(u1, 32), pad(u2, 32),
+                                  pad(pub2[:, :32], 32),
+                                  pad(pub2[:, 32:], 32), q_inf,
+                                  pad(rs2[:, :32], 32), pad(rn2, 32),
+                                  wrap8]
+                    interp = _interpret_kernels()
+                    device_ok, degen = _watched_kernel(
+                        _PW_W4_BYTES, bucket, arrays,
+                        lambda: dev.ecdsa_verify_batch_pallas_w4_bytes(
+                            *arrays, interpret=interp),
+                        jitfn=(dev._w4_bytes_program
+                               if bucket <= 16384 else None),
+                        kwargs={"interpret": interp})
                 except (KeyboardInterrupt, SystemExit):
                     raise
                 except Exception as e:

@@ -120,6 +120,8 @@ def compute_merkle_root_tpu_ex(hashes: list[bytes]) -> tuple:
     n = len(hashes)
 
     def device():
+        from ..util import devicewatch as dw
+
         bucket = max(PAD_LANES, 1 << (n - 1).bit_length())
         words = _digests_to_words(
             np.frombuffer(b"".join(hashes), dtype=np.uint8).reshape(-1, 32)
@@ -128,11 +130,21 @@ def compute_merkle_root_tpu_ex(hashes: list[bytes]) -> tuple:
             words = np.concatenate(
                 [words, np.zeros((bucket - n, 8), dtype=np.uint32)], axis=0
             )
-        root_words, mutated, witness = _tree_reduce_jit(
-            jnp.asarray(words), bucket.bit_length() - 1, jnp.uint32(n)
-        )
+        # watched dispatch: pow2 buckets bound the compiled shapes to one
+        # per level count — declare the budget as the plausible pow2 range
+        # (2^7 leaf floor .. 2^30), so a padding regression that starts
+        # compiling per-tx-count shapes fires the retrace sentinel
+        dw.note_transfer("merkle", "h2d", int(words.nbytes))
+        with dw.program("merkle_tree", shape_budget=24).dispatch(
+                bucket, jitfn=_tree_reduce_jit,
+                args=(words, bucket.bit_length() - 1, np.uint32(n))):
+            root_words, mutated, witness = _tree_reduce_jit(
+                jnp.asarray(words), bucket.bit_length() - 1, jnp.uint32(n)
+            )
         root = np.asarray(root_words, dtype=np.uint32)
         wit = np.asarray(witness, dtype=np.uint32)
+        dw.note_transfer("merkle", "d2h",
+                         int(root.nbytes) + int(wit.nbytes))
         return (_words_to_digests(root[None, :])[0].tobytes(), bool(mutated),
                 _words_to_digests(wit[None, :])[0].tobytes())
 

@@ -18,6 +18,7 @@ a ('chip',) mesh; each chip owns a contiguous nonce range).
 
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import jax
@@ -109,16 +110,38 @@ def sweep_header(header80: bytes, target: int, start_nonce: int = 0,
     ignored; bytes 0..75 define the search. Mirrors generateBlocks' semantics
     (bounded attempts, first hit wins) at tile granularity.
     """
+    from ..util import devicewatch as dw
+
     assert len(header80) == 80
     midstate = np.array(header_midstate(header80), dtype=np.uint32)
     tail = bytes_to_words_np(np.frombuffer(header80[64:76], dtype=np.uint8))
     tgt = target_to_limbs_np(target)
     n_tiles = min((max_nonces + tile - 1) // tile, (1 << 32) // tile)
-    found, nonce, tiles = sweep_jit(
-        jnp.asarray(midstate), jnp.asarray(tail), jnp.asarray(tgt),
-        jnp.uint32(start_nonce), jnp.uint32(n_tiles), tile=tile,
-    )
+    # watched dispatch: the compiled shape is the (tile,) specialization —
+    # a node mints at most a couple (DEFAULT_TILE + the regtest/CPU tile),
+    # so a sweep that starts recompiling per call trips the sentinel
+    dw.note_transfer("miner", "h2d",
+                     int(midstate.nbytes + tail.nbytes + tgt.nbytes))
+    t0 = time.perf_counter()
+    with dw.program("miner_sweep", shape_budget=4).dispatch(
+            tile, jitfn=sweep_jit,
+            args=(midstate, tail, tgt, np.uint32(start_nonce),
+                  np.uint32(n_tiles)),
+            kwargs={"tile": tile}):
+        found, nonce, tiles = sweep_jit(
+            jnp.asarray(midstate), jnp.asarray(tail), jnp.asarray(tgt),
+            jnp.uint32(start_nonce), jnp.uint32(n_tiles), tile=tile,
+        )
+        # the jit call above only ENQUEUES — settle inside the watch so
+        # the sweep itself lands in the execute phase (the int() fetch
+        # below would otherwise be billed the whole kernel as "transfer")
+        jax.block_until_ready(tiles)
+    dw.note_phase("miner", "execute", time.perf_counter() - t0)
+    t0 = time.perf_counter()
     hashes = int(tiles) * tile
-    if bool(found):
+    hit = bool(found)
+    dw.note_transfer("miner", "d2h", 12,
+                     seconds=time.perf_counter() - t0)
+    if hit:
         return int(nonce), hashes
     return None, hashes

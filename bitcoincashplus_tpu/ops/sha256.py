@@ -24,6 +24,7 @@ differential-check this module against it and hashlib.
 from __future__ import annotations
 
 import os
+import time
 from functools import partial
 
 import jax
@@ -316,17 +317,30 @@ def sha256d_headers(headers: np.ndarray) -> np.ndarray:
 
     Supervised (ops/dispatch): the device batch is spot-checked against the
     host hash of lane 0 before it is trusted; failures/poison degrade to
-    the per-header CPU loop without changing a single digest."""
+    the per-header CPU loop without changing a single digest. The device
+    leg is watched (util/devicewatch): header batches legitimately vary
+    in size, so the program carries NO shape budget — compiles are
+    counted and timed, never flagged."""
     from ..crypto.hashes import sha256d
+    from ..util import devicewatch as dw
     from . import dispatch
 
     if headers.shape[0] == 0:
         return np.zeros((0, 32), dtype=np.uint8)
 
     def device() -> np.ndarray:
-        words = jnp.asarray(headers_to_words_np(headers))
-        h = sha256d_headers_jit(words)
-        return digests_to_bytes([np.asarray(h[:, i]) for i in range(8)])
+        words_np = headers_to_words_np(headers)
+        dw.note_transfer("sha256", "h2d", int(words_np.nbytes))
+        words = jnp.asarray(words_np)
+        with dw.program("sha256_headers").dispatch(
+                words_np.shape, jitfn=sha256d_headers_jit,
+                args=(words_np,)):
+            h = sha256d_headers_jit(words)
+        t0 = time.perf_counter()
+        out = digests_to_bytes([np.asarray(h[:, i]) for i in range(8)])
+        dw.note_transfer("sha256", "d2h", int(out.nbytes),
+                         seconds=time.perf_counter() - t0)
+        return out
 
     def validate(digests: np.ndarray) -> bool:
         return digests[0].tobytes() == sha256d(headers[0].tobytes())

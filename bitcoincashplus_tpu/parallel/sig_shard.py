@@ -135,19 +135,30 @@ def verify_batch_sharded(records, n_chips: int,
         // _CHIP_BUCKET * _CHIP_BUCKET,
     )
     bucket = per_chip * n_chips
+    from ..util import devicewatch as dw
+
     kern = kernel if kernel in ecdsa_batch.ECDSA_KERNELS \
         else ecdsa_batch.active_kernel()
     if kern == "glv" and ecdsa_batch.glv_enabled():
-        arrays = pack_records_glv(records, bucket)
-        ok, degen, _fails = jax.block_until_ready(
-            _sharded_glv_jit(*map(np.asarray, arrays), n_chips=n_chips)
-        )
+        arrays = [np.asarray(a) for a in pack_records_glv(records, bucket)]
+        dw.note_transfer("sig_shard", "h2d",
+                         sum(int(a.nbytes) for a in arrays))
+        # mesh-width x bucket is the compiled-shape signature; no budget —
+        # virtual meshes legitimately sweep 1/2/4/8
+        with dw.program("sig_shard_glv").dispatch((bucket, n_chips)):
+            ok, degen, _fails = jax.block_until_ready(
+                _sharded_glv_jit(*arrays, n_chips=n_chips)
+            )
     else:
-        arrays = pack_records_w4_bytes(records, bucket)
-        ok, degen, _fails = jax.block_until_ready(
-            _sharded_w4_jit(*map(np.asarray, arrays), n_chips=n_chips,
-                            interpret=_use_interpret(n_chips))
-        )
+        arrays = [np.asarray(a)
+                  for a in pack_records_w4_bytes(records, bucket)]
+        dw.note_transfer("sig_shard", "h2d",
+                         sum(int(a.nbytes) for a in arrays))
+        with dw.program("sig_shard_w4").dispatch((bucket, n_chips)):
+            ok, degen, _fails = jax.block_until_ready(
+                _sharded_w4_jit(*arrays, n_chips=n_chips,
+                                interpret=_use_interpret(n_chips))
+            )
     out = np.asarray(ok)[:n].copy()
     degen = np.asarray(degen)[:n]
     idxs = np.nonzero(degen)[0]

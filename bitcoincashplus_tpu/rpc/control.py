@@ -119,7 +119,7 @@ def gettpuinfo(node, params):
     except Exception:
         pass
     from ..mempool.accept import accept_latency_quantiles
-    from ..util import telemetry
+    from ..util import devicewatch, telemetry
 
     return {
         "backend": node.backend,
@@ -156,6 +156,12 @@ def gettpuinfo(node, params):
             "spans": telemetry.TRACER.stats(),
             "accept_latency": accept_latency_quantiles(),
         },
+        # device-lane monitor (util/devicewatch): per-program compile
+        # counts + distinct-shape signatures vs declared budgets (+ any
+        # first-compile cost-analysis FLOPs/bytes), host<->device
+        # transfer byte totals per site, profiler state, and the stall
+        # watchdog
+        "device": devicewatch.snapshot(),
     }
 
 
@@ -189,6 +195,52 @@ def dumptrace(node, params):
                                                        "trace.json")
     events = telemetry.TRACER.dump(path)
     return {"path": path, "events": events, "mode": telemetry.mode()}
+
+
+@rpc_method("startprofile")
+def startprofile(node, params):
+    """startprofile ( "dir" )
+
+    Start an on-demand jax.profiler trace (device-side XLA timeline —
+    the layer below the span tracer's host view). Default directory:
+    <datadir>/profile. Stop with ``stopprofile``; the dump is
+    TensorBoard-compatible (plugins/profile/<ts>/*.xplane.pb +
+    trace.json.gz — load with tensorboard --logdir or xprof). Errors if
+    a profile is already running (the profiler is process-global)."""
+    import os as _os
+
+    from ..util import devicewatch
+    from .registry import RPC_INVALID_PARAMETER, RPC_MISC_ERROR
+
+    path = str(params[0]) if params else _os.path.join(node.datadir,
+                                                       "profile")
+    try:
+        return devicewatch.start_profile(path)
+    except RuntimeError as e:
+        raise RPCError(RPC_INVALID_PARAMETER, str(e)) from None
+    except Exception as e:  # noqa: BLE001 — backend/profiler failure
+        raise RPCError(RPC_MISC_ERROR,
+                       f"startprofile failed: {type(e).__name__}: {e}"
+                       ) from None
+
+
+@rpc_method("stopprofile")
+def stopprofile(node, params):
+    """stopprofile
+
+    Stop the running jax.profiler trace started by ``startprofile``;
+    returns {path, seconds}. Errors if no profile is running."""
+    from ..util import devicewatch
+    from .registry import RPC_INVALID_PARAMETER, RPC_MISC_ERROR
+
+    try:
+        return devicewatch.stop_profile()
+    except RuntimeError as e:
+        raise RPCError(RPC_INVALID_PARAMETER, str(e)) from None
+    except Exception as e:  # noqa: BLE001 — backend/profiler failure
+        raise RPCError(RPC_MISC_ERROR,
+                       f"stopprofile failed: {type(e).__name__}: {e}"
+                       ) from None
 
 
 @rpc_method("createmultisig")

@@ -53,6 +53,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from ..ops import ecdsa_batch
+from ..util import devicewatch as dw
 from ..util import telemetry as tm
 from ..util.log import log_printf
 from ..validation.sigcache import SignatureCache
@@ -199,7 +200,8 @@ class SigService:
     def __init__(self, sigcache: Optional[SignatureCache] = None,
                  backend: str = "auto", kernel: Optional[str] = None,
                  deadline_ms: float = DEFAULT_DEADLINE_MS,
-                 lanes: int = DEFAULT_LANES):
+                 lanes: int = DEFAULT_LANES,
+                 watchdog_quiet: Optional[float] = None):
         if deadline_ms < 0:
             raise ValueError(
                 f"-sigservicedeadline={deadline_ms}: must be >= 0")
@@ -210,6 +212,9 @@ class SigService:
         self.kernel = kernel
         self.deadline_s = deadline_ms / 1e3
         self.lanes = lanes
+        # stall-watchdog quiet period (util/devicewatch; -watchdogquiet):
+        # None = env/default, <= 0 = detection off for this subsystem
+        self.watchdog_quiet = watchdog_quiet
         self.result_timeout = RESULT_TIMEOUT_S
         self._cond = threading.Condition()
         self._pending: list[_Lane] = []
@@ -233,6 +238,12 @@ class SigService:
         self._thread = threading.Thread(
             target=self._run, name="sigservice", daemon=True)
         self._thread.start()
+        # no-progress sentinel (observe-only): pending lanes with no
+        # flush completion for the quiet period = a wedged flush thread
+        # (len() is GIL-atomic — the probe must never take the condvar)
+        dw.WATCHDOG.register("sigservice",
+                             pending_fn=lambda: len(self._pending),
+                             quiet_s=self.watchdog_quiet)
         return self
 
     def running(self) -> bool:
@@ -246,6 +257,7 @@ class SigService:
         if self._thread is not None:
             self._thread.join(timeout=self.result_timeout)
             self._thread = None
+        dw.WATCHDOG.unregister("sigservice")
 
     # -- enqueue side ---------------------------------------------------
 
@@ -432,6 +444,10 @@ class SigService:
             if err is not None:
                 self.stats["flush_errors"] += 1
             self._cond.notify_all()  # one settle broadcast per flush
+        # progress beat even on an errored flush: the lanes were resolved
+        # (to err) and the thread is demonstrably still draining work —
+        # the watchdog watches for NO progress, not for failures
+        dw.WATCHDOG.beat("sigservice")
         if err is not None:
             log_printf("sigservice flush failed (%s: %s) — %d lane(s) "
                        "degrade to caller-side CPU re-verify",
@@ -458,6 +474,7 @@ class SigService:
             k: round(v * 1e3, 3)
             for k, v in _WAIT_H.quantiles((0.5, 0.9, 0.99)).items()
         }
+        out["watchdog"] = dw.WATCHDOG.snapshot().get("sigservice", {})
         return out
 
 

@@ -26,6 +26,7 @@ from ..consensus.serialize import hash_to_hex
 from ..consensus.tx import COutPoint, CTransaction, money_range
 from ..consensus.tx_check import TxValidationError, check_transaction, is_final_tx
 from ..script.script import script_int
+from ..util import devicewatch as dw
 from ..util import telemetry as tm
 from ..util.log import log_print
 from .chain import BlockStatus, CBlockIndex, CChain
@@ -147,6 +148,22 @@ class ChainstateManager:
             "lookups": 0, "cache_resolved": 0,
             "skipped_scans": 0, "skipped_lookups": 0,
         }
+        # settle-horizon stall sentinel (util/devicewatch, observe-only):
+        # speculative blocks parked with no settle progress for the quiet
+        # period = a wedged device settle. Registration replaces by name
+        # (a fresh manager supersedes the old one's closure — the PR 6
+        # collector pattern); the node re-registers with -watchdogquiet
+        # and unregisters at close. The probe holds only a WEAKREF: a
+        # bare manager (library use, tools) has no close path, and a
+        # strong closure would pin its whole UTXO cache in the process-
+        # global registry for the rest of the process.
+        import weakref
+
+        self_ref = weakref.ref(self)
+        dw.WATCHDOG.register(
+            "pipeline",
+            pending_fn=lambda: (
+                len(m._horizon) if (m := self_ref()) is not None else 0))
         self._init_genesis()
 
     # ------------------------------------------------------------------
@@ -898,6 +915,7 @@ class ChainstateManager:
                     cb(idx)
             ps["commit_ms"] += (_time.perf_counter() - t1) * 1e3
             _COMMIT_H.observe(_time.perf_counter() - t1)
+            dw.WATCHDOG.beat("pipeline")  # one block settled = progress
             return True
         finally:
             self._settling = settling_save
@@ -924,6 +942,8 @@ class ChainstateManager:
         ps["unwinds"] += 1
         ps["unwound_blocks"] += len(entries)
         _UNWINDS_C.inc(len(entries))
+        # an unwind drains the horizon — progress, not a stall
+        dw.WATCHDOG.beat("pipeline")
         tm.instant("block.unwind", height=failed.height,
                    hash=hash_to_hex(failed.hash)[:16],
                    dropped=len(entries), reason=err.reason)

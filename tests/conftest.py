@@ -60,13 +60,18 @@ def pytest_collection_modifyitems(config, items):
     groups. Stable sort: order within each group is unchanged."""
 
     def group(item) -> int:
+        # the ``devprof`` suite (device-lane observability — the same
+        # registry-zeroing isolation pattern as telemetry) runs after
+        # ``telemetry`` and before ``serving``
         if "functional" not in str(item.fspath):
             if item.get_closest_marker("serving"):
+                return 4
+            if item.get_closest_marker("devprof"):
                 return 3
             if item.get_closest_marker("telemetry"):
                 return 2
             return 1 if item.get_closest_marker("pipeline") else 0
-        return 5 if item.get_closest_marker("adversarial") else 4
+        return 6 if item.get_closest_marker("adversarial") else 5
 
     items.sort(key=group)
 

@@ -81,7 +81,13 @@ def test_node_trace_smoke(tmp_path, monkeypatch, restore_mode):
         _st, _ct, body = handle_metrics(node)
         text = body.decode()
         for prefix in ("bcp_dispatch_", "bcp_ecdsa_", "bcp_pipeline_",
-                       "bcp_sigcache_", "bcp_mempool_", "bcp_net_"):
+                       "bcp_sigcache_", "bcp_mempool_", "bcp_net_",
+                       # device-lane families (util/devicewatch): the
+                       # compile sentinel, transfer totals, and the
+                       # memory collector must be visible after a
+                       # regtest import — ISSUE 8 acceptance surface
+                       "bcp_xla_compile_", "bcp_device_transfer_bytes",
+                       "bcp_device_memory_", "bcp_watchdog_"):
             assert any(n.startswith(prefix) for n in snap), prefix
             assert prefix in text, prefix
         # the pipelined import actually recorded per-block legs

@@ -293,9 +293,119 @@ def run_ecdsa_census():
     return parts
 
 
+# ---- live cost-analysis drift check (--ecdsa) -------------------------------
+#
+# The static jaxpr census above is a MODEL derived from a specific kernel
+# + compiler state; the compiled executable's own cost_analysis() is what
+# XLA actually admitted to for the SAME state, recorded below as the
+# census's compiled twin. The units are not cross-comparable (census =
+# lane-shaped primitives of the kernel cores; cost_analysis = element
+# flops of the whole lowered program — the w4 path additionally lowers
+# through pallas interpret on CPU), so drift is per kernel against its
+# OWN recorded baseline: a live compiled-flops number that moved > 10%
+# from the baseline means a kernel or compiler change shifted the real
+# op mix and BOTH the census and these baselines must be re-derived.
+#
+# This drives one real dispatch per kernel through the util/devicewatch
+# program registry (BCP_DEVICEWATCH_COST=always captures cost_analysis
+# at first compile into the SAME "ecdsa_glv"/"ecdsa_w4_bytes" programs a
+# running node populates — the live registry, not a side channel).
+
+DRIFT_BUDGET = 0.10
+
+# compiled flops/lane at bucket 1024, recorded when the §7 census was
+# last validated (jax 0.4.37). Keyed by the lowering arrangement — the
+# CPU arrangement is plain-XLA GLV + pallas-INTERPRET w4; a Mosaic (TPU)
+# run lowers differently and reports without flagging until a baseline
+# for that arrangement is recorded here.
+COST_BASELINES = {
+    "cpu": {"ecdsa_glv": 2_370_312.0, "ecdsa_w4_bytes": 1_618_602.0},
+}
+
+
+def run_ecdsa_live_drift(parts, bucket: int = 1024):
+    os.environ["BCP_DEVICEWATCH_COST"] = "always"
+    import tempfile
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(tempfile.gettempdir(), "bcp-jax-test-cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+
+    from bitcoincashplus_tpu.crypto import secp256k1 as orc
+    from bitcoincashplus_tpu.ops import ecdsa_batch as eb
+    from bitcoincashplus_tpu.ops import secp256k1 as S
+    from bitcoincashplus_tpu.ops.sha256 import backend_is_cpu
+    from bitcoincashplus_tpu.script.interpreter import SigCheckRecord
+    from bitcoincashplus_tpu.util import devicewatch as dwatch
+
+    rng = random.Random(17)
+    records = []
+    for _ in range(4):
+        sk = rng.randrange(1, orc.N)
+        e = rng.getrandbits(256) % orc.N
+        r, s = orc.ecdsa_sign(sk, e)
+        records.append(SigCheckRecord(orc.point_mul(sk, orc.G), r, s, e))
+
+    print(f"\nlive cost-analysis drift check (bucket {bucket}, one real "
+          "dispatch per kernel through the devicewatch registry)...")
+    glv_args = eb.pack_records_glv(records, bucket)
+    with dwatch.program("ecdsa_glv").dispatch(
+            bucket, jitfn=S._glv_program, args=glv_args):
+        jax.block_until_ready(S._glv_program(*glv_args))
+    interp = backend_is_cpu()
+    w4_args = eb.pack_records_w4_bytes(records, bucket)
+    with dwatch.program("ecdsa_w4_bytes").dispatch(
+            bucket, jitfn=S._w4_bytes_program, args=w4_args,
+            kwargs={"interpret": interp}):
+        jax.block_until_ready(
+            S._w4_bytes_program(*w4_args, interpret=interp))
+
+    progs = dwatch.snapshot()["programs"]
+    sig = str((bucket,))
+    live = {}
+    for name in ("ecdsa_glv", "ecdsa_w4_bytes"):
+        cost = progs.get(name, {}).get("cost", {}).get(sig)
+        if not cost:
+            print("live drift check: cost_analysis unavailable on this "
+                  "backend — skipped")
+            return None
+        live[name] = cost["flops"] / bucket
+
+    arrangement = "cpu" if interp else "mosaic"
+    baselines = COST_BASELINES.get(arrangement)
+    census_ratio = parts["glv"]["total"] / parts["w4"]["total"]
+    print(f"{'':<28}{'w4':>14}{'glv':>14}")
+    print(f"{'census ops/lane':<28}{parts['w4']['total']:>14,}"
+          f"{parts['glv']['total']:>14,}")
+    print(f"{'compiled flops/lane':<28}{live['ecdsa_w4_bytes']:>14,.0f}"
+          f"{live['ecdsa_glv']:>14,.0f}")
+    print(f"census glv/w4 ratio: {census_ratio:.4f} "
+          "(primitive counts of the kernel cores — see §7)")
+    if baselines is None:
+        print(f"no compiled-cost baseline recorded for the "
+              f"{arrangement!r} lowering arrangement — reporting only "
+              "(record one in COST_BASELINES to arm the drift flag)")
+        return {"live": live, "drift": None, "ok": None}
+    out = {"live": live, "ok": True}
+    for name, base in baselines.items():
+        drift = abs(live[name] - base) / base
+        flagged = drift > DRIFT_BUDGET
+        out[name] = {"baseline": base, "live": live[name], "drift": drift}
+        out["ok"] = out["ok"] and not flagged
+        verdict = ("DRIFT EXCEEDS BUDGET — a kernel/compiler change "
+                   "moved the real op mix; re-derive the §7 census AND "
+                   "this baseline") if flagged else "within budget"
+        print(f"{name}: live {live[name]:,.0f} vs baseline {base:,.0f} "
+              f"flops/lane — drift {drift * 100:.1f}% "
+              f"(budget {DRIFT_BUDGET * 100:.0f}%) — {verdict}")
+    return out
+
+
 def main():
     if ECDSA_MODE:
-        run_ecdsa_census()
+        parts = run_ecdsa_census()
+        run_ecdsa_live_drift(parts)
         return
     spec_ops, full_ops, spec_detail = run_census()
     print(f"census: specialized h7 sweep = {spec_ops} vector ops/nonce")
