@@ -748,6 +748,145 @@ def bench_import_pipeline():
         shutil.rmtree(chaosdir, ignore_errors=True)
 
 
+def _scalar_sweep(header80, target, max_nonces=1 << 32, tile=0):
+    """Scalar host PoW loop for corpus generation — regtest targets hit
+    in ~2 nonces, so the batched device sweep's per-dispatch latency
+    would dominate corpus build time for no measurement value."""
+    import struct as _st
+
+    from bitcoincashplus_tpu.consensus.block import NONCE_OFFSET
+    from bitcoincashplus_tpu.crypto.hashes import sha256d
+
+    base = header80[:NONCE_OFFSET]
+    for nonce in range(max_nonces):
+        raw = base + _st.pack("<I", nonce)
+        if int.from_bytes(sha256d(raw), "little") <= target:
+            return nonce, nonce + 1
+    return None, max_nonces
+
+
+def _gen_fork_corpus(workdir, segments=6, seg_len=4, fork_depth=3):
+    """A reorg-heavy corpus (ISSUE 9): linear segments punctuated by
+    deeper competing branches. Each round mines ``seg_len`` blocks, rolls
+    the chain back ``fork_depth`` (invalidateblock), mines a longer
+    replacement branch, and reconsiders the stale branch — the block
+    files then carry BOTH branches in chronological order, so a reimport
+    must fight through a fork war every few blocks: stale branches enter
+    the speculation tree, lose on work, and drop (or reorg out if they
+    settled first). Returns corpus counts."""
+    from bitcoincashplus_tpu.mining.assembler import BlockAssembler
+    from bitcoincashplus_tpu.mining.generate import mine_block
+    from bitcoincashplus_tpu.node.config import Config
+    from bitcoincashplus_tpu.node.node import Node
+    from bitcoincashplus_tpu.wallet.keys import CKey
+
+    cfg = Config()
+    cfg.args["datadir"] = [workdir]
+    cfg.args["regtest"] = ["1"]
+    node = Node(config=cfg)
+    cs = node.chainstate
+    spk = CKey(0x0906).p2pkh_script()
+    assembler = BlockAssembler(cs, None)
+    xn = [0]
+
+    def mine(n):
+        # per-block extranonce entropy: a replacement branch's first
+        # block must not assemble byte-identical to the stale one it
+        # replaces (same parent/height/time/script -> same hash, which
+        # would arrive as a duplicate of a FAILED index)
+        for _ in range(n):
+            xn[0] += 1009
+            blk = mine_block(assembler, spk, sweep=_scalar_sweep,
+                             extranonce_start=xn[0])
+            cs.process_new_block(blk)
+
+    n_blocks = n_forks = 0
+    for _ in range(segments):
+        mine(seg_len)
+        n_blocks += seg_len
+        tip = cs.tip()
+        stale_root = tip.get_ancestor(tip.height - fork_depth + 1)
+        cs.invalidate_block(stale_root)
+        mine(fork_depth + 1)
+        n_blocks += fork_depth + 1
+        cs.reconsider_block(stale_root)  # stale branch: candidate again
+        n_forks += 1
+    height = cs.tip().height
+    node.close()
+    return {"blocks": n_blocks, "forks": n_forks, "height": height,
+            "fork_depth": fork_depth}
+
+
+def bench_fork_storm():
+    """ISSUE 9 satellite metric: the speculation-tree pipelined engine vs
+    the serial engine over the SAME reorg-heavy corpus — wall times, the
+    unwind/branch-drop overhead fraction (speculative connects whose work
+    was thrown away), reorg accounting, and the byte-identical-chainstate
+    check. Writes BENCH_r09.json (schema_version=2 host stamp)."""
+    import shutil
+    import tempfile
+
+    segments = int(os.environ.get("BCP_BENCH_FORKSTORM_SEGMENTS", "6"))
+    depth = int(os.environ.get("BCP_BENCH_PIPELINE_DEPTH", "8"))
+    workdir = tempfile.mkdtemp(prefix="bcp-forkstorm-")
+    try:
+        corpus = _gen_fork_corpus(workdir, segments=segments)
+        runs = {}
+        digests = {}
+        for mode, d in (("pipelined", depth), ("serial", 1)):
+            runs[mode] = _run_reindex(workdir, pipeline_depth=d,
+                                      force_python=True)
+            digests[mode] = _chainstate_digest(workdir)
+        pipe = runs["pipelined"]["pipeline"]
+        tree = pipe.get("tree", {})
+        settled = max(1, pipe.get("settled_blocks", 0))
+        wasted = (pipe.get("unwound_blocks", 0)
+                  + tree.get("dropped_blocks", 0))
+        overhead_fraction = round(wasted / (settled + wasted), 4)
+        speedup = round(runs["serial"]["wall_s"]
+                        / max(runs["pipelined"]["wall_s"], 1e-9), 4)
+        result = {
+            "metric": "fork_storm",
+            **_bench_stamp(),
+            "corpus": corpus,
+            "wall_s": {"pipelined": round(runs["pipelined"]["wall_s"], 3),
+                       "serial": round(runs["serial"]["wall_s"], 3)},
+            "pipelined_vs_serial_speedup": speedup,
+            "unwind_overhead_fraction": overhead_fraction,
+            "tree": {
+                "reorgs": tree.get("reorgs"),
+                "reorg_depth_max": tree.get("reorg_depth_max"),
+                "branch_drops": tree.get("branch_drops"),
+                "dropped_blocks": tree.get("dropped_blocks"),
+                "branches_live_max": tree.get("branches_live_max"),
+                "serial_linear_fallbacks":
+                    tree.get("serial_linear_fallbacks"),
+            },
+            "unwinds": pipe.get("unwinds"),
+            "chainstate_identical": digests["pipelined"]
+            == digests["serial"],
+            "note": "Python validation engine (BCP_NO_NATIVE_IMPORT=1) "
+                    "over a coinbase-only fork-war corpus: every segment "
+                    "carries a stale branch the import must out-work; "
+                    "unwind_overhead_fraction = speculative blocks whose "
+                    "work was dropped / (settled + dropped) — the price "
+                    "of concurrent branch validation on this corpus",
+        }
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_r09.json"), "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+        emit("fork_storm", runs["pipelined"]["wall_s"], "s", speedup,
+             **{k: v for k, v in result.items() if k != "metric"})
+        return {"fork_storm_speedup": speedup,
+                "fork_storm_identical": result["chainstate_identical"]}
+    except Exception as e:  # pragma: no cover - diagnostics only
+        emit("fork_storm", -1, "s", 0.0, error=f"{type(e).__name__}: {e}")
+        return None
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_telemetry_overhead():
     """ISSUE 6 satellite: what the unified telemetry layer costs. The
     import_pipeline corpus is imported through the pipelined Python
@@ -1419,6 +1558,7 @@ def main():
     recap["ecdsa_sigs_per_s"] = round(device_sps) if device_sps else None
     recap.update(bench_reindex(device_sps) or {})  # config 6: north star
     recap.update(bench_import_pipeline() or {})  # ISSUE 4: settle horizon
+    recap.update(bench_fork_storm() or {})  # ISSUE 9: speculation tree
     recap.update(bench_telemetry_overhead() or {})  # ISSUE 6: < 2% budget
     recap.update(bench_serving() or {})  # ISSUE 7: serviced >= 2x sync
     try:
@@ -1435,9 +1575,11 @@ def main():
 
 
 if __name__ == "__main__":
-    # `python bench.py dispatch_breakdown` runs the ISSUE 8 phase
-    # decomposition alone (it is also part of the full run)
+    # `python bench.py dispatch_breakdown` / `python bench.py fork_storm`
+    # run one section alone (both are also part of the full run)
     if len(sys.argv) > 1 and sys.argv[1] == "dispatch_breakdown":
         bench_dispatch_breakdown()
+    elif len(sys.argv) > 1 and sys.argv[1] == "fork_storm":
+        bench_fork_storm()
     else:
         main()

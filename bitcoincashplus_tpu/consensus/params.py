@@ -42,6 +42,9 @@ class Consensus:
     # BCH-family deltas [fork-delta, hedged — SURVEY.md §0]:
     uahf_height: int = -1  # SIGHASH_FORKID activation (-1 = never)
     use_cash_daa: bool = False
+    # cw-144 DAA activation height (BCH Nov-2017 rules); below it the
+    # EDA applies while use_cash_daa is set. -1 = EDA era forever.
+    daa_height: int = -1
     # BIP9 versionbits (src/consensus/params.h nRuleChangeActivationThreshold
     # / nMinerConfirmationWindow / vDeployments) — see consensus/versionbits.py
     rule_change_activation_threshold: int = 1916  # 95% of 2016
@@ -130,7 +133,7 @@ def main_params() -> ChainParams:
         bip66_height=363_725,  # v3 blocks (BIP66)
         csv_height=419_328,  # CSV softfork activation
         uahf_height=478_559,  # [fork-delta, hedged] BCH-family split height
-        use_cash_daa=False,  # enabled per-run via -cashdaa once height rules land
+        use_cash_daa=False,  # per-run via -cashdaa/-daaheight (node/config)
         deployments=(
             # vDeployments[DEPLOYMENT_TESTDUMMY] (chainparams.cpp)
             VBDeployment("testdummy", 28, 1199145601, 1230767999),

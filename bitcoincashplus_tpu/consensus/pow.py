@@ -133,8 +133,36 @@ def get_next_work_required(prev_index, new_block_time: int, params) -> int:
     # regtest/testnet chains.
 
     height = prev_index.height + 1
+    # BCH-lineage routing [fork-delta, hedged]: with use_cash_daa set,
+    # cw-144 DAA from daa_height and the EDA overlay before it. The
+    # cash rules deliberately do NOT short-circuit on pow_no_retargeting:
+    # -cashdaa on regtest is the fork-storm harness knob and must run the
+    # same rule code every node will agree on (on a min-difficulty chain
+    # both rules clamp at/near pow_limit, so mining stays trivial).
+    if params.use_cash_daa and height >= params.daa_height >= 0:
+        return get_next_work_required_cash(prev_index, new_block_time, params)
     interval = params.difficulty_adjustment_interval
     if height % interval != 0:
+        if params.use_cash_daa:
+            # EDA era (BCH-lineage): on min-difficulty chains the
+            # 20-minute exception answers first, and otherwise the rule
+            # anchors on the last REAL-difficulty block (the same
+            # walk-back as the Core branch below — without it one
+            # min-difficulty block would floor the whole interval at
+            # pow_limit, diverging from reference nodes); then the
+            # 12h-MTP-gap emergency adjustment, which clamps at
+            # pow_limit so all-min chains keep their bits while still
+            # RUNNING the rule every node must agree on
+            anchor = prev_index
+            if params.pow_allow_min_difficulty_blocks:
+                if (new_block_time
+                        > prev_index.time + params.pow_target_spacing * 2):
+                    return pow_limit_bits
+                while (anchor.prev is not None
+                       and anchor.height % interval != 0
+                       and anchor.bits == pow_limit_bits):
+                    anchor = anchor.prev
+            return eda_bits(anchor, params)
         if params.pow_allow_min_difficulty_blocks:
             # Testnet special-case: 20-minute gap → min difficulty; otherwise
             # walk back to the last non-min-difficulty block.
@@ -180,6 +208,28 @@ def calculate_next_work_required(prev_index, first_block_time: int, params) -> i
 
 
 # ---- BCH-family difficulty [fork-delta, hedged] ----
+
+def eda_bits(prev_index, params) -> int:
+    """Emergency Difficulty Adjustment (BCH-lineage pow.cpp, the Aug-2017
+    pre-DAA rule): on a non-retarget height, if the median-time-past gap
+    across the last six blocks exceeds 12 hours, the target grows by 25%
+    (difficulty drops 20%), clamped at pow_limit. Otherwise the previous
+    bits carry forward. Only reachable when params.use_cash_daa and the
+    height is below daa_height."""
+    if prev_index.height < 6:
+        return prev_index.bits
+    anc = prev_index.get_ancestor(prev_index.height - 6)
+    if anc is None:
+        return prev_index.bits
+    mtp_gap = prev_index.get_median_time_past() - anc.get_median_time_past()
+    if mtp_gap <= 12 * 3600:
+        return prev_index.bits
+    target, _ = compact_to_target(prev_index.bits)
+    target += target >> 2  # +25% target = -20% difficulty
+    if target > params.pow_limit:
+        target = params.pow_limit
+    return target_to_compact(target)
+
 
 def get_next_work_required_cash(prev_index, new_block_time: int, params) -> int:
     """cw-144 DAA (simplified median-past form) used by BCH-family forks after

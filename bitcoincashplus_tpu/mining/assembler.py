@@ -59,6 +59,13 @@ class BlockAssembler:
         """CreateNewBlock: coinbase + greedy package selection + a
         TestBlockValidity dry-run (the reference asserts its own template
         connects)."""
+        # settle barrier: a template is a tip externalization — mining on
+        # an unsettled speculative tip would select mempool txs the
+        # speculative layer already spent (the mempool only learns of
+        # them at settle), assembling an invalid child
+        settle = getattr(self.chainstate, "settle_horizon", None)
+        if settle is not None:
+            settle()
         tip = self.chainstate.tip()
         assert tip is not None
         height = tip.height + 1

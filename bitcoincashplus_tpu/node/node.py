@@ -209,6 +209,24 @@ class Node:
                 raise ConfigError(str(e)) from None
         else:
             self.ecdsa_kernel = _eb.active_kernel()
+        # -cashdaa / -daaheight=<n>: enable the BCH-lineage difficulty
+        # rules (EDA from activation, cw-144 DAA from daaheight) on this
+        # chain — the fork-storm harness crosses the EDA->DAA boundary
+        # mid-reorg with these (consensus/pow.py). Applied to the frozen
+        # params BEFORE any consensus object is built so every consumer
+        # (chainstate, assembler, P2P header checks) sees one rule set.
+        if config.get_bool("cashdaa"):
+            import dataclasses as _dc
+
+            daa_height = config.get_int("daaheight", 0)
+            if daa_height < 0:
+                raise ConfigError(
+                    f"-daaheight={daa_height}: must be >= 0")
+            self.params = _dc.replace(
+                self.params,
+                consensus=_dc.replace(self.params.consensus,
+                                      use_cash_daa=True,
+                                      daa_height=daa_height))
         verifier = BlockScriptVerifier(self.params, backend=backend,
                                        sigcache=self.sigcache,
                                        kernel=self.ecdsa_kernel)
@@ -236,7 +254,7 @@ class Node:
 
         _dw.WATCHDOG.register(
             "pipeline",
-            pending_fn=lambda: len(self.chainstate._horizon),
+            pending_fn=lambda: len(self.chainstate._spec),
             quiet_s=self.watchdog_quiet)
         self.sigservice = None
         if svc_mode in ("on", "1"):
@@ -250,6 +268,10 @@ class Node:
                     deadline_ms=config.get_int("sigservicedeadline", 4),
                     lanes=config.get_int("sigservicelanes", 2046),
                     watchdog_quiet=self.watchdog_quiet,
+                    # -sigservicebuffers=<n>: in-flight flush slots — 2
+                    # overlaps host pack of flush N+1 with device verify
+                    # of flush N (1 = the single-slot PR 7 loop)
+                    buffers=config.get_int("sigservicebuffers", 2),
                 ).start()
             except ValueError as e:
                 raise ConfigError(str(e)) from None
@@ -260,6 +282,21 @@ class Node:
         # "Pipelined validation & the settle horizon")
         self.pipeline_depth = max(1, config.get_int("pipelinedepth", 4))
         self.chainstate.pipeline_depth = self.pipeline_depth
+        # -specbranches=<n>: cap on concurrently-validating speculation-
+        # tree branches (competing tips); -spechold=<ms>: live-path settle
+        # grace — a tip younger than this stays speculative so a fork-race
+        # competitor can join the tree (0 = settle eagerly, the default;
+        # see README "Speculation tree & fork storms")
+        self.spec_branches = config.get_int("specbranches", 4)
+        if self.spec_branches < 1:
+            raise ConfigError(
+                f"-specbranches={self.spec_branches}: must be >= 1")
+        spec_hold_ms = config.get_int("spechold", 0)
+        if spec_hold_ms < 0:
+            raise ConfigError(f"-spechold={spec_hold_ms}: must be >= 0")
+        self.spec_hold_s = spec_hold_ms / 1e3
+        self.chainstate.max_branches = self.spec_branches
+        self.chainstate.spec_hold_s = self.spec_hold_s
         loaded = self.chainstate.load_block_index()
         if loaded:
             log_printf("block index loaded: tip height %d",
@@ -782,6 +819,8 @@ class Node:
             script_verifier=verifier, index_db=self.index_db,
         )
         self.chainstate.pipeline_depth = self.pipeline_depth
+        self.chainstate.max_branches = self.spec_branches
+        self.chainstate.spec_hold_s = self.spec_hold_s
         self.chainstate.sig_service = self.sigservice
         # the fresh manager re-registered the pipeline watchdog with the
         # env default quiet — restore this node's -watchdogquiet wiring
@@ -789,7 +828,7 @@ class Node:
 
         _dw.WATCHDOG.register(
             "pipeline",
-            pending_fn=lambda: len(self.chainstate._horizon),
+            pending_fn=lambda: len(self.chainstate._spec),
             quiet_s=getattr(self, "watchdog_quiet", None))
         self.chainstate.load_block_index()
 

@@ -980,13 +980,14 @@ class SigBatchFuture:
     (settling the horizon's oldest block must never deadlock on lanes
     parked behind it)."""
 
-    __slots__ = ("_packer", "_segments", "_queued", "_result")
+    __slots__ = ("_packer", "_segments", "_queued", "_result", "_tag")
 
     def __init__(self, packer):
         self._packer = packer
         self._segments = []  # (handle-wrapper, start, end), dispatch order
         self._queued = 0     # records still in the packer's pending buffer
         self._result = None
+        self._tag = None     # speculation-tree branch attribution
 
     def result(self) -> np.ndarray:
         if self._result is None:
@@ -1043,6 +1044,10 @@ class LanePacker:
     the device path open all lanes go to the CPU engine and aggregation
     would only add settle latency."""
 
+    # branch-attribution bound: the per-tag lane tallies must not grow
+    # without limit under a fork storm minting fresh branch tags
+    MAX_TAGS = 64
+
     def __init__(self, backend: str = "auto", lanes: int = 2046,
                  kernel: str | None = None):
         self.backend = backend
@@ -1055,6 +1060,18 @@ class LanePacker:
             "lanes_discarded": 0, "blocks": 0,
             "inflight_s": 0.0, "blocked_s": 0.0,
         }
+        # speculation-tree branch attribution (ISSUE 9): lanes added /
+        # discarded per branch tag — competing branches share buckets,
+        # this is the per-branch split of the shared device work
+        self.branch_lanes: dict[str, int] = {}
+        self.branch_discards: dict[str, int] = {}
+
+    def _tag_note(self, table: dict, tag: str | None, n: int) -> None:
+        if tag is None or n <= 0:
+            return
+        if tag not in table and len(table) >= self.MAX_TAGS:
+            table.pop(next(iter(table)))  # oldest tag out
+        table[tag] = table.get(tag, 0) + n
 
     def _target_lanes(self) -> int:
         if self.backend == "cpu":
@@ -1063,11 +1080,15 @@ class LanePacker:
             return 0  # device path distrusted: no point holding lanes back
         return self.lanes
 
-    def add(self, records: Sequence) -> SigBatchFuture:
+    def add(self, records: Sequence, tag: str | None = None
+            ) -> SigBatchFuture:
         """Enqueue one block's fresh (sigcache-missed) records; returns the
-        block's future. Dispatches fire whenever a full bucket is banked."""
+        block's future. Dispatches fire whenever a full bucket is banked.
+        ``tag`` attributes the lanes to a speculation-tree branch."""
         fut = SigBatchFuture(self)
         fut._queued = len(records)
+        fut._tag = tag
+        self._tag_note(self.branch_lanes, tag, len(records))
         if records:
             self._pending.extend(records)
             self._pending_futs.append((fut, len(records)))
@@ -1095,6 +1116,7 @@ class LanePacker:
                 del self._pending[off:off + count]
                 self._pending_futs.pop(i)
                 self.stats["lanes_discarded"] += count
+                self._tag_note(self.branch_discards, fut._tag, count)
                 fut._queued = 0
                 return
             off += count
@@ -1180,6 +1202,8 @@ class LanePacker:
             1.0 - st["blocked_s"] / st["inflight_s"], 4) \
             if st["inflight_s"] > 0 else 0.0
         st["pending_lanes"] = len(self._pending)
+        st["branch_lanes"] = dict(self.branch_lanes)
+        st["branch_discards"] = dict(self.branch_discards)
         return st
 
 

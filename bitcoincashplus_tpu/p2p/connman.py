@@ -386,6 +386,15 @@ class CConnman:
         (charging floods), and run block-download stall detection
         (re-request from another peer, then evict the staller)."""
         t_tick = time.monotonic()
+        # speculation-tree stale sweep: a tip held inside the -spechold
+        # grace (or a fork-race tie) must still externalize when no
+        # further block ever arrives — re-run the live settle policy
+        # each tick so getbestblockhash/listeners lag a quiet tip by at
+        # most hold + one supervision pass (ties by 10x the hold)
+        cs = getattr(self.node, "chainstate", None)
+        if cs is not None and getattr(cs, "_spec", None):
+            with self.node.cs_main:
+                cs.settle_live()
         # rate windows are normalized by the time since the previous tick
         # actually ran — a tick delayed by a long validation must not
         # read the drained backlog as a flood
@@ -1624,7 +1633,20 @@ class CConnman:
 
                 prewarm_block_sigs(self.node, block)
             try:
-                self.node.chainstate.process_new_block(block)
+                # P2P block flow rides the pipelined engine (ISSUE 9):
+                # competing tips speculatively connect as tree branches
+                # (batches sharing the cross-block LanePacker) and the
+                # live settle policy externalizes eagerly except inside
+                # the -spechold fork-race grace window; with depth<=1
+                # process_new_block_pipelined IS the serial engine
+                cs = self.node.chainstate
+                pipelined = getattr(cs, "process_new_block_pipelined",
+                                    None)
+                if pipelined is not None:
+                    pipelined(block)
+                    cs.settle_live()
+                else:  # harness stubs pass a bare chainstate namespace
+                    cs.process_new_block(block)
                 self._block_sources.pop(h, None)  # landed — tracking done
             except BlockValidationError as e:
                 if e.reason == "duplicate":

@@ -99,7 +99,9 @@ def gettpuinfo(node, params):
     fault-injection config (BCP_FAULT_*), sigcache hit/insert/eviction
     rates, ConnectBlock phase timings (-debug=bench counters), the
     pipelined-IBD settle horizon (``pipeline``: depth/occupancy, per-leg
-    times, unwind count, cross-block lane fill and overlap fraction), the
+    times, unwind count, cross-block lane fill and overlap fraction, and
+    the speculation tree's live shape under ``pipeline.tree`` — branches,
+    layers, drops, reorg depth, collapse level), the
     BIP30 pre-scan fast-path counters (``bip30``), the active
     backend/device, the always-on signature service (``serving``: flush
     reasons, queue depth, dedup/cache hits, import-priority preemptions,

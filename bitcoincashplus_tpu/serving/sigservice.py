@@ -201,12 +201,15 @@ class SigService:
                  backend: str = "auto", kernel: Optional[str] = None,
                  deadline_ms: float = DEFAULT_DEADLINE_MS,
                  lanes: int = DEFAULT_LANES,
-                 watchdog_quiet: Optional[float] = None):
+                 watchdog_quiet: Optional[float] = None,
+                 buffers: int = 2):
         if deadline_ms < 0:
             raise ValueError(
                 f"-sigservicedeadline={deadline_ms}: must be >= 0")
         if lanes < 1:
             raise ValueError(f"-sigservicelanes={lanes}: must be >= 1")
+        if buffers < 1:
+            raise ValueError(f"-sigservicebuffers={buffers}: must be >= 1")
         self.sigcache = sigcache
         self.backend = backend
         self.kernel = kernel
@@ -216,9 +219,15 @@ class SigService:
         # None = env/default, <= 0 = detection off for this subsystem
         self.watchdog_quiet = watchdog_quiet
         self.result_timeout = RESULT_TIMEOUT_S
+        # flush double-buffering (-sigservicebuffers, ISSUE 9 / ROADMAP
+        # PR 7 headroom): up to ``buffers`` dispatched-but-unsettled
+        # flushes ride concurrently, so the host packs flush N+1 while
+        # the device verifies flush N. 1 = the PR 7 single-slot loop.
+        self.buffers = buffers
         self._cond = threading.Condition()
         self._pending: list[_Lane] = []
         self._by_key: dict[bytes, _Lane] = {}  # pending + in-flight lanes
+        self._inflight: list[dict] = []  # dispatched, unsettled flushes
         self._kick = False
         self._stop = False
         self._priority = 0  # block-import preemption depth (re-entrant)
@@ -230,6 +239,7 @@ class SigService:
             "flush_stop": 0, "preempted_dispatches": 0,
             "deadline_misses": 0, "timeouts": 0, "flush_errors": 0,
             "prewarm_txs": 0, "prewarm_records": 0,
+            "overlapped_flushes": 0,
         }
 
     # -- lifecycle ------------------------------------------------------
@@ -352,10 +362,18 @@ class SigService:
     def _run(self) -> None:
         try:
             while True:
+                settle_now = None
                 with self._cond:
                     while True:
                         reason = self._flush_reason_locked()
-                        if reason is not None:
+                        if (reason is not None
+                                and len(self._inflight) < self.buffers):
+                            break  # a slot is free: go pack + dispatch
+                        if self._inflight:
+                            # nothing new to pack (or slots full): settle
+                            # the OLDEST in-flight flush — its device work
+                            # has had the whole pack window to run
+                            settle_now = self._inflight.pop(0)
                             break
                         if self._stop:
                             return  # drained: exit
@@ -365,24 +383,55 @@ class SigService:
                                    - self._pending[0].t_enqueue)
                             timeout = max(0.0, self.deadline_s - age)
                         self._cond.wait(timeout)
-                self._flush_once(reason)
+                if settle_now is not None:
+                    self._settle_flush(settle_now)
+                    continue
+                ent = self._dispatch_flush(reason)
+                if ent is not None:
+                    if self._inflight:
+                        # flush N is still on the device while N+1's host
+                        # pack just ran — the double-buffer overlap
+                        self.stats["overlapped_flushes"] += 1
+                    self._inflight.append(ent)
         except (KeyboardInterrupt, SystemExit):
             raise
         except BaseException as e:  # noqa: BLE001 — visible death, below
-            # _flush_once re-raises programming errors AFTER resolving
+            # _settle_flush re-raises programming errors AFTER resolving
             # the affected lanes; the thread dies loudly and later
             # submits/kicks run their flushes inline on the caller.
+            # Any OTHER in-flight flush's lanes resolve to the same error
+            # NOW — waiters must fail fast to their CPU re-verify, not
+            # sit out the full result timeout on a dead thread.
+            with self._cond:
+                for ent in self._inflight:
+                    for lane in ent["batch"]:
+                        if not lane.settled():
+                            lane.err = e
+                        self._by_key.pop(lane.key, None)
+                self._inflight.clear()
+                self._cond.notify_all()
             log_printf("sigservice thread died: %s: %s — submissions "
                        "degrade to inline synchronous dispatch",
                        type(e).__name__, str(e)[:200])
 
     def _flush_once(self, reason: str) -> None:
-        """Take one bucket off the pending buffer, dispatch, settle, and
-        fulfill the lanes. Runs on the service thread normally; on the
-        submitting thread when the service is stopped/dead."""
+        """Pack, dispatch, settle and fulfill ONE bucket synchronously —
+        the inline path for callers whose service thread is stopped or
+        dead (the thread itself runs the split _dispatch_flush /
+        _settle_flush pair through the double-buffer loop)."""
+        ent = self._dispatch_flush(reason)
+        if ent is not None:
+            self._settle_flush(ent)
+
+    def _dispatch_flush(self, reason: str) -> Optional[dict]:
+        """The HOST half of a flush: take one bucket off the pending
+        buffer, pack, and enqueue the supervised dispatch. The device
+        (on an async backend) verifies in the background; the verdict
+        wait and lane fulfillment happen in _settle_flush. Returns the
+        in-flight entry, or None when nothing was pending."""
         with self._cond:
             if not self._pending:
-                return
+                return None
             # always cap a flush at the bucket target: an overload burst
             # must not compile a one-off giant bucket — it drains as a
             # train of full buckets (the loop re-fires immediately)
@@ -415,18 +464,39 @@ class SigService:
                        lanes=len(batch))
         backend = "cpu" if preempted else self.backend
         records = [lane.record for lane in batch]
-        ok = err = None
+        handle = err = None
+        ctx = None
         with tm.span("serving.flush", parent=batch[0].ctx, reason=reason,
                      lanes=len(batch)):
+            # the settle span (possibly on a later loop iteration) chains
+            # off this flush span — the same flush->settle structure
+            # trace_view reads, just no longer forced to nest in time
+            ctx = tm.trace_context()
             try:
                 handle = ecdsa_batch.dispatch_batch(
                     records, backend=backend, kernel=self.kernel)
-                with tm.span("serving.settle", lanes=len(batch)):
-                    ok = handle.result()
             except (KeyboardInterrupt, SystemExit):
                 raise
-            except BaseException as e:  # noqa: BLE001 — waiters parked
+            except BaseException as e:  # noqa: BLE001 — resolved at settle
                 err = e
+        return {"batch": batch, "handle": handle, "err": err, "ctx": ctx}
+
+    def _settle_flush(self, ent: dict) -> None:
+        """The SETTLE half: block on the dispatch's verdict, fulfill the
+        lanes, and broadcast ONCE on the service condvar (the PR 7
+        single-notify rendezvous — per-lane Events were the submit-path
+        cost the service was built to avoid)."""
+        batch = ent["batch"]
+        ok, err = None, ent["err"]
+        if err is None:
+            with tm.span("serving.settle", parent=ent["ctx"],
+                         lanes=len(batch)):
+                try:
+                    ok = ent["handle"].result()
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except BaseException as e:  # noqa: BLE001 — waiters parked
+                    err = e
         now = time.monotonic()
         with self._cond:
             for i, lane in enumerate(batch):
@@ -464,8 +534,10 @@ class SigService:
             out = dict(self.stats)
             out["queue_depth"] = len(self._pending)
             out["inflight_keys"] = len(self._by_key)
+            out["inflight_flushes"] = len(self._inflight)
             out["priority_depth"] = self._priority
         out["enabled"] = True
+        out["buffers"] = self.buffers
         out["running"] = self.running()
         out["backend"] = self.backend
         out["deadline_ms"] = round(self.deadline_s * 1e3, 3)

@@ -197,6 +197,18 @@ CHAOS_ACTIONS = (
     "reconnect",        # scripted disconnect + fresh session
 )
 
+# The fleet-level action vocabulary for multi-node fork-storm campaigns
+# (tests/functional/test_fork_storm.py): the scheduler draws these to
+# drive a whole topology — split the fleet, mine competing branches on
+# both sides, heal and watch convergence. Seeded like everything else:
+# one -netseed replays the identical storm.
+FLEET_ACTIONS = (
+    "mine",        # extend the majority side's chain
+    "fork",        # mine a competing branch on a minority side
+    "partition",   # split the fleet into two seeded halves
+    "heal",        # reconnect the halves (the fork war resolves)
+)
+
 
 class ChaosSchedule:
     """Deterministic, seeded adversarial-action planner.
@@ -245,6 +257,20 @@ class ChaosSchedule:
 
     def rand(self) -> float:
         return self._rng.random()
+
+    def choice(self, items):
+        """Seeded pick from any sequence (fleet action targets)."""
+        return self._rng.choice(items)
+
+    def bipartition(self, n: int) -> tuple[list[int], list[int]]:
+        """Seeded split of node indices 0..n-1 into two non-empty halves
+        — the ``partition`` fleet action's topology draw. The cut point
+        and the membership are both schedule-driven, so one seed replays
+        the identical partition sequence."""
+        idxs = list(range(n))
+        self._rng.shuffle(idxs)
+        cut = self._rng.randint(1, max(1, n - 1))
+        return sorted(idxs[:cut]), sorted(idxs[cut:])
 
 
 def retry_call(fn, attempts: int = 3, backoff: Optional[Backoff] = None,

@@ -50,6 +50,27 @@ _COMMIT_H = tm.histogram(
 _UNWINDS_C = tm.counter(
     "bcp_pipeline_unwind_blocks_total",
     "Speculative blocks dropped by settle-failure unwinds")
+# -- speculation-tree observability (ISSUE 9): reorg accounting plus the
+# per-branch shape of the settle horizon once competing tips validate
+# concurrently. A "reorg" here is the externalized kind — settled blocks
+# disconnected from the active chain; in-tree branch switches never
+# disconnect anything and are counted as branch drops instead.
+_REORGS_C = tm.counter(
+    "bcp_reorgs_total",
+    "Active-chain reorganizations (settled blocks disconnected)")
+_REORG_DEPTH_H = tm.histogram(
+    "bcp_reorg_depth",
+    "Settled blocks disconnected per reorg",
+    buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 64))
+_BRANCHES_G = tm.gauge(
+    "bcp_spec_branches",
+    "Live branches (leaves) in the speculation tree")
+_LAYERS_G = tm.gauge(
+    "bcp_spec_layers",
+    "Speculative coin-cache layers live across all branches")
+_BRANCH_DROPS_C = tm.counter(
+    "bcp_spec_branch_drops_total",
+    "Losing speculative branches dropped (never externalized)")
 
 
 class BlockValidationError(TxValidationError):
@@ -129,7 +150,32 @@ class ChainstateManager:
         # runtime wires -pipelinedepth here; the Python IBD import loop is
         # the driver (node.py).
         self.pipeline_depth = 1
-        self._horizon: list[dict] = []
+        # The speculation TREE (ISSUE 9, generalizing the PR 3 linear
+        # horizon): block hash -> entry {idx, block, undo, layer, job,
+        # scripts, parent, children, branch, t_connect}. Entries whose
+        # ``parent`` is None are roots — children of the settled tip,
+        # their layers based directly on the settled cache; every other
+        # entry's layer stacks on its parent entry's layer. Competing
+        # tips are sibling subtrees; the most-work branch settles in
+        # order and losing sibling subtrees are dropped un-externalized.
+        self._spec: dict[bytes, dict] = {}
+        # -specbranches: cap on live leaves — a hostile peer fanning out
+        # forks at the tip buys at most this much concurrent validation;
+        # extra forks take the serial candidate path (cheap: they are
+        # not most-work, so activation leaves them as candidates).
+        self.max_branches = 4
+        # -spechold: live-path settle grace (seconds). While the oldest
+        # root is younger than this, settle_live() holds it speculative
+        # so a competing tip arriving inside the window joins the tree
+        # instead of forcing a serial reorg. 0 = settle eagerly (the
+        # serial engine's externalization latency, default).
+        self.spec_hold_s = 0.0
+        # degradation ladder state: consecutive-unwind pressure collapses
+        # the tree to single-branch (level 1) then serial (level 2) mode
+        # rather than thrashing; sustained clean settles re-open it.
+        self._unwind_streak = 0
+        self._settles_since_unwind = 0
+        self._activating = False  # recursion guard (activation <-> settle)
         self._packer = None  # ops/ecdsa_batch.LanePacker, built lazily
         # serving/sigservice.SigService (node wires it): block connects
         # run under its import_priority() so live mempool lanes dispatch
@@ -140,6 +186,10 @@ class ChainstateManager:
             "settled_blocks": 0, "unwinds": 0, "unwound_blocks": 0,
             "max_depth": 0, "scan_ms": 0.0, "settle_wait_ms": 0.0,
             "commit_ms": 0.0,
+            # speculation-tree accounting (ISSUE 9)
+            "branch_drops": 0, "dropped_blocks": 0,
+            "branches_live_max": 0, "reorgs": 0, "reorg_depth_max": 0,
+            "serial_linear_fallbacks": 0, "degraded_connects": 0,
         }
         # BIP30 pre-scan accounting: probes resolved from cache layers vs
         # the store, and whole scans skipped above the last checkpoint
@@ -163,7 +213,7 @@ class ChainstateManager:
         dw.WATCHDOG.register(
             "pipeline",
             pending_fn=lambda: (
-                len(m._horizon) if (m := self_ref()) is not None else 0))
+                len(m._spec) if (m := self_ref()) is not None else 0))
         self._init_genesis()
 
     # ------------------------------------------------------------------
@@ -327,9 +377,15 @@ class ChainstateManager:
         """TestBlockValidity (src/validation.cpp:~3500): full non-PoW
         validation of a tip candidate on a throwaway view — header context
         (nBits/time), block rules, and a scripts-on connect dry-run.
-        Raises BlockValidationError; mutates nothing."""
+        Raises BlockValidationError. The dry-run itself mutates nothing,
+        but with a live speculation tree open it first settles the
+        horizon (an externalization: tip listeners may fire) so the
+        throwaway view and tip() agree on one coin state."""
         from .coins import CoinsCache
 
+        # the dry-run connects against self.coins (settled) at tip() —
+        # with a live tree open those disagree; settle to realign
+        self.settle_horizon()
         tip = self.tip()
         self.check_block(block, check_pow=False)
         self.contextual_check_block_header(block.header, tip)
@@ -416,8 +472,8 @@ class ChainstateManager:
         if (
             idx.chain_tx > 0  # whole ancestor path has block data
             and idx.is_valid(BlockStatus.VALID_TRANSACTIONS)
-            and (tip is None or (idx.chain_work, -idx.sequence_id)
-                 > (tip.chain_work, -tip.sequence_id))
+            and (tip is None
+                 or self._work_key(idx) > self._work_key(tip))
         ):
             self._candidates.add(idx)
 
@@ -450,7 +506,8 @@ class ChainstateManager:
 
     def _connect_block_inner(self, block: CBlock, idx: CBlockIndex,
                              check_scripts: bool,
-                             sig_jobs: Optional[list] = None) -> BlockUndo:
+                             sig_jobs: Optional[list] = None,
+                             branch: Optional[str] = None) -> BlockUndo:
         height = idx.height
         consensus = self.params.consensus
 
@@ -535,7 +592,8 @@ class ChainstateManager:
             scan = getattr(self.script_verifier, "scan", None)
             if sig_jobs is not None and scan is not None:
                 sig_jobs.append(
-                    scan(block, idx, spent_per_tx, packer=self._sig_packer())
+                    scan(block, idx, spent_per_tx, packer=self._sig_packer(),
+                         tag=branch)
                 )
             else:
                 self.script_verifier(block, idx, spent_per_tx)
@@ -577,9 +635,8 @@ class ChainstateManager:
         for idx in self._candidates:
             if idx.status & BlockStatus.FAILED_MASK:
                 continue
-            if best is None or (idx.chain_work, -idx.sequence_id) > (
-                best.chain_work, -best.sequence_id
-            ):
+            if best is None or (self._work_key(idx)
+                                > self._work_key(best)):
                 best = idx
         return best
 
@@ -598,30 +655,37 @@ class ChainstateManager:
         # coin set missing the speculative edits. No-op when empty or
         # when called back from within a settle.
         self.settle_horizon()
-        while True:
-            tip = self.chain.tip()
-            target = self._find_most_work_chain()
-            if target is None or (tip is not None and (
-                target.chain_work, -target.sequence_id
-            ) <= (tip.chain_work, -tip.sequence_id)):
+        activating_save, self._activating = self._activating, True
+        try:
+            while True:
+                tip = self.chain.tip()
+                target = self._find_most_work_chain()
+                if target is None or (tip is not None and (
+                    self._work_key(target) <= self._work_key(tip)
+                )):
+                    self._prune_candidates()
+                    return
+                if not self._activate_step(target):
+                    # target (or an ancestor) failed validation; loop to
+                    # retry with the next-best candidate
+                    continue
                 self._prune_candidates()
-                return
-            if not self._activate_step(target):
-                # target (or an ancestor) failed validation; loop to retry
-                # with the next-best candidate
-                continue
-            self._prune_candidates()
-            for cb in self.on_tip_changed:
-                cb(self.chain.tip())
-            # loop again in case an even better candidate appeared meanwhile
+                for cb in self.on_tip_changed:
+                    cb(self.chain.tip())
+                # loop again: a better candidate may have appeared
+        finally:
+            self._activating = activating_save
 
     def _activate_step(self, target: CBlockIndex) -> bool:
         """One ActivateBestChainStep: reorg from current tip to target."""
         fork = self.chain.find_fork(target)
         # disconnect to the fork point
+        n_disc = 0
         while self.chain.tip() is not None and self.chain.tip() is not fork:
             if not self._disconnect_tip():
                 return False
+            n_disc += 1
+        self._note_reorg(n_disc, target)
         # connect the path fork -> target
         path = []
         idx = target
@@ -632,6 +696,23 @@ class ChainstateManager:
             if not self._connect_tip(idx):
                 return False
         return True
+
+    def _note_reorg(self, depth: int, target: CBlockIndex) -> None:
+        """Reorg observability: ``depth`` settled blocks were disconnected
+        on the way to ``target`` (0 = plain extension, not a reorg)."""
+        if depth <= 0:
+            return
+        ps = self.pipeline_stats
+        ps["reorgs"] += 1
+        ps["reorg_depth_max"] = max(ps["reorg_depth_max"], depth)
+        _REORGS_C.inc()
+        _REORG_DEPTH_H.observe(depth)
+        tm.instant("block.reorg", depth=depth,
+                   to_height=target.height,
+                   to_hash=hash_to_hex(target.hash)[:16])
+        log_print("bench", "reorg: %d block(s) disconnected toward %s "
+                  "height=%d", depth, hash_to_hex(target.hash)[:16],
+                  target.height)
 
     def script_checks_needed(self, idx: CBlockIndex) -> bool:
         """The fScriptChecks assumevalid gate (src/validation.cpp ConnectBlock):
@@ -737,7 +818,7 @@ class ChainstateManager:
             return
         self._candidates = {
             c for c in self._candidates
-            if (c.chain_work, -c.sequence_id) > (tip.chain_work, -tip.sequence_id)
+            if self._work_key(c) > self._work_key(tip)
             and not (c.status & BlockStatus.FAILED_MASK)
         }
 
@@ -775,9 +856,119 @@ class ChainstateManager:
         tip the outside world may observe (RPC getbestblockhash, P2P
         announcements, index flush). Equals chain.tip() whenever no
         speculative horizon is open."""
-        if self._horizon:
-            return self._horizon[0]["idx"].prev
+        for ent in self._spec.values():
+            if ent["parent"] is None:
+                return ent["idx"].prev
         return self.chain.tip()
+
+    # -- speculation-tree shape queries ---------------------------------
+
+    @property
+    def _horizon(self) -> list[dict]:
+        """The WINNING path of the speculation tree, root -> best leaf —
+        the linear view PR 3 callers (tests, the watchdog probe, the
+        flush barrier) reason about. Read-only by construction."""
+        ent = self._best_spec_leaf()
+        if ent is None:
+            return []
+        path = [ent]
+        while path[-1]["parent"] is not None:
+            path.append(self._spec[path[-1]["parent"]])
+        path.reverse()
+        return path
+
+    @staticmethod
+    def _work_key(idx: CBlockIndex) -> tuple:
+        """CBlockIndexWorkComparator's key (work, then earlier-seen)."""
+        return (idx.chain_work, -idx.sequence_id)
+
+    def _spec_roots(self) -> list[dict]:
+        return [e for e in self._spec.values() if e["parent"] is None]
+
+    def _spec_leaves(self) -> list[dict]:
+        return [e for e in self._spec.values() if not e["children"]]
+
+    def _best_spec_leaf(self) -> Optional[dict]:
+        """Entry holding the tree-wide most-work tip. chain_work is
+        strictly increasing along a branch, so the global max is a leaf."""
+        best = None
+        for ent in self._spec.values():
+            if best is None or (self._work_key(ent["idx"])
+                                > self._work_key(best["idx"])):
+                best = ent
+        return best
+
+    def _subtree(self, ent: dict) -> list[dict]:
+        """``ent`` plus every descendant entry, parents-first."""
+        out, queue = [], [ent]
+        while queue:
+            cur = queue.pop(0)
+            out.append(cur)
+            queue.extend(self._spec[h] for h in cur["children"]
+                         if h in self._spec)
+        return out
+
+    def _subtree_best_key(self, ent: dict) -> tuple:
+        return max(self._work_key(e["idx"]) for e in self._subtree(ent))
+
+    def _winning_root(self) -> Optional[dict]:
+        """The root whose subtree holds the most-work leaf — the next
+        entry to settle."""
+        best, best_key = None, None
+        for root in self._spec_roots():
+            key = self._subtree_best_key(root)
+            if best is None or key > best_key:
+                best, best_key = root, key
+        return best
+
+    def _settled_anchor(self) -> Optional[CBlockIndex]:
+        """The settled tip computed WITHOUT consulting chain.tip() — safe
+        mid-mutation (an unwind leaves chain.tip() pointing into the
+        just-dropped branch until _retip runs): the tree's root anchor
+        when branches are open, else the settled cache's best-block
+        marker (the last flushed layer stamps it)."""
+        for ent in self._spec.values():
+            if ent["parent"] is None:
+                return ent["idx"].prev
+        idx = self.block_index.get(self.coins.best_block())
+        return idx if idx is not None else self.chain.tip()
+
+    def _retip(self) -> None:
+        """Point the in-memory chain at the tree-wide best leaf (or the
+        settled tip when nothing speculative beats it / the tree is
+        empty) and refresh the tree gauges."""
+        leaf = self._best_spec_leaf()
+        settled = self._settled_anchor()
+        if leaf is not None and (
+                settled is None
+                or self._work_key(leaf["idx"]) > self._work_key(settled)):
+            self.chain.set_tip(leaf["idx"])
+        elif settled is not None:
+            self.chain.set_tip(settled)
+        n_leaves = len(self._spec_leaves())
+        _BRANCHES_G.set(n_leaves)
+        _LAYERS_G.set(len(self._spec))
+        ps = self.pipeline_stats
+        ps["branches_live_max"] = max(ps["branches_live_max"], n_leaves)
+
+    def _collapse_level(self) -> int:
+        """The degradation ladder (0 = full tree, 1 = single branch,
+        2 = serial). Driven by consecutive-unwind pressure — a branch
+        that keeps failing at settle must not thrash layer churn — and
+        by the ecdsa breaker: with the device path distrusted every lane
+        goes to the CPU engine anyway, so concurrent branch validation
+        only multiplies host work."""
+        if self._unwind_streak >= 4:
+            return 2
+        level = 1 if self._unwind_streak >= 2 else 0
+        try:
+            from ..ops import dispatch
+
+            if not dispatch.breaker("ecdsa").healthy():
+                level = max(level, 1)
+        except Exception:  # noqa: BLE001 — observability must not gate
+            pass
+        return level
 
     def _sig_packer(self):
         """The session's cross-block lane packer (ops/ecdsa_batch): fresh
@@ -792,14 +983,19 @@ class ChainstateManager:
         return self._packer
 
     def process_new_block_pipelined(self, block: CBlock) -> bool:
-        """ProcessNewBlock for the IBD pipeline driver (node.py import
-        loop). A linear tip extension is speculatively connected — UTXO
-        edits into a fresh CoinsCache layer, undo retained, signature
-        batch left in flight — while up to pipeline_depth older blocks'
-        batches settle behind it (backpressure settles the oldest first).
-        Anything else (reorg candidate, invalid ancestry, depth<=1)
-        settles the whole horizon and takes the serial path. Same
-        raise/return contract as process_new_block."""
+        """ProcessNewBlock for the pipelined drivers (node.py import
+        loop, P2P block flow). Any extension of the settled tip or of an
+        in-tree entry is speculatively connected — UTXO edits into a
+        fresh CoinsCache layer stacked per branch, undo retained,
+        signature batch left in flight — competing tips validating
+        concurrently as sibling subtrees (ISSUE 9). Backpressure settles
+        the winning branch oldest-first once the winning path reaches
+        pipeline_depth; losing branches drop at settle. Reorg candidates
+        route through _activate_best_chain_pipelined (serial undo-based
+        disconnects, tree-speculative reconnects); the degradation
+        ladder (_collapse_level) narrows the tree to single-branch then
+        serial mode under unwind pressure or an unhealthy ecdsa breaker.
+        Same raise/return contract as process_new_block."""
         if self.pipeline_depth <= 1:
             return self.process_new_block(block)
         with self._import_priority():
@@ -807,33 +1003,101 @@ class ChainstateManager:
 
     def _process_new_block_pipelined_inner(self, block: CBlock) -> bool:
         idx = self.accept_block(block)
-        # backpressure: bound the horizon BEFORE connecting another block
+        if idx.hash in self._spec:
+            return True  # already speculative (duplicate delivery)
+        level = self._collapse_level()
+        if level >= 2:
+            # serial collapse: the tree has proven itself unhealthy —
+            # drain it and run the reference engine until settles recover.
+            # Successful serial activations count toward recovery too:
+            # with no pipelined settles happening, nothing else could
+            # ever re-open the tree.
+            self.pipeline_stats["degraded_connects"] += 1
+            tip_before = self.chain.tip()
+            self.settle_horizon()
+            self.activate_best_chain()
+            if (self.chain.tip() is not tip_before
+                    and not (idx.status & BlockStatus.FAILED_MASK)):
+                self._settles_since_unwind += 1
+                if self._settles_since_unwind >= 8:
+                    self._unwind_streak = 0
+            return True
+        # backpressure: bound the WINNING path before connecting another
+        # block (competing branches ride along, capped by max_branches)
         while len(self._horizon) >= self.pipeline_depth:
             if not self._settle_oldest():
                 break  # unwound — idx's ancestry may now be invalid
-        if (idx.prev is self.chain.tip()
-                and not (idx.status & BlockStatus.FAILED_MASK)
-                and self._find_most_work_chain() is idx):
-            if self._connect_tip_speculative(idx, block):
-                return True
-            # scan-stage reject: fall through to the serial engine's
-            # next-best-candidate retry, exactly like a failed ConnectTip
-        self.settle_horizon()
-        self.activate_best_chain()
+        if not (idx.status & BlockStatus.FAILED_MASK):
+            if self._speculatable(idx, level):
+                if self._connect_tip_speculative(idx, block):
+                    return True
+                # scan-stage reject: fall through to the serial engine's
+                # next-best-candidate retry, like a failed ConnectTip
+            elif (idx.prev is self.chain.tip()
+                    and self._find_most_work_chain() is idx):
+                # invariant TRIPWIRE, not a live code path: by
+                # construction _speculatable() accepts every linear
+                # most-work extension at every collapse level, so this
+                # counter stays 0 — the fork-storm acceptance run
+                # asserts that, catching any future _speculatable
+                # regression that would quietly re-serialize the fast
+                # path
+                self.pipeline_stats["serial_linear_fallbacks"] += 1
+        # NOT an unconditional settle: a declined non-most-work fork must
+        # leave the open tree alone (activation drains the horizon itself
+        # exactly when a below-settled-tip reorg needs it)
+        self._activate_best_chain_pipelined()
+        return True
+
+    def _speculatable(self, idx: CBlockIndex, level: int) -> bool:
+        """May ``idx`` enter the speculation tree right now? Its parent
+        must be the settled tip (a new root) or an in-tree entry; at
+        collapse level >= 1 only a linear extension of the current best
+        leaf qualifies; and a connect that would mint a new leaf beyond
+        max_branches is declined (the serial candidate path is cheap for
+        non-most-work forks)."""
+        parent_ent = self._spec.get(idx.prev.hash) if idx.prev else None
+        is_root = idx.prev is self.settled_tip()
+        if not is_root and parent_ent is None:
+            return False
+        if level >= 1:
+            # single-branch mode: only extend the winning leaf
+            best = self._best_spec_leaf()
+            if best is None:
+                return is_root and not self._spec
+            return parent_ent is best
+        adds_leaf = is_root or bool(parent_ent["children"])
+        if adds_leaf and len(self._spec_leaves()) + (1 if self._spec else 0) \
+                > self.max_branches:
+            return False
         return True
 
     def _connect_tip_speculative(self, idx: CBlockIndex,
                                  block: CBlock) -> bool:
         """ConnectTip minus externalization: edits land in a NEW CoinsCache
-        layer stacked on the previous speculative layer (or the settled
-        cache), the script verifier runs its SCAN stage only, and the
+        layer stacked on the parent entry's layer (or the settled cache
+        for a root), the script verifier runs its SCAN stage only, and the
         block's undo write, index row, validity raise, and listeners are
         all withheld until settle. On a scan-stage failure the layer is
         dropped and the block marked invalid — the serial _connect_tip
-        verdict, just earlier."""
+        verdict, just earlier. Competing tips land as sibling subtrees;
+        their deferred records share the cross-block LanePacker, tagged
+        with their branch for attribution."""
         t0 = _time.perf_counter()
         check_scripts = self.script_checks_needed(idx)
-        base = self._horizon[-1]["layer"] if self._horizon else self.coins
+        parent_ent = self._spec.get(idx.prev.hash) if idx.prev else None
+        if parent_ent is None and idx.prev is not self.settled_tip():
+            # parent neither the settled tip nor in-tree: basing the
+            # layer on self.coins would connect against the WRONG coin
+            # state (a backpressure settle inside the activation path
+            # loop can advance the settled tip past the fork point mid-
+            # connect). Decline — the block is NOT invalid — and let the
+            # caller's activation loop recompute fork/target against the
+            # moved anchor.
+            return False
+        base = parent_ent["layer"] if parent_ent is not None else self.coins
+        branch = (parent_ent["branch"] if parent_ent is not None
+                  else hash_to_hex(idx.hash)[:12])
         layer = CoinsCache(base)
         jobs: list = []
         coins_save, self.coins = self.coins, layer
@@ -845,7 +1109,8 @@ class ChainstateManager:
                      hash=hash_to_hex(idx.hash)[:16]):
             try:
                 undo = self._connect_block_inner(block, idx, check_scripts,
-                                                 sig_jobs=jobs)
+                                                 sig_jobs=jobs,
+                                                 branch=branch)
             except BlockValidationError:
                 for j in jobs:
                     j.drain()
@@ -853,30 +1118,47 @@ class ChainstateManager:
                 return False
             finally:
                 self.coins = coins_save
-        self.chain.set_tip(idx)
+        self._spec[idx.hash] = {
+            "idx": idx, "block": block, "undo": undo, "layer": layer,
+            "job": jobs[0] if jobs else None,
+            "scripts": bool(check_scripts and self.script_verifier),
+            "parent": parent_ent["idx"].hash if parent_ent else None,
+            "children": [], "branch": branch,
+            "t_connect": _time.monotonic(),
+        }
+        if parent_ent is not None:
+            parent_ent["children"].append(idx.hash)
+        self._retip()
         # prune like the serial engine does after every activation step —
         # without this, every imported block stays a candidate and the
         # per-block _find_most_work_chain scan turns a long linear IBD
         # quadratic (the candidate set must stay ~empty in steady state)
         self._prune_candidates()
-        self._horizon.append({
-            "idx": idx, "block": block, "undo": undo, "layer": layer,
-            "job": jobs[0] if jobs else None,
-            "scripts": bool(check_scripts and self.script_verifier),
-        })
         ps = self.pipeline_stats
         ps["max_depth"] = max(ps["max_depth"], len(self._horizon))
         ps["scan_ms"] += (_time.perf_counter() - t0) * 1e3
         _SCAN_H.observe(_time.perf_counter() - t0)
+        # one speculative connect = forward progress: a branch stalled at
+        # settle then shows pending-with-no-beat and the devicewatch
+        # watchdog fires bcp_watchdog_stalled instead of IBD hanging mute
+        dw.WATCHDOG.beat("pipeline")
         return True
 
     def _settle_oldest(self) -> bool:
-        """Settle the horizon's oldest block: wait for its signature batch,
-        then externalize (coins merged into the settled cache, undo + index
-        row written, VALID_SCRIPTS raised, connect/tip listeners fired).
-        Returns False when the batch failed — the whole horizon is unwound
-        and the failing block marked invalid."""
-        ent = self._horizon[0]
+        """Settle the winning branch's root block: wait for its signature
+        batch, then externalize (coins merged into the settled cache,
+        undo + index row written, VALID_SCRIPTS raised, connect/tip
+        listeners fired) and drop every losing sibling subtree — their
+        layers were stacked on the same settled cache the winner just
+        flushed into, so once the winner externalizes they can never
+        settle (reactivating one later is a real reorg, via undo data).
+        Returns False when the batch failed — exactly the failing branch
+        is unwound (byte-identical pre-fork coin set by construction)
+        and the failing block marked invalid; sibling branches survive
+        and the next call settles the new most-work branch."""
+        ent = self._winning_root()
+        if ent is None:
+            return True
         idx = ent["idx"]
         settling_save, self._settling = self._settling, True
         try:
@@ -887,17 +1169,27 @@ class ChainstateManager:
                                  hash=hash_to_hex(idx.hash)[:16]):
                         ent["job"].settle()
                 except BlockValidationError as e:
-                    self._unwind_horizon(e)
+                    self._unwind_branch(ent, e)
                     return False
             t1 = _time.perf_counter()
             _SETTLE_H.observe(t1 - t0)
             with tm.span("block.commit", height=idx.height):
-                self._horizon.pop(0)
+                # losing siblings first: their layers read through the
+                # settled cache the winner is about to mutate
+                for root in self._spec_roots():
+                    if root is not ent:
+                        self._drop_subtree(root, "lost-work")
+                self._spec.pop(idx.hash)
                 ent["layer"].flush()  # into the settled cache (self.coins)
-                if self._horizon:
-                    # re-base the next layer onto the settled cache — its
-                    # old base is the (now empty) layer we just flushed
-                    self._horizon[0]["layer"].base = self.coins
+                for child_h in ent["children"]:
+                    child = self._spec.get(child_h)
+                    if child is None:
+                        continue
+                    # re-base onto the settled cache — the old base is
+                    # the (now empty) layer just flushed — and promote
+                    # to root: the settled tip advanced onto ``idx``
+                    child["layer"].base = self.coins
+                    child["parent"] = None
                 self.block_store.put_undo(idx.hash, ent["undo"].serialize())
                 idx.status |= BlockStatus.HAVE_UNDO
                 idx.raise_validity(
@@ -908,7 +1200,12 @@ class ChainstateManager:
                 ps = self.pipeline_stats
                 ps["settled_blocks"] += 1
                 ps["settle_wait_ms"] += (t1 - t0) * 1e3
+                self._settles_since_unwind += 1
+                if self._settles_since_unwind >= 8:
+                    # sustained clean settles re-open the tree
+                    self._unwind_streak = 0
                 self.bench["blocks"] += 1
+                self._retip()
                 for cb in self.on_block_connected:
                     cb(ent["block"], idx)
                 for cb in self.on_tip_changed:
@@ -920,20 +1217,58 @@ class ChainstateManager:
         finally:
             self._settling = settling_save
 
-    def _unwind_horizon(self, err: BlockValidationError) -> None:
-        """A settle failure at the horizon's oldest block: drop EVERY
-        speculative layer (the later blocks are its descendants), drain
-        their in-flight batches, mark the failing block invalid, and roll
-        the in-memory tip back to the last settled block. The settled
-        cache was never touched by the dropped layers, so the UTXO set is
-        byte-identical to the pre-failing-block state by construction."""
-        entries, self._horizon = self._horizon, []
-        failed = entries[0]["idx"]
+    def _drop_subtree(self, root: dict, reason: str) -> None:
+        """Drop one losing branch: drain its in-flight batches, discard
+        its layers, and forget the entries. Nothing was externalized —
+        the blocks stay HAVE_DATA candidates in the block index, so a
+        later deep reorg can still activate them through the serial
+        machinery (undo-based disconnects)."""
+        entries = self._subtree(root)
         for ent in entries:
             if ent["job"] is not None:
                 ent["job"].drain()
-        self.chain.set_tip(failed.prev)
+            self._spec.pop(ent["idx"].hash, None)
+        for ent in entries:
+            self._try_add_candidate(ent["idx"])
+        ps = self.pipeline_stats
+        ps["branch_drops"] += 1
+        ps["dropped_blocks"] += len(entries)
+        _BRANCH_DROPS_C.inc()
+        lifetime_ms = (_time.monotonic() - root["t_connect"]) * 1e3
+        tm.instant("block.branch_drop",
+                   branch=root["branch"],
+                   height=root["idx"].height,
+                   hash=hash_to_hex(root["idx"].hash)[:16],
+                   blocks=len(entries), reason=reason,
+                   lifetime_ms=round(lifetime_ms, 3))
+        log_print(
+            "bench",
+            "speculative branch dropped (%s): %d block(s) from %s "
+            "height=%d, lived %.0f ms",
+            reason, len(entries), hash_to_hex(root["idx"].hash)[:16],
+            root["idx"].height, lifetime_ms,
+        )
+
+    def _unwind_branch(self, root: dict,
+                       err: BlockValidationError) -> None:
+        """A settle failure at a branch root: drop exactly that branch's
+        subtree, drain its in-flight batches, mark the failing block
+        invalid, and roll the in-memory tip back to the best surviving
+        leaf (or the settled tip). The settled cache was never touched
+        by the dropped layers, so the UTXO set is byte-identical to the
+        pre-fork state by construction; sibling branches keep their
+        layers and stay settleable."""
+        failed = root["idx"]
+        entries = self._subtree(root)
+        for ent in entries:
+            if ent["job"] is not None:
+                ent["job"].drain()
+            self._spec.pop(ent["idx"].hash, None)
         self._mark_invalid(failed)
+        # roll the tip back FIRST: the candidate re-seed below compares
+        # against chain.tip(), and a dormant fork the dead branch was
+        # shadowing must pass that comparison (PR 3 ordering, kept)
+        self._retip()
         # the tip ROLLED BACK: candidates pruned while it was ahead may be
         # viable again — re-seed from scratch, the invalidate_block recipe
         for other in self.block_index.values():
@@ -941,35 +1276,170 @@ class ChainstateManager:
         ps = self.pipeline_stats
         ps["unwinds"] += 1
         ps["unwound_blocks"] += len(entries)
+        self._unwind_streak += 1
+        self._settles_since_unwind = 0
         _UNWINDS_C.inc(len(entries))
-        # an unwind drains the horizon — progress, not a stall
+        # an unwind drains the branch — progress, not a stall
         dw.WATCHDOG.beat("pipeline")
         tm.instant("block.unwind", height=failed.height,
                    hash=hash_to_hex(failed.hash)[:16],
+                   branch=root["branch"],
                    dropped=len(entries), reason=err.reason)
         log_print(
             "bench",
-            "settle horizon unwound: %d speculative block(s) dropped, "
+            "speculative branch unwound: %d block(s) dropped, "
             "%s invalid (%s)",
             len(entries), hash_to_hex(failed.hash)[:16], err.reason,
         )
 
+    def _drain_spec(self) -> None:
+        """Settle/unwind everything speculative WITHOUT the post-unwind
+        activation retry — the internal barrier for activation steps
+        (which own their candidate loop) and the body of the public
+        settle_horizon."""
+        while self._spec:
+            self._settle_oldest()
+
     def settle_horizon(self) -> None:
-        """Settle every speculative block, oldest first — the barrier
-        before any serial-path activation, reorg, external flush, or
-        shutdown. Reentrancy-safe: a connect listener that triggers
+        """Settle every speculative block, winning branch first — the
+        barrier before any serial-path activation, reorg, external flush,
+        or shutdown. Reentrancy-safe: a connect listener that triggers
         flush() mid-settle does not recurse. Like the serial engine, a
-        failing block is marked invalid without raising."""
+        failing block is marked invalid without raising; surviving
+        branches keep settling (each failure drops at least one entry,
+        so the loop terminates).
+
+        An unwind can expose a DORMANT better candidate — a fork that
+        was declined for speculation while the now-dead branch was
+        ahead. Outside an activation (which owns its own candidate
+        retry loop) the drain re-runs activation until quiescent, so a
+        final-drain unwind converges exactly like the serial engine's
+        failure retry would."""
         if self._settling:
             return
-        while self._horizon:
-            if not self._settle_oldest():
-                break
+        while True:
+            unwinds_before = self.pipeline_stats["unwinds"]
+            self._drain_spec()
+            if (self._activating
+                    or self.pipeline_stats["unwinds"] == unwinds_before):
+                return
+            self._activate_best_chain_pipelined()
+
+    def settle_live(self) -> None:
+        """The live-traffic settle policy (P2P driver — per delivered
+        block and again each connman tick): settle eagerly, EXCEPT hold
+        (a) roots younger than ``spec_hold_s`` — the window in which a
+        competing tip can still join the tree instead of forcing a
+        serial reorg — and (b) equal-work branch ties, up to 10x the
+        window, so a fork race resolves by work (or first-seen once the
+        tie goes stale) rather than by arrival interleaving. With
+        spec_hold_s == 0 (the default) this is an unconditional drain —
+        serial-engine externalization latency. Like settle_horizon, an
+        unwind re-runs activation afterwards: a dormant better candidate
+        the dead branch was shadowing must not leave a quiet node
+        serving a lower-work tip until the next block happens by."""
+        if self._settling:
+            return
+        unwinds_before = self.pipeline_stats["unwinds"]
+        while self._spec:
+            if self.spec_hold_s > 0:
+                now = _time.monotonic()
+                win = self._winning_root()
+                age = now - win["t_connect"]
+                if age < self.spec_hold_s:
+                    break
+                roots = self._spec_roots()
+                if len(roots) > 1:
+                    keys = sorted((self._subtree_best_key(r) for r in roots),
+                                  reverse=True)
+                    tied = keys[0][0] == keys[1][0]  # equal WORK
+                    if tied and age < 10 * self.spec_hold_s:
+                        break
+            self._settle_oldest()
+        if (self.pipeline_stats["unwinds"] != unwinds_before
+                and not self._activating):
+            self._activate_best_chain_pipelined()
+
+    def _activate_best_chain_pipelined(self) -> None:
+        """ActivateBestChain with the connect leg running through the
+        speculation tree: reorg disconnects stay serial (undo application
+        against the settled cache — the horizon is drained first when the
+        fork sits below the settled tip), but every path block toward the
+        most-work candidate speculatively connects into tree layers, so
+        deep reorgs, competing-branch activations, pre-checkpoint eras
+        and -loadblock imports all ride the fast path. The horizon may be
+        left OPEN on return — the caller's driver (import loop, P2P
+        settle_live) owns the settle cadence."""
+        activating_save, self._activating = self._activating, True
+        try:
+            while True:
+                tip = self.chain.tip()
+                target = self._find_most_work_chain()
+                if target is None or (tip is not None and (
+                    self._work_key(target) <= self._work_key(tip)
+                )):
+                    self._prune_candidates()
+                    return
+                if not self._activate_step_pipelined(target):
+                    continue  # target (or ancestor) failed; retry next-best
+                # tip/connect listeners fire at SETTLE (the
+                # externalization point) — _settle_oldest owns them
+                self._prune_candidates()
+        finally:
+            self._activating = activating_save
+
+    def _activate_step_pipelined(self, target: CBlockIndex) -> bool:
+        """One activation step toward ``target`` through the tree. The
+        fork point decides the shape: at/above the settled tip nothing
+        externalized moves (the new branch just joins the tree and the
+        losers fall off at settle); below it, the horizon drains and
+        settled blocks disconnect serially (metered as a real reorg)
+        before the new path speculatively connects."""
+        fork = self.chain.find_fork(target)
+        settled = self.settled_tip()
+        in_tree = fork is not None and (
+            fork is settled or fork.hash in self._spec)
+        if not in_tree:
+            # direct drain, not settle_horizon: this step runs INSIDE the
+            # activation loop, which owns the candidate retry — and the
+            # serial disconnects below must never run with open layers
+            self._drain_spec()
+            # the drain may have unwound and MOVED the tip — the fork
+            # point must be recomputed against the post-drain chain or
+            # the disconnect walk below could sail past it
+            fork = self.chain.find_fork(target)
+            n_disc = 0
+            while self.chain.tip() is not None \
+                    and self.chain.tip() is not fork:
+                if not self._disconnect_tip():
+                    return False
+                n_disc += 1
+            self._note_reorg(n_disc, target)
+        path = []
+        idx = target
+        while idx is not fork:
+            path.append(idx)
+            idx = idx.prev
+        for idx in reversed(path):
+            if idx.hash in self._spec:
+                continue  # already speculative on this branch
+            raw = self.block_store.get_block(idx.hash)
+            if raw is None:
+                self._candidates.discard(idx)
+                return False
+            block = CBlock.from_bytes(raw)
+            while len(self._horizon) >= self.pipeline_depth:
+                if not self._settle_oldest():
+                    return False  # unwound — ancestry may now be invalid
+            if not self._connect_tip_speculative(idx, block):
+                return False
+        return True
 
     def pipeline_snapshot(self) -> dict:
         """gettpuinfo's ``pipeline`` section: horizon depth/occupancy,
-        per-leg cumulative times, unwind accounting, and the cross-block
-        lane packer's fill/overlap metrics."""
+        per-leg cumulative times, unwind accounting, the cross-block
+        lane packer's fill/overlap metrics, and the speculation tree's
+        live shape (``tree``)."""
         ps = dict(self.pipeline_stats)
         ps["depth"] = self.pipeline_depth
         ps["in_horizon"] = len(self._horizon)
@@ -977,6 +1447,25 @@ class ChainstateManager:
         ps["packer"] = packer
         ps["lane_fill_pct"] = packer.get("lane_fill_pct")
         ps["overlap_fraction"] = packer.get("overlap_fraction", 0.0)
+        best = self._best_spec_leaf()
+        ps["tree"] = {
+            "layers": len(self._spec),
+            "roots": len(self._spec_roots()),
+            "branches": len(self._spec_leaves()),
+            "branches_live_max": self.pipeline_stats["branches_live_max"],
+            "max_branches": self.max_branches,
+            "spec_hold_s": self.spec_hold_s,
+            "best_leaf": (hash_to_hex(best["idx"].hash)[:16]
+                          if best is not None else None),
+            "branch_drops": self.pipeline_stats["branch_drops"],
+            "dropped_blocks": self.pipeline_stats["dropped_blocks"],
+            "reorgs": self.pipeline_stats["reorgs"],
+            "reorg_depth_max": self.pipeline_stats["reorg_depth_max"],
+            "collapse_level": self._collapse_level(),
+            "unwind_streak": self._unwind_streak,
+            "serial_linear_fallbacks":
+                self.pipeline_stats["serial_linear_fallbacks"],
+        }
         return ps
 
     def precious_block(self, idx: CBlockIndex) -> None:
@@ -993,6 +1482,10 @@ class ChainstateManager:
 
     def invalidate_block(self, idx: CBlockIndex) -> None:
         """InvalidateBlock RPC backend: mark invalid and walk the tip back."""
+        # settle first: with a live speculation tree open (-spechold) the
+        # disconnect walk below needs on-disk undo data, which in-tree
+        # blocks don't have yet
+        self.settle_horizon()
         self._mark_invalid(idx)
         # disconnect while the invalid block is on the active chain
         while self.chain.tip() is not None and (
@@ -1043,7 +1536,9 @@ class ChainstateManager:
         externalization, and nothing past the horizon is externalized
         until its signature batch settles (they re-dirty at settle)."""
         if self.index_db is not None and self._dirty_index:
-            hold = {ent["idx"] for ent in self._horizon}
+            # hold EVERY tree entry, not just the winning path — no
+            # speculative block's row may externalize pre-settle
+            hold = {ent["idx"] for ent in self._spec.values()}
             flushable = [idx for idx in self._dirty_index
                          if idx not in hold]
             positions = getattr(self.block_store, "positions", {})

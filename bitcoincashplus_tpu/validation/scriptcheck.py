@@ -276,13 +276,17 @@ class BlockScriptVerifier:
         with tm.span("block.settle", height=idx.height):
             job.settle()
 
-    def scan(self, block, idx, spent_per_tx, packer=None) -> BlockSigJob:
+    def scan(self, block, idx, spent_per_tx, packer=None,
+             tag=None) -> BlockSigJob:
         """The SCAN stage: host script interpretation over every input,
         deferring OP_CHECKSIG into SigCheckRecords, probing the sigcache,
         and shipping fresh records — to ecdsa_batch.dispatch_batch chunks
         directly (serial path), or into the shared cross-block ``packer``
         (pipelined path), which banks them for full-bucket dispatches and
-        hands back per-block futures. Raises BlockValidationError on any
+        hands back per-block futures. ``tag`` names the speculation-tree
+        branch the block rides (packer lane attribution — competing
+        branches share device buckets and the per-branch lane split is
+        the observability for that). Raises BlockValidationError on any
         script failure; signature verdicts arrive at job.settle()."""
         from .chainstate import BlockValidationError
 
@@ -319,7 +323,7 @@ class BlockScriptVerifier:
             if fresh:
                 batch = [records[k] for k in fresh]
                 if packer is not None:
-                    handle = packer.add(batch)
+                    handle = packer.add(batch, tag=tag)
                 else:
                     try:
                         handle = ecdsa_batch.dispatch_batch(

@@ -62,7 +62,10 @@ def pytest_collection_modifyitems(config, items):
     def group(item) -> int:
         # the ``devprof`` suite (device-lane observability — the same
         # registry-zeroing isolation pattern as telemetry) runs after
-        # ``telemetry`` and before ``serving``
+        # ``telemetry`` and before ``serving``; the ``forkstorm``
+        # multi-node campaigns run DEAD LAST, after even the adversarial
+        # chaos suites — they are the newest, heaviest coverage and the
+        # first thing a CI timeout should cut
         if "functional" not in str(item.fspath):
             if item.get_closest_marker("serving"):
                 return 4
@@ -71,6 +74,8 @@ def pytest_collection_modifyitems(config, items):
             if item.get_closest_marker("telemetry"):
                 return 2
             return 1 if item.get_closest_marker("pipeline") else 0
+        if item.get_closest_marker("forkstorm"):
+            return 7
         return 6 if item.get_closest_marker("adversarial") else 5
 
     items.sort(key=group)

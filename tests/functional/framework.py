@@ -193,6 +193,12 @@ class ChaosPeer(threading.Thread):
                       delivery exercises the orphan pool; the mempool
                       accept path absorbs the load — the ISSUE 7 serving
                       workload)
+      - ``forkfeeder`` — replay a pre-mined COMPETING branch (supplied as
+                      raw serialized blocks forking ``depth`` below the
+                      victim's tip): announce the branch headers, then
+                      serve the node's getdata at ~``block_rate``
+                      blocks/s with seeded jitter — a reproducible
+                      fork-war feeder for the speculation tree (ISSUE 9)
 
     The thread records ``evicted`` (the node closed the connection) and
     ``rounds_done`` for assertions; ``stop()`` ends the campaign."""
@@ -200,12 +206,15 @@ class ChaosPeer(threading.Thread):
     def __init__(self, p2p_port: int, behavior: str, seed: int = 0,
                  headers: list[bytes] | None = None,
                  rounds: int | None = None, flood_payload: int = 262_144,
-                 txs: list[bytes] | None = None, tx_rate: float = 200.0):
+                 txs: list[bytes] | None = None, tx_rate: float = 200.0,
+                 blocks: list[bytes] | None = None,
+                 block_rate: float = 50.0):
         super().__init__(daemon=True, name=f"chaos-{behavior}-{seed}")
         from bitcoincashplus_tpu.consensus.params import regtest_params
         from bitcoincashplus_tpu.util.faults import ChaosSchedule
 
-        assert behavior in ("flood", "stall", "garbage", "txstorm"), behavior
+        assert behavior in ("flood", "stall", "garbage", "txstorm",
+                            "forkfeeder"), behavior
         self.magic = regtest_params().netmagic
         self.port = p2p_port
         self.behavior = behavior
@@ -215,6 +224,8 @@ class ChaosPeer(threading.Thread):
         self.flood_payload = flood_payload
         self.txs = list(txs or [])  # raw serialized transactions
         self.tx_rate = tx_rate
+        self.blocks = list(blocks or [])  # raw serialized fork blocks
+        self.block_rate = block_rate
         self.evicted = False
         self.rounds_done = 0
         self.error: BaseException | None = None
@@ -353,6 +364,47 @@ class ChaosPeer(threading.Thread):
             time.sleep(interval * (0.5 + self.schedule.rand()))
         self._drain(0.5)  # let the node chew; collect rejects/pings
 
+    def _run_forkfeeder(self) -> None:
+        """Announce the competing branch's headers, then serve the node's
+        getdata for those blocks at ~block_rate/s with seeded jitter.
+        Ends once every served block went out (or on stop/eviction);
+        blocks the node never requests are simply never pushed — the
+        feeder is a well-formed peer, not a flooder."""
+        from bitcoincashplus_tpu.crypto.hashes import sha256d
+        from bitcoincashplus_tpu.p2p.protocol import MSG_BLOCK, deser_inv
+
+        by_hash = {sha256d(raw[:80]): raw for raw in self.blocks}
+        self._send("headers", _ser_raw_headers(
+            [raw[:80] for raw in self.blocks]))
+        served = 0
+        interval = 1.0 / max(self.block_rate, 1e-6)
+        deadline = time.time() + 60.0
+        sock = self.sock
+        if sock is None:
+            raise ConnectionError("stopped")
+        sock.settimeout(0.25)
+        while (not self._halt.is_set() and served < len(by_hash)
+               and time.time() < deadline):
+            try:
+                header, payload = self._read_msg()
+            except socket.timeout:
+                continue
+            command = header[4:16].rstrip(b"\x00")
+            if command != b"getdata":
+                continue
+            for typ, h in deser_inv(payload):
+                if typ != MSG_BLOCK or h not in by_hash:
+                    continue
+                if self._halt.is_set():
+                    return
+                self._send("block", by_hash[h])
+                served += 1
+                self.rounds_done += 1
+                # seeded pacing: the fork arrives as a paced drip, not
+                # one burst — the replay shape is part of the seed
+                time.sleep(interval * (0.5 + self.schedule.rand()))
+        self._drain(0.5)  # let the node finish connecting the branch
+
     def _run_garbage(self) -> None:
         """Replay garbage on a schedule: valid-PoW headers on unknown
         parents (graduated charge), silent stretches, and scripted
@@ -431,6 +483,41 @@ def connect_nodes(a: TestNode, b: TestNode) -> None:
     a.rpc.addnode(f"127.0.0.1:{b.p2p_port}", "onetry")
     wait_until(lambda: a.rpc.getconnectioncount() >= 1
                and b.rpc.getconnectioncount() >= 1, timeout=30)
+
+
+def disconnect_nodes(a: TestNode, b: TestNode) -> None:
+    """Tear down every live link between ``a`` and ``b`` (both
+    directions — either side may own the TCP connection). onetry links
+    are not redialed, so the cut persists until connect_nodes heals it."""
+    for src, dst in ((a, b), (b, a)):
+        for peer in src.rpc.getpeerinfo():
+            addr = peer.get("addr", "")
+            if addr.endswith(f":{dst.p2p_port}"):
+                try:
+                    src.rpc.disconnectnode(addr)
+                except Exception:
+                    pass  # already gone
+
+
+def partition_fleet(nodes: list[TestNode],
+                    sides: tuple[list[int], list[int]]) -> None:
+    """Apply a seeded bipartition (util/faults.ChaosSchedule.bipartition):
+    cut every cross-side link; links inside each side stay up."""
+    side_a, side_b = sides
+    for i in side_a:
+        for j in side_b:
+            disconnect_nodes(nodes[i], nodes[j])
+
+
+def heal_fleet(nodes: list[TestNode], topology: list[tuple[int, int]]
+               ) -> None:
+    """Re-establish the fleet's base topology after a partition."""
+    for i, j in topology:
+        try:
+            connect_nodes(nodes[i], nodes[j])
+        except TimeoutError:
+            # one retry: the first dial can race the disconnect teardown
+            connect_nodes(nodes[i], nodes[j])
 
 
 def sync_blocks(nodes, timeout: float = 60.0) -> None:

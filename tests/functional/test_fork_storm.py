@@ -1,0 +1,236 @@
+"""Multi-node fork-storm chaos fleet (ISSUE 9 acceptance scenario).
+
+A seeded campaign over a 4-node ring (+ an unmolested control hanging
+off node0): two partition/heal cycles drive fork wars — both sides of
+each split mine competing branches, the heal forces deep reorgs — the
+chain crosses the EDA->DAA difficulty boundary (-cashdaa -daaheight)
+mid-campaign, and a staged fork race (two pre-mined competing tips fed
+through ``forkfeeder`` ChaosPeers inside the -spechold window) proves
+the speculation tree holds >1 live branch. Every node must converge to
+a chainstate byte-identical to the control, with ZERO serial-engine
+fallbacks on linear segments.
+
+The whole storm replays from its seeds: the partition topology draws
+come from util/faults.ChaosSchedule.bipartition and the feeders pace
+off their own schedules.
+
+Markers: ``functional`` + ``forkstorm`` — conftest orders forkstorm
+campaigns dead last (the newest, heaviest adversarial coverage is the
+first thing a CI timeout cuts, never the established suites).
+"""
+
+import os
+
+import pytest
+
+from bitcoincashplus_tpu.consensus.params import regtest_params
+from bitcoincashplus_tpu.util.faults import ChaosSchedule
+from bitcoincashplus_tpu.wallet.keys import CKey
+
+from .framework import (
+    ChaosPeer,
+    FunctionalFramework,
+    connect_nodes,
+    disconnect_nodes,
+    heal_fleet,
+    partition_fleet,
+    sync_blocks,
+    wait_until,
+)
+
+pytestmark = [pytest.mark.functional, pytest.mark.forkstorm]
+
+KEY = CKey(0x51095109)
+ADDR = KEY.p2pkh_address(regtest_params())
+
+# One rule set fleet-wide (a -cashdaa mismatch would be a consensus
+# fork, not a reorg drill): EDA era to height 23, cw-144 DAA from 24 —
+# the cycle-2 reorg crosses the boundary. -spechold=1500 opens the
+# fork-race grace window the staged branch race below lands inside;
+# -nettick=1 bounds how long a held tip can lag its settle.
+FLEET_ARGS = [
+    "-pipelinedepth=4", "-specbranches=4", "-spechold=1500",
+    "-cashdaa", "-daaheight=24", "-nettick=1", "-netseed=1109",
+]
+RING = [(0, 1), (1, 2), (2, 3), (3, 0)]
+
+
+def _chainstate_dict(datadir: str) -> dict[bytes, bytes]:
+    from bitcoincashplus_tpu.store.kvstore import KVStore
+
+    kv = KVStore(os.path.join(datadir, "chainstate.sqlite"))
+    out = dict(kv.iterate())
+    kv.close()
+    return out
+
+
+def _cut_everyone(nodes, island) -> None:
+    """Isolate ``island`` from every other node (including re-cuts — the
+    dial loop may have redialed from addrman between applications)."""
+    for other in nodes:
+        if other is not island:
+            disconnect_nodes(island, other)
+
+
+def _mine(node, n: int) -> list[str]:
+    return node.rpc.generatetoaddress(n, ADDR)
+
+
+def test_fork_storm_fleet_convergence():
+    sched = ChaosSchedule(1109)
+    with FunctionalFramework(
+        num_nodes=5, extra_args=[list(FLEET_ARGS) for _ in range(5)]
+    ) as f:
+        fleet = f.nodes[:4]
+        control = f.nodes[4]
+        heal_fleet(f.nodes, RING)
+        connect_nodes(control, f.nodes[0])
+
+        # base chain, deep inside the EDA era
+        _mine(fleet[0], 18)
+        sync_blocks(f.nodes, timeout=90)
+
+        # -- two seeded partition/heal cycles: fork wars, deep reorgs --
+        # cycle 1 stays below the DAA boundary (18 -> 23); cycle 2's
+        # winning branch crosses it (23 -> 29 over daaheight=24), so the
+        # losing side's reorg re-validates headers across the rule switch
+        for cycle in range(2):
+            side_a, side_b = sched.bipartition(4)
+            k = sched.randint(2, 3)
+            partition_fleet(fleet, (side_a, side_b))
+            # the control must follow ONE side only (node0's); cut it
+            # from any direct cross-side leakage it never has (ring) —
+            # nothing to do: it only links node0.
+            miner_a, miner_b = fleet[side_a[0]], fleet[side_b[0]]
+            for step in range(k):
+                _mine(miner_a, 1)
+                partition_fleet(fleet, (side_a, side_b))  # re-cut redials
+            for step in range(k + 2):
+                _mine(miner_b, 1)
+                partition_fleet(fleet, (side_a, side_b))
+            heal_fleet(fleet, RING)
+            sync_blocks(f.nodes, timeout=120)
+
+        tip_before_race = fleet[1].rpc.getbestblockhash()
+
+        # -- staged fork race: two competing children of the settled tip
+        # fed to node1 within the -spechold window — the speculation
+        # tree must hold BOTH branches live concurrently
+        _cut_everyone(f.nodes, fleet[2])
+        _cut_everyone(f.nodes, fleet[3])
+        (x_hash,) = _mine(fleet[2], 1)
+        (y_hash,) = _mine(fleet[3], 1)
+        x_raw = bytes.fromhex(fleet[2].rpc.getblock(x_hash, 0))
+        y_raw = bytes.fromhex(fleet[3].rpc.getblock(y_hash, 0))
+        feeder_x = ChaosPeer(fleet[1].p2p_port, "forkfeeder", seed=71,
+                             blocks=[x_raw], block_rate=500)
+        feeder_y = ChaosPeer(fleet[1].p2p_port, "forkfeeder", seed=72,
+                             blocks=[y_raw], block_rate=500)
+        feeder_x.start()
+        feeder_y.start()
+
+        def _branched():
+            tree = fleet[1].rpc.gettpuinfo()["pipeline"]["tree"]
+            return tree["branches_live_max"] >= 2
+        wait_until(_branched, timeout=20, sleep=0.05)
+        for p in (feeder_x, feeder_y):
+            p.stop()
+            p.join(10)
+            if p.error is not None:
+                raise p.error
+        # the race is a work TIE: nothing externalizes until the tie
+        # breaks — node1's own next template settles the first-seen
+        # winner (assembler settle barrier), drops the loser, and the
+        # two fresh blocks give the whole fleet a strictly-most-work
+        # chain to converge on (including the forksmiths when healed)
+        assert fleet[1].rpc.getbestblockhash() == tip_before_race
+        _mine(fleet[1], 2)
+        heal_fleet(fleet, RING)
+        sync_blocks(f.nodes, timeout=120)
+        tree1 = fleet[1].rpc.gettpuinfo()["pipeline"]["tree"]
+        assert tree1["branches_live_max"] >= 2
+        assert tree1["branch_drops"] >= 1
+
+        # -- fleet-wide acceptance assertions --
+        reorgs_total = 0
+        depth_max = 0
+        for node in fleet:
+            tree = node.rpc.gettpuinfo()["pipeline"]["tree"]
+            # the fast path never regressed to serial on a linear segment
+            assert tree["serial_linear_fallbacks"] == 0, node.index
+            assert tree["collapse_level"] == 0, node.index
+            reorgs_total += tree["reorgs"]
+            depth_max = max(depth_max, tree["reorg_depth_max"])
+        # each cycle's losing miner disconnected >= 2 of its own blocks
+        assert reorgs_total >= 2
+        assert depth_max >= 2
+        # the campaign crossed the DAA boundary
+        assert fleet[0].rpc.getblockcount() >= 27
+
+        # -- digest-identical convergence, every node vs the control --
+        tips = {n.rpc.getbestblockhash() for n in f.nodes}
+        assert len(tips) == 1
+        dirs = [n.datadir for n in f.nodes]
+        for n in f.nodes:
+            n.stop()
+        want = _chainstate_dict(dirs[-1])  # the unmolested control
+        for d in dirs[:-1]:
+            assert _chainstate_dict(d) == want, d
+
+
+@pytest.mark.slow
+def test_fork_storm_soak():
+    """Longer seeded storm (slow-marked): more cycles, bigger deltas,
+    a forkfeeder replaying a stale losing branch mid-campaign. Same
+    oracle — byte-identical convergence everywhere."""
+    sched = ChaosSchedule(2207)
+    with FunctionalFramework(
+        num_nodes=4, extra_args=[list(FLEET_ARGS) for _ in range(4)]
+    ) as f:
+        fleet = f.nodes[:3]
+        control = f.nodes[3]
+        topo = [(0, 1), (1, 2)]
+        heal_fleet(f.nodes, topo)
+        connect_nodes(control, f.nodes[0])
+        _mine(fleet[0], 20)
+        sync_blocks(f.nodes, timeout=90)
+        loser_branch: list[bytes] = []
+        for cycle in range(4):
+            side_a, side_b = sched.bipartition(3)
+            k = sched.randint(2, 4)
+            partition_fleet(fleet, (side_a, side_b))
+            miner_a, miner_b = fleet[side_a[0]], fleet[side_b[0]]
+            a_hashes = []
+            for _ in range(k):
+                a_hashes += _mine(miner_a, 1)
+                partition_fleet(fleet, (side_a, side_b))
+            for _ in range(k + 1):
+                _mine(miner_b, 1)
+                partition_fleet(fleet, (side_a, side_b))
+            if cycle == 0:
+                loser_branch = [
+                    bytes.fromhex(miner_a.rpc.getblock(h, 0))
+                    for h in a_hashes
+                ]
+            heal_fleet(fleet, topo)
+            sync_blocks(f.nodes, timeout=120)
+        # replay the cycle-0 losing branch at node1: a well-below-tip
+        # fork must neither reorg the node nor wedge the tree
+        feeder = ChaosPeer(fleet[1].p2p_port, "forkfeeder", seed=91,
+                           blocks=loser_branch, block_rate=200)
+        feeder.start()
+        feeder.join(30)
+        feeder.stop()
+        tip = fleet[1].rpc.getbestblockhash()
+        _mine(fleet[1], 1)
+        sync_blocks(f.nodes, timeout=90)
+        assert fleet[1].rpc.getbestblockhash() != tip  # still extending
+        for node in fleet:
+            tree = node.rpc.gettpuinfo()["pipeline"]["tree"]
+            assert tree["serial_linear_fallbacks"] == 0
+        dirs = [n.datadir for n in f.nodes]
+        for n in f.nodes:
+            n.stop()
+        want = _chainstate_dict(dirs[-1])
+        for d in dirs[:-1]:
+            assert _chainstate_dict(d) == want, d

@@ -366,9 +366,12 @@ def test_chainstate_registers_pipeline_watchdog():
     cs = ChainstateManager(regtest_params(), MemoryCoinsView(),
                            MemoryBlockStore(), script_verifier=None)
     assert "pipeline" in dw.WATCHDOG.snapshot()
-    # the probe tracks the speculative horizon
-    cs._horizon.append({"idx": None})
+    # the probe tracks the speculation tree's total entry count
+    # (ISSUE 9: _horizon is now the derived winning-path view; the
+    # pending work the watchdog cares about is every open layer)
+    cs._spec[b"\x11" * 32] = {"idx": None, "parent": None,
+                              "children": []}
     clk_entry = dw.WATCHDOG._entries["pipeline"]
     assert clk_entry["pending_fn"]() == 1
-    cs._horizon.clear()
+    cs._spec.clear()
     assert clk_entry["pending_fn"]() == 0

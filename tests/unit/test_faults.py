@@ -285,3 +285,20 @@ class TestChaosSchedule:
             assert 4 <= s.burst_size(4, 32) <= 32
             assert s.next_action() in faults.CHAOS_ACTIONS
         assert len(s.randhash()) == 32
+
+    def test_fleet_bipartition_seeded(self):
+        """The fork-storm fleet draws (ISSUE 9): a bipartition is two
+        non-empty sorted halves covering every node, replayable from the
+        seed; choice() draws from any sequence deterministically."""
+        a = faults.ChaosSchedule(seed=1109)
+        b = faults.ChaosSchedule(seed=1109)
+        for n in (2, 3, 4, 7):
+            pa, pb = a.bipartition(n), b.bipartition(n)
+            assert pa == pb
+            left, right = pa
+            assert left and right
+            assert sorted(left + right) == list(range(n))
+        assert [a.choice("xyz") for _ in range(8)] == \
+               [b.choice("xyz") for _ in range(8)]
+        assert set(faults.FLEET_ACTIONS) >= {"partition", "heal",
+                                             "mine", "fork"}

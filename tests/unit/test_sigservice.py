@@ -270,6 +270,101 @@ class TestDegradation:
             # with the thread dead, later submits flush inline
             assert svc.submit([_record(74)]).result().all()
 
+    def test_double_buffered_flushes_overlap(self, monkeypatch):
+        """With a slow device verify, the service packs and dispatches
+        flush N+1 while flush N is still in flight (-sigservicebuffers=2,
+        the ROADMAP PR 7 headroom item): overlapped_flushes meters it
+        and every verdict still lands correctly."""
+        real = ecdsa_batch.dispatch_batch
+        inflight = {"now": 0, "max": 0}
+        lock = threading.Lock()
+
+        class SlowHandle:
+            def __init__(self, handle):
+                self._handle = handle
+
+            def result(self):
+                time.sleep(0.05)  # the device window the host can hide in
+                with lock:
+                    inflight["now"] -= 1
+                return self._handle.result()
+
+        def slow(records, backend="auto", kernel=None):
+            with lock:
+                inflight["now"] += 1
+                inflight["max"] = max(inflight["max"], inflight["now"])
+            return SlowHandle(real(records, backend=backend, kernel=kernel))
+
+        monkeypatch.setattr(ecdsa_batch, "dispatch_batch", slow)
+        with _service(lanes=4, deadline_ms=60_000, buffers=2) as svc:
+            recs = [_record(300 + i, good=(i != 5)) for i in range(12)]
+            fut = svc.submit(recs)  # 3 full buckets back to back
+            ok = fut.result()
+            assert ok.tolist() == [i != 5 for i in range(12)]
+            assert svc.stats["dispatches"] == 3
+            assert svc.stats["overlapped_flushes"] >= 1
+            assert inflight["max"] >= 2  # two flushes genuinely co-flying
+            assert svc.snapshot()["buffers"] == 2
+
+    def test_single_buffer_identical_verdicts(self):
+        """-sigservicebuffers=1 is the PR 7 single-slot loop — the
+        differential: same records, same verdicts, no overlap."""
+        recs = [_record(340 + i, good=(i % 3 != 2)) for i in range(9)]
+        with _service(lanes=4, deadline_ms=1, buffers=1) as svc:
+            ok1 = svc.submit(recs).result()
+            assert svc.stats["overlapped_flushes"] == 0
+        with _service(lanes=4, deadline_ms=1, buffers=2) as svc:
+            ok2 = svc.submit(recs).result()
+        assert ok1.tolist() == ok2.tolist() == [i % 3 != 2
+                                                for i in range(9)]
+
+    def test_buffered_flush_error_isolated_to_its_bucket(self, monkeypatch):
+        """A failing flush in slot N must not poison slot N+1's verdicts
+        — only N's lanes degrade to the caller-side CPU re-verify."""
+        calls = {"n": 0}
+        real = ecdsa_batch.dispatch_batch
+
+        def boom_first(records, backend="auto", kernel=None):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ValueError("injected slot-0 failure")
+            return real(records, backend=backend, kernel=kernel)
+
+        monkeypatch.setattr(ecdsa_batch, "dispatch_batch", boom_first)
+        with _service(lanes=3, deadline_ms=60_000, buffers=2) as svc:
+            recs = [_record(360 + i, good=(i != 1)) for i in range(6)]
+            fut = svc.submit(recs)
+            ok = fut.result()
+            assert ok.tolist() == [i != 1 for i in range(6)]
+            assert svc.stats["flush_errors"] == 1
+            assert svc.running()
+
+    def test_stop_drains_inflight_slots(self, monkeypatch):
+        real = ecdsa_batch.dispatch_batch
+
+        class SlowHandle:
+            def __init__(self, handle):
+                self._handle = handle
+
+            def result(self):
+                time.sleep(0.03)
+                return self._handle.result()
+
+        monkeypatch.setattr(
+            ecdsa_batch, "dispatch_batch",
+            lambda records, backend="auto", kernel=None:
+            SlowHandle(real(records, backend=backend, kernel=kernel)))
+        svc = SigService(backend="cpu", lanes=2, deadline_ms=60_000,
+                         buffers=2).start()
+        fut = svc.submit([_record(380 + i) for i in range(6)])
+        svc.stop()  # must settle every dispatched slot before joining
+        assert fut.done()
+        assert fut.result().all()
+
+    def test_rejects_bad_buffers(self):
+        with pytest.raises(ValueError, match="sigservicebuffers"):
+            SigService(buffers=0)
+
     def test_concurrent_submissions_share_one_bucket(self):
         # six transactions enqueue BEFORE anyone awaits (the open-loop
         # storm shape): the first result() kick must flush every parked

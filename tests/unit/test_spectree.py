@@ -1,0 +1,627 @@
+"""Speculation tree + EDA/DAA difficulty rules (ISSUE 9).
+
+The load-bearing guarantees under test:
+  - competing tips validate CONCURRENTLY as sibling subtrees (branch
+    gauges > 1), the most-work branch settles in order, and losing
+    branches drop un-externalized with digests identical to the serial
+    engine's verdicts;
+  - a settle FAILURE unwinds exactly the failing branch — sibling
+    branches survive, settle, and the coin set is byte-identical to the
+    serial engine's on the same feed;
+  - reorg activation routes through the pipelined driver (serial
+    undo-based disconnects + tree-speculative reconnects), metered as
+    bcp_reorgs_total/bcp_reorg_depth, with zero serial fallbacks on
+    linear segments;
+  - the degradation ladder collapses tree -> single-branch -> serial
+    under unwind pressure / an unhealthy ecdsa breaker and re-opens
+    after sustained clean settles;
+  - the BCH-lineage EDA/cw-144 DAA rules route by daa_height, and deep
+    reorgs across the boundary converge digest-identically on both
+    engines in both feed orders.
+
+Marker: ``pipeline`` — ordered with the pipelined-IBD suite; tier-1,
+JAX_PLATFORMS=cpu, backend="cpu" end to end.
+"""
+
+import dataclasses
+import hashlib
+
+import pytest
+
+from bitcoincashplus_tpu.consensus.block import CBlockHeader
+from bitcoincashplus_tpu.consensus.params import regtest_params
+from bitcoincashplus_tpu.consensus.pow import (
+    compact_to_target,
+    eda_bits,
+    get_next_work_required,
+    get_next_work_required_cash,
+    target_to_compact,
+)
+from bitcoincashplus_tpu.consensus.tx import COutPoint
+from bitcoincashplus_tpu.ops import dispatch
+from bitcoincashplus_tpu.util import devicewatch as dw
+from bitcoincashplus_tpu.validation.chain import BlockStatus, CBlockIndex
+from bitcoincashplus_tpu.validation.chainstate import BlockValidationError
+
+from test_pipeline import (
+    _coin_digest,
+    _feed,
+    _make_cs,
+    _runway_blocks,
+    _signed_spend,
+    _tampered,
+    _with_runway,
+)
+from test_validation import _hand_mine
+
+pytestmark = pytest.mark.pipeline
+
+
+def _runway_spendable(k: int):
+    blocks, _t = _runway_blocks()
+    cb = blocks[k].vtx[0]
+    return COutPoint(cb.txid, 0), cb.vout[0].value
+
+
+def _mk(cs, prev_hash, height, t, txs=(), extra=b""):
+    tip_bits = regtest_params().genesis.header.bits
+    return _hand_mine(prev_hash, height, t, tip_bits, txs, extra=extra)
+
+
+class TestSpecTreeShape:
+    def test_competing_tips_validate_concurrently(self):
+        """Two children of the settled tip + one grandchild: the tree
+        holds two live branches, the most-work branch settles, the loser
+        drops un-externalized — and the serial engine lands on the
+        identical tip + coin set for the same feed."""
+        cs = _with_runway(depth=6)
+        tip = cs.tip()
+        t = cs.get_time()
+        a1 = _mk(cs, tip.hash, tip.height + 1, t + 10, extra=b"A")
+        b1 = _mk(cs, tip.hash, tip.height + 1, t + 10, extra=b"B")
+        a2 = _mk(cs, a1.get_hash(), tip.height + 2, t + 20)
+        for blk in (a1, b1, a2):
+            cs.process_new_block_pipelined(blk)
+        assert len(cs._spec) == 3
+        assert len(cs._spec_roots()) == 2
+        snap = cs.pipeline_snapshot()["tree"]
+        assert snap["branches"] == 2
+        assert snap["branches_live_max"] == 2
+        assert cs.chain.tip().hash == a2.get_hash()
+        assert cs.settled_tip() is tip  # nothing externalized yet
+
+        cs.settle_horizon()
+        assert not cs._spec
+        assert cs.tip().hash == a2.get_hash()
+        snap = cs.pipeline_snapshot()["tree"]
+        assert snap["branch_drops"] == 1
+        assert snap["dropped_blocks"] == 1
+        # the loser was NOT marked invalid — it lost on work, and stays
+        # a valid candidate for a future (real) reorg
+        b1_idx = cs.block_index[b1.get_hash()]
+        assert not (b1_idx.status & BlockStatus.FAILED_MASK)
+
+        cs2 = _with_runway(1)
+        _feed(cs2, (a1, b1, a2), pipelined=False)
+        assert cs2.tip().hash == cs.tip().hash
+        assert _coin_digest(cs2) == _coin_digest(cs)
+
+    def test_mid_branch_fork(self):
+        """A fork off a NON-root tree entry shares the prefix layers:
+        one root, two leaves; settling the shared prefix promotes both
+        children to competing roots and the work winner survives."""
+        cs = _with_runway(depth=6)
+        tip = cs.tip()
+        t = cs.get_time()
+        a1 = _mk(cs, tip.hash, tip.height + 1, t + 10)
+        a2 = _mk(cs, a1.get_hash(), tip.height + 2, t + 20, extra=b"A")
+        b2 = _mk(cs, a1.get_hash(), tip.height + 2, t + 20, extra=b"B")
+        a3 = _mk(cs, a2.get_hash(), tip.height + 3, t + 30)
+        for blk in (a1, a2, b2, a3):
+            cs.process_new_block_pipelined(blk)
+        assert len(cs._spec) == 4
+        assert len(cs._spec_roots()) == 1
+        assert cs.pipeline_snapshot()["tree"]["branches"] == 2
+        cs.settle_horizon()
+        assert cs.tip().hash == a3.get_hash()
+        assert cs.pipeline_snapshot()["tree"]["branch_drops"] == 1
+
+    def test_max_branches_declines_extra_forks(self):
+        cs = _with_runway(depth=6)
+        cs.max_branches = 2
+        tip = cs.tip()
+        t = cs.get_time()
+        blocks = [_mk(cs, tip.hash, tip.height + 1, t + 10, extra=bytes([i]))
+                  for i in range(3)]
+        for blk in blocks:
+            cs.process_new_block_pipelined(blk)
+        # the third competing tip was declined (serial candidate path),
+        # not speculatively connected
+        assert len(cs._spec_roots()) == 2
+        assert cs.pipeline_snapshot()["tree"]["branches"] == 2
+        cs.settle_horizon()
+        assert not cs._spec
+
+    def test_watchdog_beats_per_speculative_connect(self):
+        before = dw.WATCHDOG.beat_totals().get("pipeline", 0)
+        cs = _with_runway(depth=6)
+        tip = cs.tip()
+        blk = _mk(cs, tip.hash, tip.height + 1, cs.get_time() + 10)
+        cs.process_new_block_pipelined(blk)
+        assert dw.WATCHDOG.beat_totals().get("pipeline", 0) > before
+        cs.settle_horizon()
+
+
+class TestBranchUnwindIsolation:
+    def test_failing_branch_unwinds_siblings_survive(self):
+        """The WINNING branch's root fails at settle: exactly that
+        subtree unwinds, the sibling branch survives, settles, and the
+        coin set matches the serial engine byte for byte."""
+        cs = _with_runway(depth=6)
+        tip = cs.tip()
+        t = cs.get_time()
+        op, value = _runway_spendable(0)
+        bad = _tampered(_signed_spend(op, value), op)
+        b1 = _mk(cs, tip.hash, tip.height + 1, t + 10, txs=(bad,))
+        b2 = _mk(cs, b1.get_hash(), tip.height + 2, t + 20)
+        a1 = _mk(cs, tip.hash, tip.height + 1, t + 10, extra=b"A")
+        pre = _coin_digest(cs)
+        for blk in (b1, b2, a1):
+            cs.process_new_block_pipelined(blk)
+        assert len(cs._spec_roots()) == 2
+        # B has more work -> winning root -> its settle fails
+        cs.settle_horizon()
+        assert cs.tip().hash == a1.get_hash()
+        ps = cs.pipeline_stats
+        assert ps["unwinds"] == 1
+        assert ps["unwound_blocks"] == 2  # exactly the B subtree
+        assert cs.pipeline_stats["settled_blocks"] >= 1  # A still settled
+        assert cs.block_index[b1.get_hash()].status & BlockStatus.FAILED_VALID
+        assert cs.block_index[b2.get_hash()].status & BlockStatus.FAILED_CHILD
+        a_idx = cs.block_index[a1.get_hash()]
+        assert not (a_idx.status & BlockStatus.FAILED_MASK)
+
+        # serial differential: same feed, same verdicts, same bytes
+        cs2 = _with_runway(1)
+        _feed(cs2, (b1, b2, a1), pipelined=False)
+        assert cs2.tip().hash == a1.get_hash()
+        assert _coin_digest(cs2) == _coin_digest(cs)
+        # and unwinding B left the settled world pre-B + A only
+        cs3 = _with_runway(1)
+        _feed(cs3, (a1,), pipelined=False)
+        assert _coin_digest(cs3) == _coin_digest(cs)
+        assert _coin_digest(cs) != pre  # A externalized
+
+    def test_unwind_streak_and_recovery(self):
+        cs = _with_runway(depth=6)
+        assert cs._collapse_level() == 0
+        cs._unwind_streak = 2
+        assert cs._collapse_level() == 1
+        cs._unwind_streak = 4
+        assert cs._collapse_level() == 2
+        # 8 clean settles re-open the tree
+        cs._unwind_streak = 2
+        tip = cs.tip()
+        t = cs.get_time()
+        prev = tip.hash
+        for i in range(8):
+            blk = _mk(cs, prev, tip.height + 1 + i, t + 10 * (i + 1))
+            cs.process_new_block_pipelined(blk)
+            prev = blk.get_hash()
+        cs.settle_horizon()
+        assert cs._unwind_streak == 0
+        assert cs._collapse_level() == 0
+
+    def test_breaker_unhealthy_narrows_to_single_branch(self):
+        dispatch.reset()
+        try:
+            br = dispatch.breaker("ecdsa")
+            for _ in range(br.cfg.threshold):
+                br.record_failure(RuntimeError("boom"))
+            assert not br.healthy()
+            cs = _with_runway(depth=6)
+            assert cs._collapse_level() == 1
+            tip = cs.tip()
+            t = cs.get_time()
+            a1 = _mk(cs, tip.hash, tip.height + 1, t + 10, extra=b"A")
+            b1 = _mk(cs, tip.hash, tip.height + 1, t + 10, extra=b"B")
+            cs.process_new_block_pipelined(a1)
+            cs.process_new_block_pipelined(b1)
+            # single-branch mode: the competitor was NOT speculated
+            assert len(cs._spec_roots()) <= 1
+            cs.settle_horizon()
+            assert cs.tip().hash == a1.get_hash()
+        finally:
+            dispatch.reset()
+
+    def test_serial_collapse_still_converges(self):
+        cs = _with_runway(depth=6)
+        cs._unwind_streak = 4  # forced serial mode
+        tip = cs.tip()
+        t = cs.get_time()
+        a1 = _mk(cs, tip.hash, tip.height + 1, t + 10)
+        a2 = _mk(cs, a1.get_hash(), tip.height + 2, t + 20)
+        for blk in (a1, a2):
+            cs.process_new_block_pipelined(blk)
+        assert not cs._spec  # nothing speculative in serial mode
+        assert cs.tip().hash == a2.get_hash()
+        assert cs.pipeline_stats["degraded_connects"] >= 2
+        cs2 = _with_runway(1)
+        _feed(cs2, (a1, a2), pipelined=False)
+        assert _coin_digest(cs2) == _coin_digest(cs)
+
+
+class TestPipelinedReorg:
+    def test_reorg_routes_through_tree(self):
+        """A most-work branch forking BELOW the settled tip: settled
+        blocks disconnect serially (metered as a reorg), the new path
+        speculatively connects through tree layers, and the digest
+        matches the serial engine — with zero linear serial fallbacks."""
+        cs = _with_runway(depth=4)
+        tip = cs.tip()
+        t = cs.get_time()
+        m1 = _mk(cs, tip.hash, tip.height + 1, t + 10, extra=b"M")
+        m2 = _mk(cs, m1.get_hash(), tip.height + 2, t + 20, extra=b"M")
+        for blk in (m1, m2):
+            cs.process_new_block_pipelined(blk)
+        cs.settle_horizon()
+        assert cs.settled_tip().hash == m2.get_hash()
+
+        fork = [
+            _mk(cs, tip.hash, tip.height + 1, t + 10, extra=b"N"),
+        ]
+        for i in range(2, 6):
+            fork.append(_mk(cs, fork[-1].get_hash(), tip.height + i,
+                            t + 10 * i, extra=b"N"))
+        for blk in fork:
+            cs.process_new_block_pipelined(blk)
+        cs.settle_horizon()
+        assert cs.tip().hash == fork[-1].get_hash()
+        ps = cs.pipeline_stats
+        assert ps["reorgs"] == 1
+        assert ps["reorg_depth_max"] == 2
+        assert ps["serial_linear_fallbacks"] == 0
+
+        cs2 = _with_runway(1)
+        _feed(cs2, (m1, m2, *fork), pipelined=False)
+        assert cs2.tip().hash == cs.tip().hash
+        assert _coin_digest(cs2) == _coin_digest(cs)
+
+    def test_activation_survives_backpressure_moving_the_anchor(self):
+        """Inside the activation path loop a backpressure settle can
+        advance the settled tip past the fork point mid-connect; the
+        speculative connect must DECLINE (never base the layer on the
+        moved coin state, never mark the valid block invalid) and the
+        retry must still converge to the most-work branch with a digest
+        identical to the serial engine's."""
+        cs = _with_runway(depth=2)
+        cs.max_branches = 1  # B-blocks may not enter the tree on feed
+        tip = cs.tip()
+        t = cs.get_time()
+        a1 = _mk(cs, tip.hash, tip.height + 1, t + 10, extra=b"A")
+        a2 = _mk(cs, a1.get_hash(), tip.height + 2, t + 20, extra=b"A")
+        b = [_mk(cs, tip.hash, tip.height + 1, t + 10, extra=b"B")]
+        for i in range(2, 5):
+            b.append(_mk(cs, b[-1].get_hash(), tip.height + i,
+                         t + 10 * i, extra=b"B"))
+        blocks = (a1, a2, *b)
+        for blk in blocks:
+            cs.process_new_block_pipelined(blk)
+        cs.settle_horizon()
+        assert cs.tip().hash == b[-1].get_hash()
+        for blk in blocks:  # nothing valid was marked invalid en route
+            assert not (cs.block_index[blk.get_hash()].status
+                        & BlockStatus.FAILED_MASK)
+        cs2 = _with_runway(1)
+        _feed(cs2, blocks, pipelined=False)
+        assert cs2.tip().hash == cs.tip().hash
+        assert _coin_digest(cs2) == _coin_digest(cs)
+
+    def test_connect_declines_on_detached_parent(self):
+        """Direct probe of the anchor guard: a speculative connect whose
+        parent is neither the settled tip nor in-tree returns False
+        without touching state."""
+        cs = _with_runway(depth=4)
+        tip = cs.tip()
+        t = cs.get_time()
+        a1 = _mk(cs, tip.hash, tip.height + 1, t + 10, extra=b"A")
+        cs.process_new_block_pipelined(a1)
+        cs.settle_horizon()  # settled tip is now a1
+        orphan_parent = _mk(cs, tip.hash, tip.height + 1, t + 10,
+                            extra=b"O")
+        child = _mk(cs, orphan_parent.get_hash(), tip.height + 2, t + 20)
+        cs.accept_block(orphan_parent)
+        idx = cs.accept_block(child)
+        pre = _coin_digest(cs)
+        assert cs._connect_tip_speculative(idx, child) is False
+        assert not (idx.status & BlockStatus.FAILED_MASK)
+        assert not cs._spec
+        assert _coin_digest(cs) == pre
+
+    def test_packer_branch_attribution(self):
+        """Competing branches carrying real signatures share the packer;
+        the lane split is attributed per branch tag."""
+        cs = _with_runway(depth=6)
+        tip = cs.tip()
+        t = cs.get_time()
+        op_a, val_a = _runway_spendable(0)
+        op_b, val_b = _runway_spendable(1)
+        a1 = _mk(cs, tip.hash, tip.height + 1, t + 10,
+                 txs=(_signed_spend(op_a, val_a),), extra=b"A")
+        b1 = _mk(cs, tip.hash, tip.height + 1, t + 10,
+                 txs=(_signed_spend(op_b, val_b),), extra=b"B")
+        cs.process_new_block_pipelined(a1)
+        cs.process_new_block_pipelined(b1)
+        snap = cs._packer.snapshot()
+        assert len(snap["branch_lanes"]) == 2
+        assert all(v >= 1 for v in snap["branch_lanes"].values())
+        cs.settle_horizon()
+        assert cs._packer.snapshot()["pending_lanes"] == 0
+
+    def test_packer_discard_attribution(self):
+        """A losing branch whose lanes are still PARKED when it drops
+        (the winner carried no signatures, so nothing forced a flush)
+        has its discards attributed to its branch tag."""
+        cs = _with_runway(depth=6)
+        tip = cs.tip()
+        t = cs.get_time()
+        op_b, val_b = _runway_spendable(0)
+        b1 = _mk(cs, tip.hash, tip.height + 1, t + 10,
+                 txs=(_signed_spend(op_b, val_b),), extra=b"B")
+        a1 = _mk(cs, tip.hash, tip.height + 1, t + 10, extra=b"A")
+        a2 = _mk(cs, a1.get_hash(), tip.height + 2, t + 20)
+        for blk in (b1, a1, a2):
+            cs.process_new_block_pipelined(blk)
+        cs.settle_horizon()
+        assert cs.tip().hash == a2.get_hash()
+        snap = cs._packer.snapshot()
+        assert sum(snap["branch_discards"].values()) >= 1
+        assert snap["pending_lanes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# EDA / cw-144 DAA difficulty rules (consensus/pow.py)
+# ---------------------------------------------------------------------------
+
+_BITS = 0x1F00FFFF  # comfortably below the synthetic pow_limit
+
+
+def _cash_consensus(daa_height: int = -1):
+    base = regtest_params().consensus
+    return dataclasses.replace(
+        base, use_cash_daa=True, daa_height=daa_height,
+        pow_no_retargeting=False,
+        pow_allow_min_difficulty_blocks=False,
+        pow_limit=(1 << 250) - 1,
+    )
+
+
+def _synth_chain(n: int, spacing: int = 600, bits: int = _BITS,
+                 t0: int = 1_500_000_000):
+    """A synthetic CBlockIndex chain (no blocks, headers only) — the
+    difficulty rules read times/bits/work off the index alone."""
+    prev = None
+    for i in range(n):
+        header = CBlockHeader(
+            version=0x20000000,
+            hash_prev_block=prev.hash if prev else b"\x00" * 32,
+            hash_merkle_root=b"\x00" * 32,
+            time=t0 + i * spacing, bits=bits, nonce=0,
+        )
+        h = hashlib.sha256(f"synth{i}".encode()).digest()
+        prev = CBlockIndex(header, h, prev)
+    return prev
+
+
+class TestCashDifficulty:
+    def test_eda_quiet_chain_carries_bits(self):
+        params = _cash_consensus()
+        tip = _synth_chain(20, spacing=600)
+        assert get_next_work_required(tip, tip.time + 600, params) == _BITS
+
+    def test_eda_fires_on_twelve_hour_mtp_gap(self):
+        params = _cash_consensus()
+        # 13h spacing: MTP(prev) - MTP(prev-6) = 6 * 13h > 12h
+        tip = _synth_chain(20, spacing=13 * 3600)
+        got = get_next_work_required(tip, tip.time + 600, params)
+        target, _ = compact_to_target(_BITS)
+        assert got == target_to_compact(target + (target >> 2))
+        assert got == eda_bits(tip, params)
+        # and the adjustment clamps at pow_limit
+        near_limit = target_to_compact(params.pow_limit)
+        tip2 = _synth_chain(20, spacing=13 * 3600, bits=near_limit)
+        assert (get_next_work_required(tip2, tip2.time + 600, params)
+                == near_limit)
+
+    def test_eda_runs_on_min_difficulty_chains(self):
+        """Regtest/testnet-shaped chains (pow_allow_min_difficulty) still
+        RUN the EDA rule in the cash era — the 20-minute exception wins
+        first, then eda_bits (which clamps at pow_limit, so a
+        min-difficulty chain's bits never actually move). This is the
+        path the fork-storm fleet's pre-DAA blocks take live."""
+        params = dataclasses.replace(
+            _cash_consensus(), pow_allow_min_difficulty_blocks=True)
+        limit_bits = target_to_compact(params.pow_limit)
+        tip = _synth_chain(20, spacing=600, bits=limit_bits)
+        # quiet chain: EDA carries the previous bits (== the limit here)
+        assert (get_next_work_required(tip, tip.time + 600, params)
+                == eda_bits(tip, params) == limit_bits)
+        # 20-minute gap: the min-difficulty exception answers first
+        assert (get_next_work_required(tip, tip.time + 1201, params)
+                == limit_bits)
+        # and a sub-limit chain with a 13h gap still adjusts
+        tip2 = _synth_chain(20, spacing=13 * 3600)
+        got = get_next_work_required(tip2, tip2.time + 600, params)
+        assert got == eda_bits(tip2, params) != _BITS
+
+    def test_eda_walks_back_past_min_difficulty_blocks(self):
+        """One 20-minute-gap min-difficulty block must not floor the rest
+        of the interval: the EDA era anchors on the last REAL-difficulty
+        block (the reference walk-back), so the next normally-paced
+        block returns to _BITS instead of carrying pow_limit forward."""
+        params = dataclasses.replace(
+            _cash_consensus(), pow_allow_min_difficulty_blocks=True)
+        limit_bits = target_to_compact(params.pow_limit)
+        real = _synth_chain(20, spacing=600)  # bits=_BITS throughout
+        mindiff_header = CBlockHeader(
+            version=0x20000000, hash_prev_block=real.hash,
+            hash_merkle_root=b"\x00" * 32,
+            time=real.time + 1300, bits=limit_bits, nonce=0)
+        tip = CBlockIndex(mindiff_header, b"\x77" * 32, real)
+        assert (get_next_work_required(tip, tip.time + 600, params)
+                == _BITS)
+
+    def test_daa_routing_and_response(self):
+        params = _cash_consensus(daa_height=0)
+        tip = _synth_chain(150, spacing=600)
+        got = get_next_work_required(tip, tip.time + 600, params)
+        assert got == get_next_work_required_cash(tip, tip.time + 600,
+                                                  params)
+        # faster blocks -> more work demanded (smaller target)
+        fast = _synth_chain(150, spacing=300)
+        got_fast = get_next_work_required(fast, fast.time + 300, params)
+        t_slow, _ = compact_to_target(got)
+        t_fast, _ = compact_to_target(got_fast)
+        assert t_fast < t_slow
+
+    def test_boundary_routes_eda_below_daa_at(self):
+        daa_h = 151
+        params = _cash_consensus(daa_height=daa_h)
+        tip = _synth_chain(daa_h - 1, spacing=13 * 3600)  # next height = daa_h - 1? no:
+        # tip height = daa_h - 2, next block height = daa_h - 1 < daa_h: EDA
+        assert tip.height == daa_h - 2
+        assert (get_next_work_required(tip, tip.time + 600, params)
+                == eda_bits(tip, params))
+        tip2 = _synth_chain(daa_h + 1, spacing=600)  # next height > daa_h
+        assert (get_next_work_required(tip2, tip2.time + 600, params)
+                == get_next_work_required_cash(tip2, tip2.time + 600,
+                                               params))
+
+
+class TestDeepReorgAcrossDaaBoundary:
+    """Deep reorg crossing the EDA->DAA switch on a regtest-shaped chain
+    (bits pinned at the limit on both sides of the boundary, so the
+    cached runway replays — the rules still RUN and must agree)."""
+
+    DAA_H = 107  # runway is 104; the reorg crosses this
+
+    def _cs(self, depth):
+        cs = _with_runway(depth)
+        cs.params = dataclasses.replace(
+            cs.params,
+            consensus=dataclasses.replace(
+                cs.params.consensus, use_cash_daa=True,
+                daa_height=self.DAA_H))
+        return cs
+
+    def _sequences(self):
+        cs = self._cs(1)
+        tip = cs.tip()
+        t = cs.get_time()
+        main = [_mk(cs, tip.hash, tip.height + 1, t + 10, extra=b"M")]
+        for i in range(2, 5):  # heights 105..108: crosses 107
+            main.append(_mk(cs, main[-1].get_hash(), tip.height + i,
+                            t + 10 * i, extra=b"M"))
+        fork = [_mk(cs, tip.hash, tip.height + 1, t + 10, extra=b"F")]
+        for i in range(2, 7):  # heights 105..110: deeper, crosses 107
+            fork.append(_mk(cs, fork[-1].get_hash(), tip.height + i,
+                            t + 10 * i, extra=b"F"))
+        return main, fork
+
+    def test_both_engines_both_orders_identical(self):
+        main, fork = self._sequences()
+        outcomes = set()
+        for order in ((*main, *fork), (*fork, *main)):
+            for depth in (1, 4):
+                cs = self._cs(depth)
+                _feed(cs, order, pipelined=(depth > 1))
+                outcomes.add((cs.tip().hash, _coin_digest(cs)))
+                assert cs.tip().hash == fork[-1].get_hash()
+        assert len(outcomes) == 1
+
+    def test_pipelined_reorg_metrics_across_boundary(self):
+        main, fork = self._sequences()
+        cs = self._cs(4)
+        _feed(cs, main, pipelined=True)
+        _feed(cs, fork, pipelined=True)
+        assert cs.tip().hash == fork[-1].get_hash()
+        assert cs.pipeline_stats["reorgs"] == 1
+        assert cs.pipeline_stats["reorg_depth_max"] == 4
+        assert cs.pipeline_stats["serial_linear_fallbacks"] == 0
+
+
+@pytest.mark.slow
+class TestUnwindStormSoak:
+    def test_repeated_deep_unwinds_with_ecdsa_faults(self, fault_harness):
+        """The unwind storm: K-deep bad-signature branches over and over
+        with device faults injected at the ecdsa site. The node must
+        never wedge, the ladder must engage and recover, and the final
+        chain must match a fault-free serial control byte for byte."""
+        fault_harness("fail-rate", ops="ecdsa", rate="0.3", seed="9")
+        cs = _with_runway(depth=5)
+        fed: list = []
+
+        def storm_round(round_i: int, with_bad: bool):
+            tip = cs.settled_tip()
+            t = cs.get_time()
+            blocks = []
+            if with_bad:
+                op, value = _runway_spendable(round_i % 4)
+                bad = _tampered(_signed_spend(op, value), op)
+                b1 = _mk(cs, tip.hash, tip.height + 1, t + 10, txs=(bad,),
+                         extra=b"bad%d" % round_i)
+                b2 = _mk(cs, b1.get_hash(), tip.height + 2, t + 20)
+                b3 = _mk(cs, b2.get_hash(), tip.height + 3, t + 30)
+                blocks += [b1, b2, b3]
+            g1 = _mk(cs, tip.hash, tip.height + 1, t + 10,
+                     extra=b"good%d" % round_i)
+            blocks.append(g1)
+            for blk in blocks:
+                try:
+                    cs.process_new_block_pipelined(blk)
+                except BlockValidationError:
+                    pass  # bad ancestry noticed at accept — fine
+            cs.settle_horizon()
+            assert cs.tip().hash == g1.get_hash(), round_i
+            fed.extend(blocks)
+
+        # phase 1: the storm — every round converges on the good chain
+        # while the ladder collapses tree -> single-branch -> serial
+        for round_i in range(6):
+            storm_round(round_i, with_bad=True)
+        assert cs.pipeline_stats["unwinds"] >= 2
+        assert cs._unwind_streak >= 4  # fully collapsed at some point
+        assert cs._collapse_level() == 2
+        assert cs.pipeline_stats["degraded_connects"] >= 1
+
+        # phase 2: the storm passes — sustained clean activations re-open
+        # the ladder and the tree speculates again
+        for round_i in range(6, 16):
+            storm_round(round_i, with_bad=False)
+        assert cs._collapse_level() == 0
+        tip = cs.settled_tip()
+        t = cs.get_time()
+        f1 = _mk(cs, tip.hash, tip.height + 1, t + 10, extra=b"f1")
+        f2 = _mk(cs, tip.hash, tip.height + 1, t + 10, extra=b"f2")
+        cs.process_new_block_pipelined(f1)
+        cs.process_new_block_pipelined(f2)
+        assert len(cs._spec_roots()) == 2  # the tree is open for business
+        cs.settle_horizon()
+        fed.extend([f1, f2])
+
+        # fault-free serial control over the same feed
+        import os
+
+        for key in [k for k in os.environ if k.startswith("BCP_FAULT")]:
+            os.environ.pop(key, None)
+        from bitcoincashplus_tpu.util import faults
+
+        faults.INJECTOR.reload()
+        cs2 = _with_runway(1)
+        for blk in fed:
+            try:
+                cs2.process_new_block(blk)
+            except BlockValidationError:
+                pass
+        assert cs2.tip().hash == cs.tip().hash
+        assert _coin_digest(cs2) == _coin_digest(cs)

@@ -169,3 +169,57 @@ def test_summarize_includes_serving_when_present():
     out = trace_view.summarize(EVENTS + SERVING_EVENTS)
     assert "signature serving" in out
     assert out.index("signature serving") < out.index("unwinds:")
+
+
+# -- reorg report (ISSUE 9 speculation tree) ---------------------------
+
+
+def _instant(name, ts, **args):
+    return {"name": name, "ph": "i", "s": "t", "ts": ts,
+            "pid": 1, "tid": 1, "args": args}
+
+
+REORG_EVENTS = [
+    _instant("block.reorg", 100_000, depth=3, to_height=42,
+             to_hash="00aa11bb22cc33dd"),
+    _instant("block.reorg", 200_000, depth=1, to_height=43,
+             to_hash="00ee11ff22aa33bb"),
+    _instant("block.unwind", 300_000, height=44, branch="deadbeef0001",
+             dropped=2, reason="blk-bad-inputs"),
+    _instant("block.branch_drop", 400_000, branch="cafecafe0002",
+             height=44, hash="1122334455667788", blocks=3,
+             reason="lost-work", lifetime_ms=512.25),
+    _instant("block.branch_drop", 500_000, branch="cafecafe0003",
+             height=45, hash="99aabbccddeeff00", blocks=1,
+             reason="lost-work", lifetime_ms=87.75),
+]
+
+REORG_GOLDEN = """\
+
+reorg report (speculation tree)
+reorgs: 2  depth max 3 mean 2.00
+  depth 3 -> 00aa11bb22cc33dd height 42
+  depth 1 -> 00ee11ff22aa33bb height 43
+settle-failure unwinds: 1 (2 speculative block(s) dropped)
+losing branches dropped: 2 (4 block(s)), lifetime mean 300.0 ms max 512.2 ms
+  branch cafecafe0002 from height 44: 3 block(s), lost-work, lived 512.2 ms
+  branch cafecafe0003 from height 45: 1 block(s), lost-work, lived 87.8 ms"""
+
+
+def test_reorg_section_golden():
+    assert "\n".join(trace_view.reorg_section(REORG_EVENTS)) == REORG_GOLDEN
+
+
+def test_reorg_section_absent_without_tree_events():
+    # pre-tree dumps (even ones WITH unwind instants) keep their
+    # byte-stable report — the unwind list at the report tail already
+    # covers them and the golden above must not regress
+    assert trace_view.reorg_section(EVENTS) == []
+    assert "reorg report" not in trace_view.summarize(EVENTS)
+
+
+def test_summarize_includes_reorg_report_when_present():
+    out = trace_view.summarize(EVENTS + REORG_EVENTS)
+    assert "reorg report (speculation tree)" in out
+    # ordered after serving (absent here), before the unwind tail
+    assert out.index("reorg report") < out.index("unwinds:")

@@ -15,6 +15,9 @@ prints:
     settle span's duration — overlap = the fraction of the in-flight
     window the host spent doing useful work instead of waiting;
   - the top-10 slowest settles (the blocks worth profiling first);
+  - a "reorg report" when the dump carries speculation-tree events
+    (block.reorg / block.unwind / block.branch_drop instants, ISSUE 9):
+    reorg depths, settle-failure unwinds, and losing-branch lifetimes;
   - a "signature serving" section when the dump carries SigService spans
     (serving.flush / serving.settle, ISSUE 7): flush-reason breakdown
     with lane counts, the flush->settle span-chain timing, and the list
@@ -172,6 +175,60 @@ def serving_section(events: list[dict]) -> list[str]:
     return lines
 
 
+def reorg_section(events: list[dict]) -> list[str]:
+    """The speculation-tree reorg report (empty when the dump carries no
+    reorg/branch events — keeps pre-tree dumps' reports byte-stable).
+
+    Reads three instant families the chainstate emits (ISSUE 9):
+    ``block.reorg`` (settled blocks disconnected toward a new tip, with
+    depth), ``block.unwind`` (a branch dropped by a settle FAILURE, with
+    the failing block and how many speculative blocks went with it), and
+    ``block.branch_drop`` (a losing branch dropped un-externalized when
+    its competitor settled, with its lifetime)."""
+    reorgs = [ev for ev in events
+              if ev.get("ph") == "i" and ev.get("name") == "block.reorg"]
+    unwinds = [ev for ev in events
+               if ev.get("ph") == "i" and ev.get("name") == "block.unwind"]
+    drops = [ev for ev in events
+             if ev.get("ph") == "i"
+             and ev.get("name") == "block.branch_drop"]
+    if not (reorgs or drops):
+        return []
+    lines = ["", "reorg report (speculation tree)"]
+    if reorgs:
+        depths = [int(ev.get("args", {}).get("depth", 0)) for ev in reorgs]
+        lines.append(
+            f"reorgs: {len(reorgs)}  depth max {max(depths)} "
+            f"mean {sum(depths) / len(depths):.2f}")
+        for ev in reorgs:
+            a = ev.get("args", {})
+            lines.append(
+                f"  depth {a.get('depth')} -> {a.get('to_hash')} "
+                f"height {a.get('to_height')}")
+    unwound = sum(int(ev.get("args", {}).get("dropped", 0))
+                  for ev in unwinds)
+    if unwinds:
+        lines.append(
+            f"settle-failure unwinds: {len(unwinds)} "
+            f"({unwound} speculative block(s) dropped)")
+    if drops:
+        lives = [float(ev.get("args", {}).get("lifetime_ms", 0.0))
+                 for ev in drops]
+        blocks = sum(int(ev.get("args", {}).get("blocks", 0))
+                     for ev in drops)
+        lines.append(
+            f"losing branches dropped: {len(drops)} ({blocks} block(s)), "
+            f"lifetime mean {sum(lives) / len(lives):.1f} ms "
+            f"max {max(lives):.1f} ms")
+        for ev in drops:
+            a = ev.get("args", {})
+            lines.append(
+                f"  branch {a.get('branch')} from height {a.get('height')}"
+                f": {a.get('blocks')} block(s), {a.get('reason')}, "
+                f"lived {float(a.get('lifetime_ms', 0.0)):.1f} ms")
+    return lines
+
+
 def summarize(events: list[dict]) -> str:
     """The full text report over one dump."""
     spans = [ev for ev in events if ev.get("ph") == "X"]
@@ -209,6 +266,7 @@ def summarize(events: list[dict]) -> str:
                          f"{b['overlap']:>10.4f}")
 
     lines += serving_section(events)
+    lines += reorg_section(events)
 
     unwinds = [ev for ev in events
                if ev.get("ph") == "i" and ev.get("name") == "block.unwind"]
