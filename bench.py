@@ -765,6 +765,150 @@ def _scalar_sweep(header80, target, max_nonces=1 << 32, tile=0):
     return None, max_nonces
 
 
+def bench_mining():
+    """ISSUE 10: the device-resident mining loop's end-to-end trajectory.
+    Three engines sweep the same nonce work on the same host:
+
+      scalar        sweep_header_cpu — the reference generateBlocks loop
+      per_dispatch  supervised sweep_header, one dispatch + blocking
+                    scalar fetch per poll (the PR<=9 end-to-end shape);
+                    measured at two poll granularities
+      resident      mining/resident.ResidentSweep.advance — persistent
+                    template buffers, pipelined segments, FIFO polls
+
+    The headline ratio compares the resident path against the
+    per-dispatch path at the FINEST poll cadence the per-dispatch shape
+    can afford (its per-call overhead floors poll latency near ~1 ms on
+    any host; the resident loop polls FASTER than that while sweeping
+    bigger segments — the decoupling is the design). The equal-dispatch-
+    size ratio is recorded alongside, honestly smaller. Digest parity:
+    every engine must find the oracle-identical first hit on an easy
+    target before its throughput counts. Writes BENCH_r10.json
+    (schema_version=2 + host stamp) with the ROOFLINE.md §8 ops/nonce
+    census delta inline."""
+    import importlib.util
+
+    from bitcoincashplus_tpu.mining.resident import ResidentSweep
+    from bitcoincashplus_tpu.ops.dispatch import supervised_sweep
+    from bitcoincashplus_tpu.ops.miner import sweep_header_cpu
+
+    header = b"\xa5" * 80
+    easy = 0x7FFFFF << (8 * 29)
+    polls = int(os.environ.get("BCP_BENCH_MINING_POLLS", "40"))
+    tile_small = 1 << 12   # per-dispatch fine poll granularity
+    tile_big = 1 << 14     # resident segment / per-dispatch coarse
+
+    def med(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    # --- digest parity gate (easy target, all engines vs the oracle) ---
+    n_oracle, _ = sweep_header_cpu(header, easy, max_nonces=1 << 13)
+    assert n_oracle is not None
+    sup = supervised_sweep()
+    n_pd, _ = sup(header, easy, max_nonces=1 << 13, tile=tile_small)
+    rs_par = ResidentSweep(tile=tile_small, seg_tiles=2, inflight=2,
+                           kernel="exact")
+    n_res, _ = rs_par.sweep(header, easy, max_nonces=1 << 13)
+    rs_par.close()
+    parity_ok = (n_pd == n_oracle and n_res == n_oracle)
+    assert parity_ok, (n_oracle, n_pd, n_res)
+
+    # --- scalar engine -------------------------------------------------
+    n_scalar = 1 << 14
+    t0 = time.perf_counter()
+    sweep_header_cpu(header, 0, max_nonces=n_scalar)
+    scalar_mhs = n_scalar / (time.perf_counter() - t0) / 1e6
+
+    # --- per-dispatch engine (supervised, one dispatch per poll) -------
+    def per_dispatch(tile):
+        sup(header, 0, max_nonces=tile, tile=tile)  # warm/compile
+        walls = []
+        for _r in range(3):
+            t0 = time.perf_counter()
+            for k in range(polls):
+                sup(header, 0, start_nonce=(k * tile) & 0xFFFFFFFF,
+                    max_nonces=tile, tile=tile)
+            walls.append(time.perf_counter() - t0)
+        wall = med(walls)
+        return {"tile": tile, "polls": polls,
+                "mhs": round(polls * tile / wall / 1e6, 3),
+                "poll_wall_ms": round(wall / polls * 1e3, 3)}
+
+    pd_fine = per_dispatch(tile_small)
+    pd_coarse = per_dispatch(tile_big)
+
+    # --- resident engine (continuous advance over one template) --------
+    rs = ResidentSweep(tile=tile_big, seg_tiles=1, inflight=2,
+                       kernel="exact")
+    rs.set_template(header, 0)
+    rs.advance(tile_big)  # warm (shares the per-dispatch compile cache)
+    walls = []
+    for _r in range(3):
+        t0 = time.perf_counter()
+        rs.advance(polls * tile_big)
+        walls.append(time.perf_counter() - t0)
+    wall = med(walls)
+    res = {"tile": tile_big, "seg_tiles": 1, "inflight": 2,
+           "mhs": round(polls * tile_big / wall / 1e6, 3),
+           "poll_wall_ms": round(wall / polls * 1e3, 3),
+           "snapshot": rs.snapshot()}
+    rs.close()
+
+    # the headline: resident vs the per-dispatch path at the finest
+    # cadence it affords — valid only while the resident loop's own poll
+    # wall is no WORSE (it settles one pipelined segment per poll)
+    cadence_ok = res["poll_wall_ms"] <= pd_fine["poll_wall_ms"] * 1.25
+    headline_x = round(res["mhs"] / pd_fine["mhs"], 2)
+    same_size_x = round(res["mhs"] / pd_coarse["mhs"], 2)
+
+    # --- ops/nonce census delta (ROOFLINE.md §8) -----------------------
+    census = None
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "bcp_roofline", os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tools", "roofline.py"))
+        roofline = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(roofline)
+        h7, full, full_hoisted, _ = roofline.run_census()
+        census = {"h7_hoisted": h7, "h7_pre_hoist": roofline.PRE_HOIST_H7,
+                  "full_generic": full, "full_hoisted": full_hoisted}
+    except Exception as e:  # pragma: no cover - census is best-effort
+        census = {"error": f"{type(e).__name__}: {e}"}
+
+    result = {
+        "metric": "mining",
+        **_bench_stamp(),
+        "scalar_mhs": round(scalar_mhs, 3),
+        "per_dispatch_fine": pd_fine,
+        "per_dispatch_coarse": pd_coarse,
+        "resident": res,
+        "resident_vs_dispatch_x": headline_x,
+        "resident_same_dispatch_size_x": same_size_x,
+        "resident_poll_cadence_ok": cadence_ok,
+        "digest_parity": {"oracle_nonce": int(n_oracle),
+                          "per_dispatch": int(n_pd),
+                          "resident": int(n_res), "ok": parity_ok},
+        "census_ops_per_nonce": census,
+        "note": "CPU backend = memcpy-scale dispatch lower bound; the "
+                "real gap is the tunneled-TPU ~15x (BENCH_r05/r08). "
+                "headline resident_vs_dispatch_x compares against the "
+                "finest poll cadence the per-dispatch shape affords "
+                "(per-call overhead floors its poll latency); the "
+                "resident loop polls at least as often while dispatching "
+                "bigger segments — equal-dispatch-size ratio recorded "
+                "as resident_same_dispatch_size_x",
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_r10.json"), "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    emit("mining_resident_speedup", headline_x, "x", 0.0,
+         **{k: v for k, v in result.items() if k != "metric"})
+    return {"mining_resident_vs_dispatch_x": headline_x,
+            "mining_resident_mhs": res["mhs"]}
+
+
 def _gen_fork_corpus(workdir, segments=6, seg_len=4, fork_depth=3):
     """A reorg-heavy corpus (ISSUE 9): linear segments punctuated by
     deeper competing branches. Each round mines ``seg_len`` blocks, rolls
@@ -1559,6 +1703,11 @@ def main():
     recap.update(bench_reindex(device_sps) or {})  # config 6: north star
     recap.update(bench_import_pipeline() or {})  # ISSUE 4: settle horizon
     recap.update(bench_fork_storm() or {})  # ISSUE 9: speculation tree
+    try:
+        recap.update(bench_mining() or {})  # ISSUE 10: resident loop
+    except Exception as e:  # pragma: no cover - diagnostics only
+        emit("mining_resident_speedup", -1, "x", 0.0,
+             error=f"{type(e).__name__}: {e}")
     recap.update(bench_telemetry_overhead() or {})  # ISSUE 6: < 2% budget
     recap.update(bench_serving() or {})  # ISSUE 7: serviced >= 2x sync
     try:
@@ -1575,11 +1724,13 @@ def main():
 
 
 if __name__ == "__main__":
-    # `python bench.py dispatch_breakdown` / `python bench.py fork_storm`
-    # run one section alone (both are also part of the full run)
+    # `python bench.py dispatch_breakdown` / `fork_storm` / `mining` run
+    # one section alone (all are also part of the full run)
     if len(sys.argv) > 1 and sys.argv[1] == "dispatch_breakdown":
         bench_dispatch_breakdown()
     elif len(sys.argv) > 1 and sys.argv[1] == "fork_storm":
         bench_fork_storm()
+    elif len(sys.argv) > 1 and sys.argv[1] == "mining":
+        bench_mining()
     else:
         main()

@@ -102,6 +102,30 @@ def header_midstate(header80: bytes) -> tuple:
     return sha256_compress(SHA256_INIT, header80[:64])
 
 
+def chunk2_round_state(midstate: tuple, tail12: bytes, rounds: int = 3) -> tuple:
+    """Compression state after the first ``rounds`` rounds of the header's
+    SECOND block (bytes 64..79 + padding), consuming only the
+    nonce-independent words w0..w2 (merkle tail, nTime, nBits) — so
+    ``rounds`` must be <= 3 (the nonce is w3).
+
+    This is the CPU twin of the sweep kernel's per-template chunk-2 hoist
+    (ops/sha256_sweep.hoist_template): the device precompute's early-round
+    state is pinned bit-exactly against this oracle by the mining tests.
+    """
+    assert len(tail12) == 12 and 0 <= rounds <= 3
+    w = struct.unpack(">3I", tail12)
+    a, b, c, d, e, f, g, h = midstate
+    for i in range(rounds):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = (h + s1 + ch + SHA256_K[i] + w[i]) & _M32
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = (s0 + maj) & _M32
+        h, g, f, e, d, c, b, a = g, f, e, (d + t1) & _M32, c, b, a, (t1 + t2) & _M32
+    return (a, b, c, d, e, f, g, h)
+
+
 def sha256d_from_midstate(midstate: tuple, tail16: bytes) -> bytes:
     """Finish SHA-256d of an 80-byte header given the block-0 midstate and the
     final 16 header bytes (merkle tail + time + bits + nonce)."""

@@ -32,7 +32,10 @@ def mine_block(assembler: BlockAssembler, script_pubkey: bytes,
                extranonce_start: int = 0) -> Optional[CBlock]:
     """Assemble + PoW-search one block. Returns the mined block or None if
     max_tries hashes were exhausted. `sweep` is injectable (single-chip
-    default; parallel.nonce_shard.sweep_header_sharded for a mesh); the
+    default; parallel.nonce_shard.sweep_header_sharded for a mesh;
+    node._select_sweep wires mining/resident.ResidentSweep.sweep — there,
+    each extranonce bump below is a device-side template BUFFER SWAP into
+    the persistent resident loop, not a fresh dispatch); the
     default is the SUPERVISED single-chip sweep (ops/dispatch): a claimed
     hit is host re-verified and a dead device degrades to the scalar CPU
     loop under the miner circuit breaker.

@@ -1,0 +1,460 @@
+"""Device-resident mining loop (the ISSUE 10 tentpole).
+
+BENCH_r05 measured the nonce sweep at 0.59 MH/s device-resident but
+0.04 MH/s end-to-end — ~15x lost to host dispatch — and BENCH_r08's
+per-phase decomposition pinned the blame on per-call enqueue/fetch, not
+the kernel (ROOFLINE.md has the kernel at 88% of its op-bound ceiling).
+The per-call shape (``ops/miner.sweep_header``) pays, on EVERY poll:
+host->device staging of the template (midstate/tail/target), a fresh
+program dispatch, a blocking scalar fetch, and the full devicewatch/
+breaker bookkeeping — serially, with the device idle between calls.
+
+``ResidentSweep`` keeps the sweep resident instead:
+
+- **One compiled program, long-lived buffers.** The template (midstate,
+  tail words, target limbs) lives in device buffers; a template refresh
+  is a same-shape buffer swap (``set_template``), never a retrace — the
+  compiled shape is keyed only by the static tile, declared to the
+  devicewatch compile sentinel as the ``miner_resident`` program with a
+  shape budget. The retrace-sentinel test asserts repeated swaps stay
+  inside it.
+- **Pipelined segments.** The nonce space is swept in fixed-size
+  segments (``seg_tiles`` tiles per dispatch); up to ``inflight``
+  segments ride the device queue at once (JAX async dispatch), so the
+  host settles segment k while k+1 already executes — enqueue/fetch
+  overhead overlaps the hash work instead of serializing with it.
+- **On-chip nonce-space rollover.** Segment arithmetic is uint32; the
+  host cursor clamps each segment at the 2^32 boundary
+  (``ops/miner._boundary_tiles`` semantics) and wraps to 0, counting
+  passes — a sweep crossing the boundary continues at nonce 0 without
+  re-hashing the straddled range and without a fresh program.
+- **Candidate-hit FIFO.** Device hits are host exact-verified (the
+  scalar oracle — 2 hashes, free next to a sweep) and pushed into a
+  bounded FIFO the caller polls; with the truncated-h7 kernel a false
+  positive (limb7 tie, ~2^-32) is resumed past synchronously, so
+  results stay bit-identical to the CPU oracle.
+
+``sweep()`` adapts the loop to the ``sweep_header`` contract (first hit
+in nonce order wins, ``(nonce | None, hashes_attempted)``) so
+``mining/generate.mine_block`` and ``node._select_sweep`` drive the
+persistent loop through the supervised-dispatch/breaker path unchanged:
+a dead device degrades to the scalar host loop under the miner breaker,
+and every settle beats the ``miner`` watchdog subsystem.
+
+Telemetry: ``bcp_mining_*`` counter/histogram families below (native,
+TYPEs per the PR 6/PR 7 lessons); the node projects ``snapshot()`` into
+``bcp_mining_state_*`` gauges and ``gettpuinfo.mining``.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from ..crypto.hashes import header_midstate, sha256d
+from ..util import devicewatch as dw
+from ..util import telemetry as tm
+
+PROGRAM = "miner_resident"
+# compiled-shape budget for the resident program: (kernel, tile)
+# specializations — a node mints at most the exact + h7 kernels at the
+# production tile plus a regtest/bench tile each; a template swap that
+# starts recompiling trips the sentinel (asserted in the mining tests)
+SHAPE_BUDGET = 4
+
+_TILES_C = tm.counter(
+    "bcp_mining_tiles_swept_total",
+    "Nonce tiles swept by the resident mining loop")
+_CANDS_C = tm.counter(
+    "bcp_mining_candidates_total",
+    "Device candidate hits by outcome (confirmed = host-verified PoW hit, "
+    "false_positive = truncated-limb tie resumed past, stale = hit from a "
+    "pre-swap template generation, dropped = FIFO overflow)",
+    labels=("result",))
+_SWAPS_C = tm.counter(
+    "bcp_mining_template_swaps_total",
+    "Template refreshes applied as device buffer swaps (no retrace)")
+_POLLS_C = tm.counter(
+    "bcp_mining_polls_total",
+    "Host polls of the resident loop (one settled segment each)")
+_ROLLOVER_C = tm.counter(
+    "bcp_mining_rollovers_total",
+    "Nonce-space rollovers (cursor wrapped past 2^32 to 0)")
+_POLL_H = tm.histogram(
+    "bcp_mining_poll_seconds",
+    "Blocking settle wait per resident-loop poll (the d2h scalar fetch "
+    "of the oldest in-flight segment)")
+_FIFO_G = tm.gauge(
+    "bcp_mining_fifo_depth",
+    "Confirmed candidate hits parked in the resident loop's FIFO")
+
+
+def _clamp_segment(cursor: int, want: int, tile: int, cap: int):
+    """Boundary-clamped ``(n_tiles, nonces)`` for a segment at ``cursor``:
+    the shared ops/miner._boundary_tiles clamp (no wrap past 2^32 inside
+    one dispatch) plus the per-segment tile cap."""
+    from ..ops.miner import _boundary_tiles
+
+    n_tiles = min(cap, _boundary_tiles(cursor, want, tile))
+    return n_tiles, min(n_tiles * tile, (1 << 32) - cursor)
+
+
+class _Segment:
+    __slots__ = ("gen", "start", "n_tiles", "nonces", "out")
+
+    def __init__(self, gen, start, n_tiles, nonces, out):
+        self.gen = gen              # template generation at enqueue
+        self.start = start          # first nonce of the segment
+        self.n_tiles = n_tiles
+        self.nonces = nonces        # boundary-clamped nonce count
+        self.out = out              # (found, nonce, tiles) device futures
+
+
+class ResidentSweep:
+    """Long-lived device-resident PoW sweep (see module docstring).
+
+    ``kernel``: "exact" runs the full 8-limb on-device compare
+    (ops/miner.sweep_jit — no false positives); "h7" runs the truncated
+    top-limb kernel (ops/sha256_sweep.sweep_fast_jit — fewer ops/nonce,
+    candidates host-verified). ``tile`` is the STATIC compiled shape;
+    the loop never recompiles for a template swap, only for a new
+    (kernel, tile) pair, bounded by the devicewatch shape budget."""
+
+    def __init__(self, tile: int = 1 << 16, seg_tiles: int = 8,
+                 inflight: int = 2, fifo_depth: int = 16,
+                 kernel: str = "exact"):
+        if kernel not in ("exact", "h7"):
+            raise ValueError(f"resident kernel {kernel!r}: exact or h7")
+        self.tile = int(tile)
+        self.seg_tiles = max(1, int(seg_tiles))
+        self.inflight = max(1, int(inflight))
+        self.kernel = kernel
+        self.fifo = deque(maxlen=max(1, int(fifo_depth)))
+        self.generation = 0
+        self._header76: Optional[bytes] = None
+        self._target: Optional[int] = None
+        self._mid = self._tail = self._tgt = None   # device buffers
+        self._mid_np = self._tail_np = self._tgt_np = None
+        self._cursor = 0
+        self._segments: deque[_Segment] = deque()
+        self._watchdog = False
+        # cumulative stats (snapshot() / gettpuinfo.mining)
+        self.tiles_swept = 0
+        self.nonces_swept = 0
+        self.passes = 0
+        self.buffer_swaps = 0
+        self.polls = 0
+        self.hits = 0
+        self.false_positives = 0
+        self.stale_hits = 0
+        self.segments_discarded = 0
+        self.fifo_dropped = 0
+        self._poll_ema_s = 0.0      # inter-poll cadence (EMA)
+        self._last_poll_t = 0.0
+
+    # -- template lifecycle (buffer swap, never a retrace) --------------
+
+    def set_template(self, header80: bytes, target: int) -> int:
+        """Install a template. A changed (header bytes 0..75, target)
+        swaps the device buffers in place — same shapes, same compiled
+        program — bumps the generation, and invalidates in-flight
+        segments (their results are counted stale, never trusted).
+        Idempotent for an unchanged template."""
+        import jax.numpy as jnp
+
+        from ..ops.sha256 import bytes_to_words_np, target_to_limbs_np
+
+        assert len(header80) == 80
+        header76 = header80[:76]
+        if header76 == self._header76 and target == self._target:
+            return self.generation
+        self._header76 = header76
+        self._target = target
+        self._mid_np = np.array(header_midstate(header80), dtype=np.uint32)
+        self._tail_np = bytes_to_words_np(
+            np.frombuffer(header80[64:76], dtype=np.uint8))
+        limbs = target_to_limbs_np(target)
+        self._tgt_np = (np.uint32(limbs[7]) if self.kernel == "h7"
+                        else limbs)
+        nbytes = int(self._mid_np.nbytes + self._tail_np.nbytes
+                     + np.asarray(self._tgt_np).nbytes)
+        dw.note_transfer("miner_resident", "h2d", nbytes)
+        # the swap: fresh same-shape device buffers replace the old ones
+        # (the old buffers are freed once their in-flight segments settle)
+        self._mid = jnp.asarray(self._mid_np)
+        self._tail = jnp.asarray(self._tail_np)
+        self._tgt = jnp.asarray(self._tgt_np)
+        self.generation += 1
+        self.buffer_swaps += 1
+        _SWAPS_C.inc()
+        self._cursor = 0
+        return self.generation
+
+    # -- segment pipeline -----------------------------------------------
+
+    def _jitfn(self):
+        if self.kernel == "h7":
+            from ..ops.sha256_sweep import sweep_fast_jit
+
+            return sweep_fast_jit
+        from ..ops.miner import sweep_jit
+
+        return sweep_jit
+
+    def _dispatch(self, start: int, n_tiles: int):
+        """Enqueue one segment dispatch under the compile sentinel; the
+        shape signature is (kernel, tile) — template swaps re-dispatch
+        the SAME signature, so the shapes count must stay flat."""
+        import jax.numpy as jnp
+
+        jitfn = self._jitfn()
+        args = (self._mid_np, self._tail_np, self._tgt_np,
+                np.uint32(start), np.uint32(n_tiles))
+        with dw.program(PROGRAM, shape_budget=SHAPE_BUDGET).dispatch(
+                self.kernel, self.tile, jitfn=jitfn, args=args,
+                kwargs={"tile": self.tile}):
+            out = jitfn(self._mid, self._tail, self._tgt,
+                        jnp.uint32(start), jnp.uint32(n_tiles),
+                        tile=self.tile)
+        dw.note_transfer("miner_resident", "h2d", 8)  # 2 uint32 scalars
+        return out
+
+    def _pump(self, budget_left: int) -> int:
+        """Enqueue segments (rollover-aware) until the in-flight window
+        is full or ``budget_left`` nonces are covered; returns the nonce
+        count newly planned."""
+        planned = 0
+        while (len(self._segments) < self.inflight
+               and budget_left - planned > 0):
+            n_tiles, nonces = _clamp_segment(
+                self._cursor, budget_left - planned, self.tile,
+                self.seg_tiles)
+            out = self._dispatch(self._cursor, n_tiles)
+            self._segments.append(_Segment(
+                self.generation, self._cursor, n_tiles, nonces, out))
+            planned += nonces
+            self._cursor = (self._cursor + nonces) & 0xFFFFFFFF
+            if self._cursor == 0:
+                self.passes += 1
+                _ROLLOVER_C.inc()
+        return planned
+
+    def _settle_oldest(self):
+        """Block on the oldest in-flight segment; returns (seg, found,
+        cand_nonce, tiles_done). Meters the poll, beats the watchdog."""
+        seg = self._segments.popleft()
+        t0 = time.perf_counter()
+        found, nonce, tiles = seg.out
+        found = bool(found)
+        nonce = int(nonce)
+        tiles = int(tiles)
+        dt = time.perf_counter() - t0
+        _POLL_H.observe(dt)
+        _POLLS_C.inc()
+        dw.note_transfer("miner_resident", "d2h", 12, seconds=dt)
+        dw.note_phase("miner_resident", "fetch", dt)
+        now = time.perf_counter()
+        if self._last_poll_t:
+            gap = now - self._last_poll_t
+            self._poll_ema_s = (gap if self._poll_ema_s == 0.0
+                                else 0.8 * self._poll_ema_s + 0.2 * gap)
+        self._last_poll_t = now
+        self.polls += 1
+        done_tiles = tiles
+        self.tiles_swept += done_tiles
+        _TILES_C.inc(done_tiles)
+        dw.WATCHDOG.beat("miner")
+        return seg, found, nonce, tiles
+
+    def _confirm(self, nonce: int) -> bool:
+        """Host exact-verify of a device candidate (the scalar oracle)."""
+        hdr = self._header76 + int(nonce).to_bytes(4, "little")
+        return int.from_bytes(sha256d(hdr), "little") <= self._target
+
+    def _resweep_exact(self, start: int, nonces_left: int):
+        """Synchronous in-segment resume past an h7 false positive
+        (~2^-32 per hash): sweep [start, start+nonces_left) blocking.
+        Returns ``(hit, hashed)`` — the first CONFIRMED hit (or None) and
+        the number of nonces hashed here, which the caller must fold into
+        its attempted-hash accounting (the per-dispatch twin
+        sweep_header_fast counts resumed work the same way)."""
+        hashed = 0
+        while nonces_left > 0:
+            n_tiles, nonces = _clamp_segment(
+                start, nonces_left, self.tile, self.seg_tiles)
+            out = self._dispatch(start, n_tiles)
+            found, cand, tiles = bool(out[0]), int(out[1]), int(out[2])
+            done = min(tiles * self.tile, nonces)
+            self.tiles_swept += tiles
+            self.nonces_swept += done
+            hashed += done
+            _TILES_C.inc(tiles)
+            if not found:
+                return None, hashed
+            if self._confirm(cand):
+                return cand, hashed
+            self.false_positives += 1
+            _CANDS_C.labels(result="false_positive").inc()
+            consumed = ((cand - start) & 0xFFFFFFFF) + 1
+            nonces_left -= consumed
+            start = (cand + 1) & 0xFFFFFFFF
+        return None, hashed
+
+    # -- the sweep_header-contract driver -------------------------------
+
+    def sweep(self, header80: bytes, target: int, start_nonce: int = 0,
+              max_nonces: int = 1 << 32, tile: Optional[int] = None):
+        """Search [start_nonce, start_nonce+max_nonces) (rollover past
+        2^32, one full pass max) for the first nonce in sweep order with
+        sha256d(header) <= target. Same contract as
+        ops/miner.sweep_header; ``tile`` is accepted for signature
+        compatibility and ignored — the resident loop owns its compiled
+        tile. A changed header/target is a buffer swap; in-flight
+        segments of the old generation are discarded unsettled."""
+        gen = self.set_template(header80, target)
+        # stale in-flight segments (previous template or previous call's
+        # cursor) never contribute: drop the references — the device work
+        # completes harmlessly and the buffers are collected
+        self.segments_discarded += len(self._segments)
+        self._segments.clear()
+        self._cursor = start_nonce & 0xFFFFFFFF
+        budget = min(max_nonces, 1 << 32)
+        swept = 0
+        planned = self._pump(budget)
+        while self._segments:
+            seg, found, cand, tiles = self._settle_oldest()
+            done = min(tiles * self.tile, seg.nonces)
+            swept += done
+            self.nonces_swept += done
+            if found and seg.gen != gen:  # defensive: direct-pump users
+                self.stale_hits += 1
+                _CANDS_C.labels(result="stale").inc()
+            elif found:
+                if self._confirm(cand):
+                    self._record_hit()
+                    self.segments_discarded += len(self._segments)
+                    self._segments.clear()
+                    return cand, swept
+                # h7 limb tie: resume synchronously inside the segment
+                self.false_positives += 1
+                _CANDS_C.labels(result="false_positive").inc()
+                after = ((cand - seg.start) & 0xFFFFFFFF) + 1
+                hit, hashed = self._resweep_exact(
+                    (cand + 1) & 0xFFFFFFFF, seg.nonces - after)
+                swept += hashed
+                if hit is not None:
+                    self._record_hit()
+                    self.segments_discarded += len(self._segments)
+                    self._segments.clear()
+                    return hit, swept
+            planned += self._pump(budget - planned)
+        return None, swept
+
+    def advance(self, nonce_budget: int) -> int:
+        """Continuous-mining poll surface: sweep up to ``nonce_budget``
+        nonces forward from the loop's cursor (rollover-aware, template
+        already installed via set_template), parking confirmed hits in
+        the FIFO for ``take_hits()`` instead of returning the first one —
+        the host polls a buffer, it never blocks on (found, nonce,
+        tiles). A hit does not stop the sweep; the loop moves on to the
+        next segment (at real difficulty a template yields ~one hit, and
+        the driver refreshes the template on pickup, so the skipped
+        segment remainder is dead work either way). Returns the number
+        of new confirmed hits parked."""
+        assert self._header76 is not None, "set_template first"
+        gen = self.generation
+        new_hits = 0
+        planned = self._pump(nonce_budget)
+        while self._segments:
+            seg, found, cand, tiles = self._settle_oldest()
+            self.nonces_swept += min(tiles * self.tile, seg.nonces)
+            if found and seg.gen == gen and self._confirm(cand):
+                self._push_hit(cand)
+                new_hits += 1
+            elif found and seg.gen == gen:
+                # h7 limb tie: the kernel early-exited the segment at the
+                # false positive, but the cursor already moved past the
+                # whole segment at dispatch time — resume the remainder
+                # synchronously (as sweep() does) or a REAL hit in
+                # (cand, seg end) would be silently lost until a full
+                # 2^32 rollover
+                self.false_positives += 1
+                _CANDS_C.labels(result="false_positive").inc()
+                after = ((cand - seg.start) & 0xFFFFFFFF) + 1
+                hit, _ = self._resweep_exact(
+                    (cand + 1) & 0xFFFFFFFF, seg.nonces - after)
+                if hit is not None:
+                    self._push_hit(hit)
+                    new_hits += 1
+            elif found:
+                self.stale_hits += 1
+                _CANDS_C.labels(result="stale").inc()
+            planned += self._pump(nonce_budget - planned)
+        return new_hits
+
+    def _record_hit(self) -> None:
+        self.hits += 1
+        _CANDS_C.labels(result="confirmed").inc()
+
+    def _push_hit(self, nonce: int) -> None:
+        """Park a confirmed hit in the bounded FIFO (oldest dropped on
+        overflow, metered — the host poll cadence bounds staleness)."""
+        if len(self.fifo) == self.fifo.maxlen:
+            self.fifo_dropped += 1
+            _CANDS_C.labels(result="dropped").inc()
+        self.fifo.append({"nonce": int(nonce),
+                          "generation": self.generation})
+        self._record_hit()
+        _FIFO_G.set(len(self.fifo))
+
+    def take_hits(self) -> list:
+        """Drain the confirmed-candidate FIFO (host poll surface)."""
+        out = list(self.fifo)
+        self.fifo.clear()
+        _FIFO_G.set(0)
+        return out
+
+    # -- lifecycle / observability --------------------------------------
+
+    def register_watchdog(self, quiet_s: Optional[float] = None) -> None:
+        """Register the ``miner`` stall-watchdog subsystem: pending work
+        is the in-flight segment count; every settled poll beats."""
+        dw.WATCHDOG.register("miner",
+                             pending_fn=lambda: len(self._segments),
+                             quiet_s=quiet_s)
+        self._watchdog = True
+
+    def close(self) -> None:
+        self._segments.clear()
+        self._mid = self._tail = self._tgt = None
+        if self._watchdog:
+            dw.WATCHDOG.unregister("miner")
+            self._watchdog = False
+
+    def snapshot(self) -> dict:
+        """gettpuinfo's ``mining`` section (resident-loop state)."""
+        return {
+            "resident": True,
+            "kernel": self.kernel,
+            "tile": self.tile,
+            "seg_tiles": self.seg_tiles,
+            "inflight_limit": self.inflight,
+            "inflight": len(self._segments),
+            "template_generation": self.generation,
+            "buffer_swaps": self.buffer_swaps,
+            "tiles_swept": self.tiles_swept,
+            "nonces_swept": self.nonces_swept,
+            "rollover_passes": self.passes,
+            "polls": self.polls,
+            "poll_cadence_s": round(self._poll_ema_s, 6),
+            "fifo_depth": len(self.fifo),
+            "fifo_capacity": self.fifo.maxlen,
+            "fifo_dropped": self.fifo_dropped,
+            "hits": self.hits,
+            "false_positives": self.false_positives,
+            "stale_hits": self.stale_hits,
+            "segments_discarded": self.segments_discarded,
+        }

@@ -61,6 +61,14 @@ Options:
                          ladder + fixed-base G comb (default), w4 = the
                          64-window kernel (kept as oracle/fallback); unknown
                          values are rejected at startup
+  -residentminer=<on|off|force>  Device-resident mining loop: the nonce sweep
+                         runs as a persistent segment pipeline over
+                         long-lived template buffers (refresh = buffer swap,
+                         not a new dispatch). Default: on; off = the
+                         per-dispatch sweep; force = resident even on a
+                         regtest CPU node (test/bench hook — those otherwise
+                         keep the scalar host fast path); unknown values are
+                         rejected at startup
   -sigservice=<on|off>   Run the always-on micro-batching signature service:
                          mempool ingest and tip relay enqueue script checks
                          into shared device lanes behind a flush deadline

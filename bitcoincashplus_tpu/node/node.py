@@ -256,6 +256,21 @@ class Node:
             "pipeline",
             pending_fn=lambda: len(self.chainstate._spec),
             quiet_s=self.watchdog_quiet)
+        # -residentminer=<on|off>: the device-resident mining loop
+        # (mining/resident.ResidentSweep — ISSUE 10). Default ON where a
+        # batched sweep runs at all; regtest CPU nodes keep the scalar
+        # host fast path regardless (see _select_sweep). off = the
+        # per-dispatch sweep shapes of PR <=9.
+        res_mode = config.get("residentminer", "on")
+        if res_mode not in ("on", "off", "1", "0", "force"):
+            raise ConfigError(
+                f"-residentminer={res_mode!r}: must be on, off or force")
+        self.resident_mode = res_mode in ("on", "1", "force")
+        # "force" overrides the regtest-CPU scalar fast path too (test/
+        # bench hook: exercises the resident loop where mining is trivial)
+        self.resident_force = res_mode == "force"
+        self.resident_miner = None
+        self.sweep_engine = "unselected"
         self.sigservice = None
         if svc_mode in ("on", "1"):
             from ..serving import SigService
@@ -336,6 +351,7 @@ class Node:
         telemetry.register_collector("sigcache", self._sigcache_families)
         telemetry.register_collector("pipeline", self._pipeline_families)
         telemetry.register_collector("mempool", self._mempool_families)
+        telemetry.register_collector("mining", self._mining_families)
         if self.sigservice is not None:
             telemetry.register_collector("serving", self._serving_families)
         # P2P adversarial-supervision limits (p2p/connman.py): the
@@ -481,6 +497,20 @@ class Node:
             "bcp_sigservice", scalars, typ="gauge",
             help="serving/sigservice micro-batching state (flush reasons, "
                  "dedup/cache hits, preemptions, config)")
+
+    def _mining_families(self) -> list:
+        # bcp_mining_state_* prefix: the NATIVE bcp_mining_* counter/
+        # histogram families (mining/resident module-level) own their
+        # names — re-emitting fifo_depth/tiles under them would duplicate
+        # a family with a conflicting TYPE (the PR 6 in_flight lesson).
+        # Everything here is a point-in-time projection, so typ="gauge".
+        snap = self.mining_snapshot()
+        scalars = {k: v for k, v in snap.items()
+                   if isinstance(v, (int, float)) and not isinstance(v, bool)}
+        return telemetry.flat_families(
+            "bcp_mining_state", scalars, typ="gauge",
+            help="device-resident mining loop state (template generation, "
+                 "segment pipeline, candidate FIFO, rollover passes)")
 
     def _mempool_families(self) -> list:
         return [
@@ -655,12 +685,16 @@ class Node:
                               versionbits_cache=self.versionbits_cache)
 
     def _select_sweep(self):
-        """Pick the PoW sweep for this backend: the specialized truncated-h7
-        kernel (ops/sha256_sweep) on a real accelerator — bit-identical
-        results via host re-verify, ~2x the generic sweep (ROOFLINE.md) —
-        and the generic looped sweep on CPU, where the unrolled kernel's
-        XLA compile is pathologically slow (ops/sha256._use_unrolled).
-        Either choice runs under miner-breaker supervision
+        """Pick the PoW sweep for this backend. Default: the DEVICE-
+        RESIDENT loop (mining/resident.ResidentSweep, -residentminer=on) —
+        a persistent segment pipeline over long-lived template buffers,
+        h7-truncated kernel on a real accelerator (fewest ops/nonce,
+        candidates host-verified bit-identical) and the exact-compare
+        kernel on CPU backends (where the unrolled h7 program's XLA
+        compile is pathologically slow — ops/sha256._use_unrolled). With
+        -residentminer=off, the PR<=9 per-dispatch shapes: truncated-h7
+        sweep_header_fast on the accelerator, the generic looped sweep on
+        CPU. Every choice runs under miner-breaker supervision
         (ops/dispatch.supervised_sweep): failures degrade to the scalar
         host loop without stalling block production.
 
@@ -671,27 +705,62 @@ class Node:
         at functional-test scale was paying minutes of pure dispatch
         overhead. Real networks keep the batched sweep, where throughput,
         not latency, is what matters."""
-        from ..ops.dispatch import supervised_sweep
+        from ..ops.dispatch import supervised_resident_sweep, supervised_sweep
 
         inner = None
+        engine = "generic-dispatch"
         try:
             from ..ops.sha256 import backend_is_cpu
 
-            if not backend_is_cpu():
-                from ..ops.sha256_sweep import sweep_header_fast
-
-                inner = sweep_header_fast
-            elif self.params.network == "regtest":
+            on_cpu = backend_is_cpu()
+            if (on_cpu and self.params.network == "regtest"
+                    and not self.resident_force):
                 from ..ops.miner import sweep_header_cpu
+
+                engine = "scalar-host"
 
                 def inner(header80, target, start_nonce=0,
                           max_nonces=1 << 32, tile=None):
                     return sweep_header_cpu(header80, target,
                                             start_nonce=start_nonce,
                                             max_nonces=max_nonces)
+            elif self.resident_mode:
+                if self.resident_miner is None:
+                    from ..mining.resident import ResidentSweep
+
+                    kernel = "exact" if on_cpu else "h7"
+                    # CPU backends take a smaller tile: the looped-
+                    # compress kernel executes ~6k vector ops/nonce on
+                    # host ALUs, so a 64Ki tile would make each segment
+                    # settle hundreds of ms
+                    self.resident_miner = ResidentSweep(
+                        tile=(1 << 14) if on_cpu else (1 << 16),
+                        kernel=kernel)
+                    self.resident_miner.register_watchdog(
+                        self.watchdog_quiet)
+                engine = f"resident-{self.resident_miner.kernel}"
+            elif not on_cpu:
+                from ..ops.sha256_sweep import sweep_header_fast
+
+                engine = "h7-dispatch"
+                inner = sweep_header_fast
         except Exception:
             pass
+        self.sweep_engine = engine
+        if engine.startswith("resident-"):
+            return supervised_resident_sweep(self.resident_miner)
         return supervised_sweep(inner)
+
+    def mining_snapshot(self) -> dict:
+        """gettpuinfo's ``mining`` section: the active sweep engine and,
+        when the resident loop is live, its full state (template
+        generation, tiles swept, candidate FIFO, buffer swaps, poll
+        cadence)."""
+        out = {"engine": self.sweep_engine, "resident": False,
+               "resident_enabled": self.resident_mode}
+        if self.resident_miner is not None:
+            out.update(self.resident_miner.snapshot())
+        return out
 
     def generate_to_script(self, script_pubkey: bytes, n_blocks: int,
                            max_tries: int = MAX_TRIES_DEFAULT) -> list[bytes]:
@@ -1687,8 +1756,13 @@ class Node:
         # otherwise keep the closed node's whole object graph (coins
         # cache, mempool, block index) alive in the process-global
         # REGISTRY for the rest of the process
-        for name in ("sigcache", "pipeline", "mempool", "serving"):
+        for name in ("sigcache", "pipeline", "mempool", "serving", "mining"):
             telemetry.REGISTRY.unregister_collector(name)
+        if self.resident_miner is not None:
+            # drops the device template buffers and the miner watchdog
+            # registration (same closure-leak lesson as the collectors)
+            self.resident_miner.close()
+            self.resident_miner = None
         # same lesson for the watchdog: its pending_fn closures must not
         # keep a closed node alive (sigservice.stop() already dropped its
         # own registration above)

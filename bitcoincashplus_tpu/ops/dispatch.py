@@ -482,9 +482,22 @@ def block_merkle_root(block) -> tuple:
                        expected=block.header.hash_merkle_root)
 
 
+def supervised_resident_sweep(resident):
+    """Wrap a mining/resident.ResidentSweep's persistent loop in miner
+    supervision: the resident segment pipeline (device-side buffer swaps,
+    candidate FIFO, nonce rollover) runs as the device path, a claimed
+    hit is host re-verified, and any device failure — including a dead
+    backend mid-pipeline — degrades to the scalar host loop under the
+    same miner circuit breaker as the per-dispatch path. The resident
+    program rides the devicewatch compile sentinel as ``miner_resident``
+    with its own shape budget (a template swap must never retrace)."""
+    return supervised_sweep(inner=resident.sweep)
+
+
 def supervised_sweep(inner=None):
     """Wrap a PoW sweep implementation (ops/miner.sweep_header,
-    ops/sha256_sweep.sweep_header_fast, or the multi-chip shard) in miner
+    ops/sha256_sweep.sweep_header_fast, mining/resident.ResidentSweep.sweep,
+    or the multi-chip shard) in miner
     supervision: a claimed hit is re-verified on host before it is trusted
     (2 hashes — free next to a sweep), and failures degrade to the scalar
     CPU loop, the reference generateBlocks inner loop. Returns a callable

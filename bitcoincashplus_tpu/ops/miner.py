@@ -29,10 +29,10 @@ from ..crypto.hashes import header_midstate
 from .sha256 import (
     bytes_to_words_np,
     digest_to_limbs,
-    header_sweep_digest,
     le256,
     target_to_limbs_np,
 )
+from .sha256_sweep import hoist_template, sweep_digest_hoisted
 
 # Default tile: 64Ki nonces per device loop iteration. Large enough to fill
 # the 8x128 VPU lanes many times over (amortizing loop overhead), small
@@ -40,17 +40,29 @@ from .sha256 import (
 DEFAULT_TILE = 1 << 16
 
 
-def _sweep_tile(midstate8, tail3, target_limbs, base_nonce, tile: int):
+def _sweep_tile(pre, target_limbs, base_nonce, tile: int):
     """Hash one tile of `tile` consecutive nonces; return (hit, nonce).
     `nonce` is the lowest in-tile hit when hit is True (argmax finds the
-    first True lane; nonces are base+iota so lane order == nonce order)."""
+    first True lane; nonces are base+iota so lane order == nonce order).
+    ``pre`` is the per-template chunk-2 hoist (ops/sha256_sweep.
+    hoist_template) — computed once per dispatch (or per template swap in
+    the resident loop), never per nonce."""
     lanes = jax.lax.broadcasted_iota(jnp.uint32, (tile, 1), 0).squeeze(-1)
     nonces = base_nonce + lanes
-    h8 = header_sweep_digest(midstate8, tail3, nonces)
+    h8 = sweep_digest_hoisted(pre, nonces)
     ok = le256(digest_to_limbs(h8), target_limbs)
     hit = jnp.any(ok)
     idx = jnp.argmax(ok)
     return hit, nonces[idx]
+
+
+def _boundary_tiles(start_nonce: int, max_nonces: int, tile: int) -> int:
+    """Tile count for a sweep from ``start_nonce``, clamped against the
+    2^32 nonce-space boundary: a sweep starting near the top must not
+    wrap into (and re-hash / over-count) nonces below the start — the
+    resident loop's rollover owns wrap policy, one full pass at a time."""
+    space = (1 << 32) - (start_nonce & 0xFFFFFFFF)
+    return min((max_nonces + tile - 1) // tile, (space + tile - 1) // tile)
 
 
 @partial(jax.jit, static_argnames=("tile",))
@@ -62,9 +74,11 @@ def sweep_jit(midstate, tail, target_limbs, start_nonce, n_tiles, tile: int = DE
     Returns (found bool, nonce uint32, tiles_done uint32). Nonce arithmetic
     wraps mod 2^32 exactly like the reference's uint32 nNonce.
     """
-    mid8 = [midstate[i] for i in range(8)]
-    tail3 = [tail[i] for i in range(3)]
     tgt = [target_limbs[j] for j in range(8)]
+    # per-template hoist: traced scalars, computed once per dispatch and
+    # lifted out of the while_loop by XLA (loop-invariant)
+    pre = hoist_template([midstate[i] for i in range(8)],
+                         [tail[i] for i in range(3)])
 
     def cond(carry):
         i, found, _ = carry
@@ -73,7 +87,7 @@ def sweep_jit(midstate, tail, target_limbs, start_nonce, n_tiles, tile: int = DE
     def body(carry):
         i, _, _ = carry
         base = start_nonce + i.astype(jnp.uint32) * np.uint32(tile)
-        hit, nonce = _sweep_tile(mid8, tail3, tgt, base, tile)
+        hit, nonce = _sweep_tile(pre, tgt, base, tile)
         return i + np.uint32(1), hit, nonce
 
     i0 = jnp.uint32(0)
@@ -108,7 +122,11 @@ def sweep_header(header80: bytes, target: int, start_nonce: int = 0,
 
     Returns (nonce or None, hashes_attempted). The header's own nonce field is
     ignored; bytes 0..75 define the search. Mirrors generateBlocks' semantics
-    (bounded attempts, first hit wins) at tile granularity.
+    (bounded attempts, first hit wins) at tile granularity. The search is
+    clamped at the 2^32 nonce-space boundary (``_boundary_tiles``): a sweep
+    starting near the top stops there instead of wrapping into — and
+    over-counting / re-hashing — nonces below the start; rollover across
+    the boundary is the resident loop's job (mining/resident.py).
     """
     from ..util import devicewatch as dw
 
@@ -116,7 +134,7 @@ def sweep_header(header80: bytes, target: int, start_nonce: int = 0,
     midstate = np.array(header_midstate(header80), dtype=np.uint32)
     tail = bytes_to_words_np(np.frombuffer(header80[64:76], dtype=np.uint8))
     tgt = target_to_limbs_np(target)
-    n_tiles = min((max_nonces + tile - 1) // tile, (1 << 32) // tile)
+    n_tiles = _boundary_tiles(start_nonce, max_nonces, tile)
     # watched dispatch: the compiled shape is the (tile,) specialization —
     # a node mints at most a couple (DEFAULT_TILE + the regtest/CPU tile),
     # so a sweep that starts recompiling per call trips the sentinel
@@ -138,7 +156,10 @@ def sweep_header(header80: bytes, target: int, start_nonce: int = 0,
         jax.block_until_ready(tiles)
     dw.note_phase("miner", "execute", time.perf_counter() - t0)
     t0 = time.perf_counter()
-    hashes = int(tiles) * tile
+    # attempted-hash accounting is also boundary-clamped: the final tile
+    # may straddle 2^32, but nonces past the boundary were never part of
+    # this sweep's contract
+    hashes = min(int(tiles) * tile, (1 << 32) - (start_nonce & 0xFFFFFFFF))
     hit = bool(found)
     dw.note_transfer("miner", "d2h", 12,
                      seconds=time.perf_counter() - t0)

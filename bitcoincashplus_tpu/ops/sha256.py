@@ -219,6 +219,11 @@ def header_sweep_digest(midstate8: list, tail3: list, nonces):
     Returns 8 digest state words, each shaped like `nonces`. Cost: 2
     compressions per nonce (vs 3 without midstate) — the optimization the
     scalar reference loop (src/rpc/mining.cpp:~120) misses.
+
+    This is the UNHOISTED reference form: the production sweep tile
+    (ops/miner._sweep_tile) rides ops/sha256_sweep.sweep_digest_hoisted,
+    which additionally hoists the chunk-2 sweep-constant rounds/schedule
+    legs per template (ROOFLINE.md §8); tests differential the two.
     """
     zero = nonces * _ZERO
     w = (

@@ -22,6 +22,7 @@ from jax.sharding import PartitionSpec as P
 from ..crypto.hashes import header_midstate
 from ..ops.miner import DEFAULT_TILE, _sweep_tile
 from ..ops.sha256 import bytes_to_words_np, target_to_limbs_np
+from ..ops.sha256_sweep import hoist_template
 from .mesh import CHIP_AXIS, chip_mesh, local_devices, shard_map_nocheck
 
 
@@ -39,9 +40,11 @@ def _shard_body(midstate, tail, target_limbs, start_nonce, n_tiles, tile: int):
         n_chips = jax.lax.psum(jnp.uint32(1), CHIP_AXIS)
     stripe = start_nonce + chip * n_tiles * np.uint32(tile)
 
-    mid8 = [midstate[i] for i in range(8)]
-    tail3 = [tail[i] for i in range(3)]
     tgt = [target_limbs[j] for j in range(8)]
+    # per-template chunk-2 hoist, once per dispatch (shared across every
+    # tile of this chip's stripe — the same pre the single-chip sweep uses)
+    pre = hoist_template([midstate[i] for i in range(8)],
+                         [tail[i] for i in range(3)])
 
     def cond(carry):
         i, found, _ = carry
@@ -50,7 +53,7 @@ def _shard_body(midstate, tail, target_limbs, start_nonce, n_tiles, tile: int):
     def body(carry):
         i, _, _ = carry
         base = stripe + i * np.uint32(tile)
-        hit, nonce = _sweep_tile(mid8, tail3, tgt, base, tile)
+        hit, nonce = _sweep_tile(pre, tgt, base, tile)
         return i + jnp.uint32(1), hit, nonce
 
     # Initial carry must be device-varying (derived from `stripe`, which

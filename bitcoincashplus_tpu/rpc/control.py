@@ -97,7 +97,10 @@ def gettpuinfo(node, params):
     state, trip counts, fallback call/item tallies — fallback_items is sigs
     for ecdsa, hashes for sha256, leaves for merkle), the active
     fault-injection config (BCP_FAULT_*), sigcache hit/insert/eviction
-    rates, ConnectBlock phase timings (-debug=bench counters), the
+    rates, the device-resident mining loop (``mining``: active sweep
+    engine, template generation, tiles swept, candidate FIFO depth/hits,
+    buffer-swap count, poll cadence — mining/resident.py),
+    ConnectBlock phase timings (-debug=bench counters), the
     pipelined-IBD settle horizon (``pipeline``: depth/occupancy, per-leg
     times, unwind count, cross-block lane fill and overlap fraction, and
     the speculation tree's live shape under ``pipeline.tree`` — branches,
@@ -134,6 +137,11 @@ def gettpuinfo(node, params):
         "breakers": dispatch.snapshot(),
         "faults": faults.INJECTOR.snapshot(),
         "sigcache": node.sigcache.snapshot(),
+        # the device-resident mining loop (mining/resident): sweep engine
+        # selection + resident-loop state; getattr-guarded for harness
+        # stubs that pass a bare node namespace
+        "mining": (node.mining_snapshot()
+                   if hasattr(node, "mining_snapshot") else {}),
         "connectblock": dict(node.chainstate.bench),
         # getattr-guarded: harness stubs pass a bare chainstate namespace
         "pipeline": (node.chainstate.pipeline_snapshot()

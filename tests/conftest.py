@@ -62,12 +62,17 @@ def pytest_collection_modifyitems(config, items):
     def group(item) -> int:
         # the ``devprof`` suite (device-lane observability — the same
         # registry-zeroing isolation pattern as telemetry) runs after
-        # ``telemetry`` and before ``serving``; the ``forkstorm``
-        # multi-node campaigns run DEAD LAST, after even the adversarial
-        # chaos suites — they are the newest, heaviest coverage and the
-        # first thing a CI timeout should cut
+        # ``telemetry`` and before ``serving``; the ``mining`` suite
+        # (resident loop + hoist differentials — ISSUE 10) runs after
+        # ``devprof`` (it asserts on devicewatch program state) and
+        # before ``serving``; the ``forkstorm`` multi-node campaigns run
+        # DEAD LAST, after even the adversarial chaos suites — they are
+        # the newest, heaviest coverage and the first thing a CI timeout
+        # should cut
         if "functional" not in str(item.fspath):
             if item.get_closest_marker("serving"):
+                return 5
+            if item.get_closest_marker("mining"):
                 return 4
             if item.get_closest_marker("devprof"):
                 return 3
@@ -75,8 +80,8 @@ def pytest_collection_modifyitems(config, items):
                 return 2
             return 1 if item.get_closest_marker("pipeline") else 0
         if item.get_closest_marker("forkstorm"):
-            return 7
-        return 6 if item.get_closest_marker("adversarial") else 5
+            return 8
+        return 7 if item.get_closest_marker("adversarial") else 6
 
     items.sort(key=group)
 

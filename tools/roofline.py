@@ -33,9 +33,14 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."
 # tool run, so pin the backend BEFORE jax imports. BCP_SECP_PARALLEL=1
 # traces the parallel field forms — the ops the device VPU executes —
 # rather than the CPU backend's compile-friendly scan forms.
+# --mining: sweep-kernel census (generic vs chunk-2-hoisted, ISSUE 10)
+# plus the live compiled-flops drift check of the resident miner program;
+# CPU-pinned the same way.
 ECDSA_MODE = "--ecdsa" in sys.argv
-if ECDSA_MODE:
+MINING_MODE = "--mining" in sys.argv
+if ECDSA_MODE or MINING_MODE:
     os.environ["JAX_PLATFORMS"] = "cpu"
+if ECDSA_MODE:
     os.environ["BCP_SECP_PARALLEL"] = "1"
 
 import jax
@@ -74,9 +79,17 @@ def census(f, *args, tile=1024):
 
 
 def run_census():
+    from bitcoincashplus_tpu.ops.sha256_sweep import (
+        hoist_template,
+        sweep_digest_hoisted,
+    )
+
     nonces = jnp.zeros((1024,), jnp.uint32)
+    # sweep_h7 routes through hoist_template since ISSUE 10 — this IS the
+    # post-hoist h7 count (pre-hoist was 5923; see ROOFLINE.md §8)
     spec = census(lambda n: sweep_h7(MID, TAIL, n), nonces)
 
+    unroll_save = os.environ.get("BCP_SHA_UNROLL")
     os.environ["BCP_SHA_UNROLL"] = "1"
 
     def generic(n):
@@ -85,8 +98,17 @@ def run_census():
         )
         return gen.le256(gen.digest_to_limbs(h8), [np.uint32(0)] * 8)
 
+    def hoisted_full(n):
+        h8 = sweep_digest_hoisted(hoist_template(MID, TAIL), n)
+        return gen.le256(gen.digest_to_limbs(h8), [np.uint32(0)] * 8)
+
     full = census(generic, nonces)
-    return sum(spec.values()), sum(full.values()), spec
+    hoisted = census(hoisted_full, nonces)
+    if unroll_save is None:
+        os.environ.pop("BCP_SHA_UNROLL", None)
+    else:
+        os.environ["BCP_SHA_UNROLL"] = unroll_save
+    return sum(spec.values()), sum(full.values()), sum(hoisted.values()), spec
 
 
 # ---- 2. sustained-op probe --------------------------------------------------
@@ -319,7 +341,16 @@ DRIFT_BUDGET = 0.10
 # run lowers differently and reports without flagging until a baseline
 # for that arrangement is recorded here.
 COST_BASELINES = {
-    "cpu": {"ecdsa_glv": 2_370_312.0, "ecdsa_w4_bytes": 1_618_602.0},
+    "cpu": {"ecdsa_glv": 2_370_312.0, "ecdsa_w4_bytes": 1_618_602.0,
+            # miner_resident compiled flops/nonce at tile 1024 (exact =
+            # looped-compress lowering — the form a CPU backend compiles;
+            # h7 = the fully-unrolled trace, which XLA's whole-program
+            # flop accounting weighs differently — hence per-kernel
+            # baselines), recorded when the §8 post-hoist census was
+            # validated (jax 0.4.37) — the census's compiled twin for
+            # the mining drift check
+            "miner_resident_exact": 6_244.4,
+            "miner_resident_h7": 11_791.4},
 }
 
 
@@ -402,14 +433,120 @@ def run_ecdsa_live_drift(parts, bucket: int = 1024):
     return out
 
 
+# ---- mining sweep census + live drift (--mining) ----------------------------
+#
+# The ISSUE 10 twin of the ECDSA section: the chunk-2 hoist's ops/nonce
+# claim (ROOFLINE.md §8) as a re-runnable census, plus the compiled-flops
+# drift check of the resident miner program. One real dispatch per kernel
+# goes through the SAME devicewatch program a running node populates
+# ("miner_resident", sig = (kernel, tile)); cost_analysis at first
+# compile is compared per kernel against its recorded baseline — the
+# units (census primitive counts vs whole-program element flops, body of
+# the while_loop counted once) are not cross-comparable, so drift is
+# per kernel against its OWN compiled twin, flagged at > 10%.
+
+PRE_HOIST_H7 = 5923      # ops/nonce before the chunk-2 hoist (§2)
+PRE_HOIST_FULL = 7041    # generic full-digest sweep, unhoisted (§2)
+
+
+def run_mining_census():
+    spec_ops, full_ops, hoisted_full_ops, _detail = run_census()
+    print("nonce-sweep kernels — vector ops per nonce (jaxpr census)")
+    print(f"{'kernel':<42}{'ops/nonce':>12}")
+    print(f"{'generic full-digest (unhoisted)':<42}{full_ops:>12,}")
+    print(f"{'full-digest + chunk-2 hoist (resident exact)':<42}"
+          f"{hoisted_full_ops:>12,}")
+    print(f"{'truncated-h7, pre-hoist (r10 baseline)':<42}"
+          f"{PRE_HOIST_H7:>12,}")
+    print(f"{'truncated-h7 + chunk-2 hoist':<42}{spec_ops:>12,}")
+    red = 1.0 - spec_ops / PRE_HOIST_H7
+    print(f"chunk-2 hoist reduction vs pre-hoist h7: {red * 100:.2f}% "
+          f"({'below' if spec_ops < PRE_HOIST_H7 else 'NOT below'} "
+          f"the 5923 baseline)")
+    return {"h7_hoisted": spec_ops, "full_generic": full_ops,
+            "full_hoisted": hoisted_full_ops,
+            "h7_pre_hoist": PRE_HOIST_H7}
+
+
+def run_mining_live_drift(census_d, tile: int = 1024):
+    os.environ["BCP_DEVICEWATCH_COST"] = "always"
+    import tempfile
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(tempfile.gettempdir(), "bcp-jax-test-cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+
+    from bitcoincashplus_tpu.mining.resident import (
+        PROGRAM,
+        SHAPE_BUDGET,
+        ResidentSweep,
+    )
+    from bitcoincashplus_tpu.ops.sha256 import backend_is_cpu
+    from bitcoincashplus_tpu.util import devicewatch as dwatch
+
+    print(f"\nlive cost-analysis drift check (tile {tile}, one real "
+          "segment dispatch per kernel through the devicewatch "
+          f"{PROGRAM!r} program, shape budget {SHAPE_BUDGET})...")
+    header = HEADER
+    target = 0  # impossible: the segment runs its full tile
+    live = {}
+    for kernel in ("exact", "h7"):
+        rs = ResidentSweep(tile=tile, seg_tiles=1, inflight=1,
+                           kernel=kernel)
+        rs.sweep(header, target, max_nonces=tile)
+        rs.close()
+        snap = dwatch.program(PROGRAM).snapshot()
+        cost = snap["cost"].get(str((kernel, tile)))
+        if not cost:
+            print("live drift check: cost_analysis unavailable on this "
+                  "backend — skipped")
+            return None
+        live[f"miner_resident_{kernel}"] = cost["flops"] / tile
+    arrangement = "cpu" if backend_is_cpu() else "mosaic"
+    baselines = COST_BASELINES.get(arrangement, {})
+    print(f"{'kernel':<28}{'census ops/nonce':>18}{'flops/nonce':>16}")
+    print(f"{'exact (full digest)':<28}{census_d['full_hoisted']:>18,}"
+          f"{live['miner_resident_exact']:>16,.1f}")
+    print(f"{'h7 (truncated)':<28}{census_d['h7_hoisted']:>18,}"
+          f"{live['miner_resident_h7']:>16,.1f}")
+    out = {"live": live, "ok": True}
+    for name, val in live.items():
+        base = baselines.get(name)
+        if base is None:
+            print(f"{name}: live {val:,.1f} flops/nonce — no baseline "
+                  f"recorded for the {arrangement!r} arrangement "
+                  "(record one in COST_BASELINES to arm the drift flag)")
+            out["ok"] = None
+            continue
+        drift = abs(val - base) / base
+        flagged = drift > DRIFT_BUDGET
+        out[name] = {"baseline": base, "live": val, "drift": drift}
+        if out["ok"] is not None:
+            out["ok"] = out["ok"] and not flagged
+        verdict = ("DRIFT EXCEEDS BUDGET — a kernel/compiler change "
+                   "moved the real op mix; re-derive the §8 census AND "
+                   "this baseline") if flagged else "within budget"
+        print(f"{name}: live {val:,.1f} vs baseline {base:,.1f} "
+              f"flops/nonce — drift {drift * 100:.1f}% "
+              f"(budget {DRIFT_BUDGET * 100:.0f}%) — {verdict}")
+    return out
+
+
 def main():
     if ECDSA_MODE:
         parts = run_ecdsa_census()
         run_ecdsa_live_drift(parts)
         return
-    spec_ops, full_ops, spec_detail = run_census()
-    print(f"census: specialized h7 sweep = {spec_ops} vector ops/nonce")
-    print(f"census: generic full-digest  = {full_ops} vector ops/nonce")
+    if MINING_MODE:
+        census_d = run_mining_census()
+        run_mining_live_drift(census_d)
+        return
+    spec_ops, full_ops, hoisted_full_ops, spec_detail = run_census()
+    print(f"census: specialized h7 sweep = {spec_ops} vector ops/nonce "
+          f"(chunk-2 hoisted; pre-hoist {PRE_HOIST_H7})")
+    print(f"census: generic full-digest  = {full_ops} vector ops/nonce "
+          f"(hoisted full-digest: {hoisted_full_ops})")
     print(f"census detail: {spec_detail}")
 
     on_tpu = jax.default_backend() != "cpu"
