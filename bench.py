@@ -1342,16 +1342,22 @@ def bench_serving():
 
 
 def bench_dispatch_breakdown():
-    """ISSUE 8 tentpole metric: per-phase (pack / transfer / execute /
-    fetch) decomposition of one device dispatch, for the ecdsa verify
-    path and the nonce-sweep path — the measurement behind BENCH_r05's
-    "mining loses ~15x to host dispatch" claim, now a per-phase number
-    that tells the device-resident-mining and multi-chip PRs exactly
-    which leg to attack. Phases are isolated with explicit staging
-    (jax.device_put + block_until_ready) so transfer is not hidden
-    inside the async dispatch; `execute` runs on device-resident inputs.
-    Writes BENCH_r08.json (schema v2: stamped with the host fingerprint
-    — a CPU-sandbox breakdown and a real-chip one are different series)."""
+    """ISSUE 8 tentpole metric, re-run for ISSUE 11: per-phase (pack /
+    transfer / execute / fetch) decomposition of one device dispatch,
+    for the ecdsa verify path and the nonce-sweep path. Phases are
+    isolated with explicit staging (jax.device_put + block_until_ready)
+    so transfer is not hidden inside the async dispatch; `execute` runs
+    on device-resident inputs.
+
+    Since ISSUE 11 the ecdsa leg rides the device-decompose GLV program:
+    the host pack is numpy byte emission only, and the result records a
+    per-stage PACK SPLIT (decompose vs emit, for both the shipped device
+    path and the retained host-decompose fallback) plus a verdict-parity
+    check against the host-decompose oracle program and the CPU engine.
+    The acceptance bar host_share < 0.15 at bucket 2048 is ASSERTED.
+    Writes BENCH_r11.json (schema v2, host-fingerprint stamped — a
+    CPU-sandbox breakdown and a real-chip one are different series;
+    BENCH_r08.json keeps the pre-decompose-kernel record)."""
     import tempfile
 
     from bitcoincashplus_tpu.ops import ecdsa_batch
@@ -1360,11 +1366,12 @@ def bench_dispatch_breakdown():
 
     # the GLV/w4 programs are minutes of XLA compile on a cold CPU
     # backend — share the persistent compilation cache the test suite
-    # and the kernel-dimension subprocesses already use
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(tempfile.gettempdir(), "bcp-jax-test-cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    # and the kernel-dimension subprocesses already use (routed through
+    # the -compilecache plumbing so hits land in the r11 record)
+    dwatch.enable_compile_cache(
+        os.environ.get("BCP_COMPILE_CACHE",
+                       os.path.join(tempfile.gettempdir(),
+                                    "bcp-jax-test-cache")))
 
     n = int(os.environ.get("BCP_BENCH_BREAKDOWN_SIGS", "2046"))
     repeats = int(os.environ.get("BCP_BENCH_BREAKDOWN_REPEATS", "3"))
@@ -1412,10 +1419,25 @@ def bench_dispatch_breakdown():
     bucket = max(1024, ecdsa_batch._bucket_for(wire_n, pallas=True))
     use_glv = (ecdsa_batch.active_kernel() == "glv"
                and ecdsa_batch.glv_enabled())
+    use_glv_dev = use_glv and ecdsa_batch.glv_dev_enabled()
+
+    # Corpus generation happens OUTSIDE the timed pack phase: r08's
+    # "pack 3.37 s" was in fact ~3.2 s of the HARNESS's own Python
+    # point_mul keygen + ~0.15 s of actual pack — the node's dispatch
+    # path receives records from the interpreter/deferral layer and
+    # never pays keygen, so timing it as "pack" overstated host_share.
+    # Fresh corpus per repeat keeps the memoization caveat honest
+    # (repeats + 1: one extra for the warm/compile call below).
+    corpora = [_make_sig_records(rng, 64, n)
+               + list(ecdsa_batch._kat_records())
+               for _ in range(repeats + 1)]
 
     def ecdsa_args():
-        records = _make_sig_records(rng, 64, n) \
-            + list(ecdsa_batch._kat_records())
+        records = corpora.pop()
+        if use_glv_dev:
+            # ISSUE 11 production path: byte emission only — the lattice
+            # split runs inside the fused device program
+            return ecdsa_batch.pack_records_w4_bytes(records, bucket)
         if use_glv:
             return ecdsa_batch.pack_records_glv(records, bucket)
         return ecdsa_batch.pack_records_w4_bytes(records, bucket)
@@ -1423,6 +1445,8 @@ def bench_dispatch_breakdown():
     interp = ecdsa_batch._interpret_kernels()
 
     def ecdsa_exec(dev_args):
+        if use_glv_dev:
+            return dev._glv_dev_program(*dev_args)
         if use_glv:
             return dev._glv_program(*dev_args)
         return dev._w4_bytes_program(*dev_args, interpret=interp)
@@ -1438,14 +1462,72 @@ def bench_dispatch_breakdown():
     ecdsa_phases = run_phases(
         ecdsa_args, jax.device_put, ecdsa_exec,
         lambda out: [np.asarray(out)])
-    ecdsa_phases["kernel"] = "glv" if use_glv else (
-        "w4-bytes-interpret" if interp else "w4-bytes")
+    ecdsa_phases["kernel"] = "glv-device-decompose" if use_glv_dev else (
+        "glv" if use_glv else
+        ("w4-bytes-interpret" if interp else "w4-bytes"))
     ecdsa_phases["lanes"] = n
     ecdsa_phases["bucket"] = bucket
     ecdsa_phases["sigs_per_s_end_to_end"] = round(
         n / max(ecdsa_phases["total"], 1e-9))
     ecdsa_phases["sigs_per_s_device_resident"] = round(
         n / max(ecdsa_phases["execute"], 1e-9))
+
+    # per-stage pack split (ISSUE 11 satellite): decompose vs emit, for
+    # the shipped device-decompose path AND the retained host fallback —
+    # the before/after of moving the lattice split on-device
+    if use_glv:
+        records = _make_sig_records(rng, 64, n) \
+            + list(ecdsa_batch._kat_records())
+        st = ecdsa_batch.STATS
+        t0 = time.perf_counter()
+        emit_args = ecdsa_batch.pack_records_w4_bytes(records, bucket)
+        emit_s = time.perf_counter() - t0
+        d0, p0 = st.glv_decompose_s, st.glv_pack_s
+        t0 = time.perf_counter()
+        host_args = ecdsa_batch.pack_records_glv(records, bucket)
+        host_total = time.perf_counter() - t0
+        # the pre-r11 per-record Python-bigint loop, replicated inline —
+        # the honest "before" of the decompose leg (it no longer exists
+        # on any path)
+        u1b, u2b, _ok = ecdsa_batch._scalar_bitplanes(
+            records, len(records))
+        t0 = time.perf_counter()
+        for i in range(len(records)):
+            a1, _n1, a2, _n2 = dev.glv_decompose(
+                int.from_bytes(u1b[i].tobytes(), "big"))
+            b1, _n3, b2, _n4 = dev.glv_decompose(
+                int.from_bytes(u2b[i].tobytes(), "big"))
+            a1.to_bytes(16, "little"), a2.to_bytes(16, "little")
+            b1.to_bytes(16, "big"), b2.to_bytes(16, "big")
+        legacy_s = time.perf_counter() - t0
+        ecdsa_phases["pack_split"] = {
+            "device_decompose_path": {
+                "decompose": 0.0, "emit": round(emit_s, 6),
+            },
+            "host_fallback_path": {
+                "decompose": round(st.glv_decompose_s - d0, 6),
+                "emit": round(st.glv_pack_s - p0, 6),
+                "total": round(host_total, 6),
+            },
+            "legacy_per_record_bigint_loop": round(legacy_s, 6),
+        }
+        # verdict parity: the device-decompose program vs the
+        # host-decompose oracle program vs the CPU engine, same lanes
+        if use_glv_dev:
+            out_dev = np.asarray(ecdsa_exec(jax.device_put(
+                ecdsa_batch.pack_records_w4_bytes(records, bucket))))
+            out_host = np.asarray(dev._glv_program(*host_args))
+            cpu = ecdsa_batch._verify_cpu(records)
+            real = slice(0, len(records))
+            dev_ok = out_dev[0].reshape(-1)[real].astype(bool)
+            host_ok = out_host[0].reshape(-1)[real].astype(bool)
+            parity = (dev_ok.tolist() == host_ok.tolist()
+                      == np.asarray(cpu, bool).tolist())
+            ecdsa_phases["verdict_parity_vs_host_decompose"] = bool(parity)
+            assert parity, "device-decompose verdicts diverged"
+    if use_glv_dev and bucket == 2048:
+        # the ISSUE 11 acceptance bar, enforced where the bench runs
+        assert ecdsa_phases["host_share"] < 0.15, ecdsa_phases
 
     # --- sweep leg: the mining nonce dispatch --------------------------
     from bitcoincashplus_tpu.crypto.hashes import header_midstate
@@ -1484,29 +1566,55 @@ def bench_dispatch_breakdown():
     sweep_phases["mhs_device_resident"] = round(
         tile * n_tiles / max(sweep_phases["execute"], 1e-9) / 1e6, 3)
 
+    # serving re-measure (ISSUE 11 satellite): the closed-loop
+    # `concurrent` level lost to sync in BENCH_r07 (0.48x) largely on
+    # per-lane submit cost — re-measured now that the GLV host pack is
+    # byte emission only. Recorded here (BENCH_r07.json keeps the
+    # original trajectory entry).
+    serving_recheck = None
+    if os.environ.get("BCP_BENCH_SKIP_SERVING") != "1":
+        try:
+            out_levels, _sat = _bench_serving_levels()
+            serving_recheck = {
+                "levels": out_levels,
+                "concurrent_speedup": out_levels["concurrent"]["speedup"],
+                "baseline_r07_concurrent_speedup": 0.48,
+            }
+        except Exception as e:  # pragma: no cover - diagnostics only
+            serving_recheck = {"error": f"{type(e).__name__}: {e}"}
+
     result = {
         "metric": "dispatch_breakdown",
         **_bench_stamp(),
         "repeats": repeats,
         "ecdsa": ecdsa_phases,
         "sweep": sweep_phases,
+        "serving_recheck": serving_recheck,
         "device_watch": {
             name: {k: snap[k] for k in
                    ("dispatches", "compiles", "compile_seconds", "shapes",
                     "shape_budget", "retraces_unexpected")}
             for name, snap in dwatch.snapshot()["programs"].items()
         },
-        "note": "median-of-N per phase; pack = host SoA/byte-matrix "
-                "emit (incl. GLV lattice decompose), transfer = explicit "
-                "device_put staging, execute = program on device-resident "
-                "inputs, fetch = host materialization of the result. "
-                "host_share/dispatch_overhead_factor quantify the "
-                "BENCH_r05 'lost to host dispatch' claim per path; on a "
-                "CPU backend the transfer legs are memcpy-scale lower "
-                "bounds, not PCIe/tunnel numbers",
+        "compilation_cache": dwatch.compile_cache_snapshot(),
+        "note": "median-of-N per phase; pack = host byte-matrix emit "
+                "(the GLV lattice decompose rides the DEVICE program "
+                "since ISSUE 11 — pack_split records the before/after), "
+                "transfer = explicit device_put staging, execute = "
+                "program on device-resident inputs, fetch = host "
+                "materialization of the result. MEASUREMENT CORRECTION "
+                "vs BENCH_r08: r08's pack leg timed the harness's own "
+                "corpus generation (~3.2 s of Python point_mul keygen) "
+                "inside 'pack', overstating host_share — the node's "
+                "dispatch path never pays keygen. r11 times the pack "
+                "alone; the honest before/after of the real pack is in "
+                "pack_split (host_fallback_path vs "
+                "device_decompose_path). On a CPU backend the transfer "
+                "legs are memcpy-scale lower bounds, not PCIe/tunnel "
+                "numbers",
     }
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "BENCH_r08.json"), "w") as f:
+                           "BENCH_r11.json"), "w") as f:
         json.dump(result, f, indent=1)
         f.write("\n")
     emit("dispatch_breakdown",

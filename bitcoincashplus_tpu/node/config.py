@@ -61,6 +61,12 @@ Options:
                          ladder + fixed-base G comb (default), w4 = the
                          64-window kernel (kept as oracle/fallback); unknown
                          values are rejected at startup
+  -compilecache=<dir>    Persistent XLA compilation cache directory (default:
+                         off). First compile of each kernel shape writes the
+                         cache; every later process start reads it instead of
+                         re-paying the ~90 s cold GLV compile. Seeds
+                         BCP_COMPILE_CACHE for child processes; cache hits
+                         surface in gettpuinfo.device.compilation_cache
   -residentminer=<on|off|force>  Device-resident mining loop: the nonce sweep
                          runs as a persistent segment pipeline over
                          long-lived template buffers (refresh = buffer swap,

@@ -209,6 +209,22 @@ class Node:
                 raise ConfigError(str(e)) from None
         else:
             self.ecdsa_kernel = _eb.active_kernel()
+        # -compilecache=<dir>: persistent XLA compilation cache (default
+        # OFF). The GLV verify programs are ~90 s of cold XLA compile on
+        # a CPU backend (BENCH_r08) — with the cache on, every restart,
+        # bench subprocess and kernel-pinned import after the first pays
+        # a disk read instead. Seeds BCP_COMPILE_CACHE so child processes
+        # inherit it; cache hits surface in gettpuinfo.device.
+        self.compile_cache = config.get(
+            "compilecache", os.environ.get("BCP_COMPILE_CACHE", ""))
+        if self.compile_cache:
+            from ..util import devicewatch as _dwcc
+
+            try:
+                _dwcc.enable_compile_cache(self.compile_cache)
+            except (OSError, ValueError) as e:
+                raise ConfigError(
+                    f"-compilecache={self.compile_cache}: {e}") from None
         # -cashdaa / -daaheight=<n>: enable the BCH-lineage difficulty
         # rules (EDA from activation, cw-144 DAA from daaheight) on this
         # chain — the fork-storm harness crosses the EDA->DAA boundary

@@ -8,13 +8,17 @@ verified in ONE device dispatch via ops/secp256k1.ecdsa_verify_batch_jit
 (SURVEY.md §3.2 P1, §8.4 "ECDSA batch").
 
 Pipeline per batch:
-  1. host: w = s⁻¹ mod n, u1 = e·w, u2 = r·w  (Python ints, µs per sig);
-     the GLV kernel additionally lattice-splits each scalar
-     (k = k1 + λ·k2, |k1|,|k2| < 2^128 — pack_records_glv)
-  2. pack: u1/u2 → (256, B) MSB-first bit planes (ladder kernels) or
-     split-scalar byte matrices + sign flags (GLV); qx/qy/r/rn → (20, B)
-     13-bit limbs; wrap_ok = (r + n < p) per lane (the kernel gates the
-     x-wraparound candidate on it — see ecdsa_verify_batch_device)
+  1. host: w = s⁻¹ mod n, u1 = e·w, u2 = r·w  (native C++/Python ints,
+     µs per sig). The GLV lattice split (k = k1 + λ·k2, |k1|,|k2| <
+     2^128) rides the DEVICE program since ISSUE 11 (_glv_dev_program —
+     raw scalar bytes in, exact in-kernel rounding); the host split
+     (pack_records_glv, numpy limb batches) is the retained fallback
+  2. pack: u1/u2 → (256, B) MSB-first bit planes (ladder kernels), raw
+     (B, 32) byte matrices (w4 bytes AND device-decompose GLV), or
+     split-scalar byte matrices + sign flags (host-decompose GLV);
+     qx/qy/r/rn → (20, B) 13-bit limbs or bytes; wrap_ok = (r + n < p)
+     per lane (the kernel gates the x-wraparound candidate on it — see
+     ecdsa_verify_batch_device)
   3. pad B up to a bucket size (bounds XLA recompiles to len(BUCKETS))
   4. one jit dispatch; padded lanes are poisoned (q_inf) and ignored
   5. device returns a (B,) validity mask; caller attributes failures
@@ -109,6 +113,10 @@ CPU_FLOOR = 8
 # call, so no extra shapes); the plane/ladder programs pad to BUCKETS.
 PALLAS_SHAPE_BUDGET = 9
 _PW_GLV = dw.program("ecdsa_glv", shape_budget=PALLAS_SHAPE_BUDGET)
+# the fused decompose+verify program (ISSUE 11): same bucket ladder as
+# the other byte pipelines, so the same 9-shape budget applies
+_PW_GLV_DEV = dw.program("ecdsa_glv_decompose",
+                         shape_budget=PALLAS_SHAPE_BUDGET)
 _PW_W4_BYTES = dw.program("ecdsa_w4_bytes", shape_budget=PALLAS_SHAPE_BUDGET)
 _PW_W4 = dw.program("ecdsa_w4", shape_budget=len(BUCKETS))
 _PW_XLA = dw.program("ecdsa_xla", shape_budget=len(BUCKETS))
@@ -152,6 +160,12 @@ ECDSA_KERNELS = ("glv", "w4")
 # fail-* modes prove the glv -> w4 dispatch fallback; poison-output proves
 # the KAT gate catches a lying GLV mask and settles on the CPU engine.
 GLV_SITE = "ecdsa_glv"
+# Device-decompose leg of the GLV path (ISSUE 11), likewise explicit-only:
+# fail-* proves the device-decompose -> host-decompose fallback (the
+# degradation ladder's first rung); poison-output proves the KAT gate.
+# GLV_SITE stays armed across the WHOLE GLV family (both legs consult
+# it), so the pre-existing glv -> w4 drills keep their meaning.
+GLV_DEV_SITE = "ecdsa_glv_dev"
 _KERNEL = None  # set_kernel() override; None = BCP_ECDSA_KERNEL or "glv"
 _BAD_ENV_WARNED = False
 
@@ -190,7 +204,11 @@ def set_kernel(name: str) -> str:
 
 def kernel_info() -> dict:
     """gettpuinfo's ``ecdsa`` section: the active kernel, GLV health, the
-    one-time fixed-base-table build cost, and the host pack-stage split."""
+    one-time fixed-base-table build cost, and the pack-stage split —
+    decompose (host lattice split; ~0 while the device-decompose leg is
+    healthy), emit (numpy byte emission) and dispatch (host-side program
+    enqueue) reported SEPARATELY since ISSUE 11 (decompose_s/pack_s keep
+    their PR-8 meanings, so the section stays a key-for-key superset)."""
     from . import secp256k1 as dev_mod
 
     return {
@@ -202,6 +220,14 @@ def kernel_info() -> dict:
         "table_build_s": round(dev_mod.GLV_TABLE_BUILD_S, 4),
         "decompose_s": round(STATS.glv_decompose_s, 4),
         "pack_s": round(STATS.glv_pack_s, 4),
+        "emit_s": round(STATS.glv_emit_s, 4),
+        "dispatch_s": round(STATS.glv_dispatch_s, 4),
+        "dev_decompose": {
+            "enabled": glv_dev_enabled(),
+            "broken": _GLV_DEV_BROKEN,
+            "dispatches": STATS.glv_dev_dispatches,
+            "fallbacks": STATS.glv_dev_fallbacks,
+        },
     }
 
 
@@ -236,6 +262,16 @@ class BatchStats:
     glv_fallbacks: int = 0
     glv_decompose_s: float = 0.0
     glv_pack_s: float = 0.0
+    # device-decompose leg (ISSUE 11): dispatches that ran the fused
+    # decompose+verify program, failures that degraded to the host
+    # lattice split, and the decompose/emit/dispatch stage separation
+    # (decompose_s above stays HOST decompose only — ~0 when the device
+    # leg is healthy; emit_s is the numpy byte emission across BOTH GLV
+    # legs; dispatch_s is the host-side enqueue of the glv programs)
+    glv_dev_dispatches: int = 0
+    glv_dev_fallbacks: int = 0
+    glv_emit_s: float = 0.0
+    glv_dispatch_s: float = 0.0
     # supervised-dispatch accounting (ops/dispatch breaker layer): sigs
     # re-verified on the CPU engine because the device path failed or its
     # known-answer lanes came back wrong. NOTE sigs_padded includes the 2
@@ -441,19 +477,28 @@ def pack_records_w4_bytes(records: Sequence, bucket: int):
     return u1m, u2m, qxb, qyb, q_inf, r0b, rnb, wrap8
 
 
-def _glv_pack_parts(u1_bytes, u2_bytes, qx_bytes, qy_ints, r_bytes,
+def _glv_pack_parts(u1_bytes, u2_bytes, qx_bytes, qy_bytes, r_bytes,
                     rn_bytes, wraps, range_bad, bucket: int):
-    """Shared GLV pack: lattice-decompose the (u1, u2) scalars on host
-    (exact Python ints — the "lattice reduction on host in the packer"
-    leg) and emit the GLV program's byte matrices. u1/u2: (n, 32) uint8
-    big-endian scalars; qx_bytes/r_bytes/rn_bytes: (n, 32) uint8;
-    qy_ints: per-record pubkey y as Python ints (the first Q-stream sign
-    folds into y here, so the device never negates Q). range_bad: (n,)
-    bool poison mask or None. Decompose and pack stages are timed into
-    STATS for the bench's per-stage split."""
+    """Shared HOST-decompose GLV pack (the device-decompose leg's
+    fallback): lattice-decompose the (u1, u2) scalars with the numpy
+    limb-batch split (ops/secp256k1.glv_split_batch_np — vectorized
+    since ISSUE 11; the per-record Python-bigint loop it replaced was
+    the BENCH_r08 host_share 0.56 leg) and emit the GLV program's byte
+    matrices. u1/u2/qx/qy/r/rn: (n, 32) uint8 big-endian. range_bad:
+    (n,) bool poison mask or None. Decompose and emit stages are timed
+    into STATS for the bench's per-stage split."""
     from . import secp256k1 as dev
 
-    n = len(qy_ints)
+    n = len(qy_bytes)
+    t0 = time.monotonic()
+    if n:
+        a1m, na1, a2m, na2 = dev.glv_decompose_batch_np(u1_bytes)
+        b1m, nb1, b2m, nb2 = dev.glv_decompose_batch_np(u2_bytes)
+    dt = time.monotonic() - t0
+    STATS.glv_decompose_s += dt
+    _STAGE_H.labels(stage="decompose").observe(dt)
+    dw.note_phase("ecdsa", "decompose", dt)
+
     t0 = time.monotonic()
     d1m = np.zeros((bucket, 16), np.uint8)
     d2m = np.zeros((bucket, 16), np.uint8)
@@ -463,26 +508,21 @@ def _glv_pack_parts(u1_bytes, u2_bytes, qx_bytes, qy_ints, r_bytes,
     sg2 = np.zeros(bucket, np.uint8)
     ydiff = np.zeros(bucket, np.uint8)
     qyb = np.zeros((bucket, 32), np.uint8)
-    for i in range(n):
-        u1 = int.from_bytes(u1_bytes[i].tobytes(), "big")
-        u2 = int.from_bytes(u2_bytes[i].tobytes(), "big")
-        a1, na1, a2, na2 = dev.glv_decompose(u1)
-        b1, nb1, b2, nb2 = dev.glv_decompose(u2)
+    if n:
         # comb digits little-endian (position i = weight 256^i); ladder
         # scalars big-endian (MSB-first nibble windows on device)
-        d1m[i] = np.frombuffer(a1.to_bytes(16, "little"), np.uint8)
-        d2m[i] = np.frombuffer(a2.to_bytes(16, "little"), np.uint8)
-        s1m[i] = np.frombuffer(b1.to_bytes(16, "big"), np.uint8)
-        s2m[i] = np.frombuffer(b2.to_bytes(16, "big"), np.uint8)
-        sg1[i] = na1
-        sg2[i] = na2
-        ydiff[i] = nb1 ^ nb2
-        qy = oracle.P - qy_ints[i] if nb1 else qy_ints[i]
-        qyb[i] = np.frombuffer(qy.to_bytes(32, "big"), np.uint8)
-    STATS.glv_decompose_s += time.monotonic() - t0
-    _STAGE_H.labels(stage="decompose").observe(time.monotonic() - t0)
-
-    t0 = time.monotonic()
+        d1m[:n] = a1m
+        d2m[:n] = a2m
+        s1m[:n] = b1m[:, ::-1]
+        s2m[:n] = b2m[:, ::-1]
+        sg1[:n] = na1
+        sg2[:n] = na2
+        ydiff[:n] = nb1 ^ nb2
+        # first Q-stream sign folds into qy (device never negates Q)
+        fold = nb1.astype(bool)
+        qyb[:n] = qy_bytes
+        if fold.any():
+            qyb[:n][fold] = dev.field_neg_bytes_np(qy_bytes[fold])
 
     def pad(mat: np.ndarray) -> np.ndarray:
         out = np.zeros((bucket, 32), np.uint8)
@@ -496,8 +536,11 @@ def _glv_pack_parts(u1_bytes, u2_bytes, qx_bytes, qy_ints, r_bytes,
     wrap8[:n] = np.asarray(wraps, np.uint8)
     out = (d1m, d2m, sg1, sg2, s1m, s2m, ydiff, pad(qx_bytes), qyb,
            q_inf, pad(r_bytes), pad(rn_bytes), wrap8)
-    STATS.glv_pack_s += time.monotonic() - t0
-    _STAGE_H.labels(stage="pack").observe(time.monotonic() - t0)
+    dt = time.monotonic() - t0
+    STATS.glv_pack_s += dt
+    STATS.glv_emit_s += dt
+    _STAGE_H.labels(stage="pack").observe(dt)
+    dw.note_phase("ecdsa", "pack", dt)
     return out
 
 
@@ -518,10 +561,12 @@ def pack_records_glv(records: Sequence, bucket: int):
         b"".join((rec.r + oracle.N if w else rec.r).to_bytes(32, "big")
                  for rec, w in zip(records, wraps)),
         np.uint8).reshape(n, 32) if n else np.zeros((0, 32), np.uint8)
+    qy_bytes = np.frombuffer(
+        b"".join(rec.pubkey[1].to_bytes(32, "big") for rec in records),
+        np.uint8).reshape(n, 32) if n else np.zeros((0, 32), np.uint8)
     range_bad = None if range_ok is None else ~np.asarray(range_ok, bool)
     return _glv_pack_parts(
-        u1_bytes, u2_bytes, qx_bytes,
-        [rec.pubkey[1] for rec in records], r_bytes, rn_bytes, wraps,
+        u1_bytes, u2_bytes, qx_bytes, qy_bytes, r_bytes, rn_bytes, wraps,
         range_bad, bucket,
     )
 
@@ -807,22 +852,57 @@ def _dispatch_device(records: Sequence, br,
                 # (~minutes per shape on a CPU backend, and every shape is
                 # a fresh XLA program on the chip too)
                 bucket = max(1024, _bucket_for(len(wire), pallas=True))
-                try:
-                    INJECTOR.on_call(GLV_SITE)
-                    with dw.phase("ecdsa", "pack"):
+                if glv_dev_enabled():
+                    # device-decompose leg (ISSUE 11): the host pack is
+                    # the w4 byte emit ONLY — the lattice split runs
+                    # inside the fused program
+                    try:
+                        INJECTOR.on_call(GLV_DEV_SITE)
+                        INJECTOR.on_call(GLV_SITE)
+                        t0 = time.monotonic()
+                        with dw.phase("ecdsa", "pack"):
+                            arrays = pack_records_w4_bytes(wire, bucket)
+                        dt = time.monotonic() - t0
+                        STATS.glv_emit_s += dt
+                        _STAGE_H.labels(stage="emit").observe(dt)
+                        t0 = time.monotonic()
+                        device_ok, degen = _watched_kernel(
+                            _PW_GLV_DEV, bucket, arrays,
+                            lambda: dev.ecdsa_verify_batch_glv_dev(*arrays),
+                            jitfn=(dev._glv_dev_program
+                                   if bucket <= 16384 else None))
+                        STATS.glv_dispatch_s += time.monotonic() - t0
+                        if (INJECTOR.should_poison(GLV_DEV_SITE)
+                                or INJECTOR.should_poison(GLV_SITE)):
+                            device_ok = ~device_ok
+                        STATS.glv_dispatches += 1
+                        STATS.glv_dev_dispatches += 1
+                    except (KeyboardInterrupt, SystemExit):
+                        raise
+                    except Exception as e:
+                        _note_glv_dev_failure(e)
+                        device_ok = degen = None
+                if device_ok is None and glv_enabled():
+                    # host-decompose fallback (the pre-ISSUE-11 path,
+                    # itself numpy-vectorized now)
+                    try:
+                        INJECTOR.on_call(GLV_SITE)
                         arrays = pack_records_glv(wire, bucket)
-                    device_ok, degen = _watched_kernel(
-                        _PW_GLV, bucket, arrays,
-                        lambda: dev.ecdsa_verify_batch_glv(*arrays),
-                        jitfn=dev._glv_program if bucket <= 16384 else None)
-                    if INJECTOR.should_poison(GLV_SITE):
-                        device_ok = ~device_ok
-                    STATS.glv_dispatches += 1
-                except (KeyboardInterrupt, SystemExit):
-                    raise
-                except Exception as e:
-                    _note_glv_failure(e)
-                    device_ok = degen = None
+                        t0 = time.monotonic()
+                        device_ok, degen = _watched_kernel(
+                            _PW_GLV, bucket, arrays,
+                            lambda: dev.ecdsa_verify_batch_glv(*arrays),
+                            jitfn=(dev._glv_program
+                                   if bucket <= 16384 else None))
+                        STATS.glv_dispatch_s += time.monotonic() - t0
+                        if INJECTOR.should_poison(GLV_SITE):
+                            device_ok = ~device_ok
+                        STATS.glv_dispatches += 1
+                    except (KeyboardInterrupt, SystemExit):
+                        raise
+                    except Exception as e:
+                        _note_glv_failure(e)
+                        device_ok = degen = None
             if device_ok is None and pallas_enabled():
                 bucket = _bucket_for(len(wire), pallas=True)
                 try:
@@ -885,6 +965,34 @@ def _dispatch_device(records: Sequence, br,
 
 _PALLAS_BROKEN = False
 _GLV_BROKEN = False
+_GLV_DEV_BROKEN = False
+
+
+def glv_dev_enabled() -> bool:
+    """Gate for the device-decompose GLV leg (ISSUE 11) — the first rung
+    of the degradation ladder (device-decompose -> host decompose -> w4
+    -> XLA -> CPU); latched off on deterministic lowering failures only."""
+    return not _GLV_DEV_BROKEN
+
+
+def _note_glv_dev_failure(e: Exception) -> None:
+    """Device-decompose-leg failure bookkeeping: the dispatch degrades to
+    the host-decompose GLV pack (same supervised attempt). Deterministic
+    lowering failures latch _GLV_DEV_BROKEN; transient errors (including
+    injected drill faults) do not. Programming errors re-raise — the
+    _note_pallas_failure invariant: a NameError in the decompose kernel
+    must not hide behind a green host fallback forever."""
+    global _GLV_DEV_BROKEN
+    if isinstance(e, (NameError, AttributeError, UnboundLocalError)):
+        raise e
+    STATS.glv_dev_fallbacks += 1
+    text = f"{type(e).__name__}: {e}"
+    if ("Mosaic" in text or "NotImplementedError" in text
+            or "lowering" in text):
+        _GLV_DEV_BROKEN = True
+    log_printf("glv device-decompose leg failed (%s) — host decompose "
+               "fallback%s", text[:200],
+               " (latched)" if _GLV_DEV_BROKEN else "")
 
 
 def glv_enabled() -> bool:
@@ -1373,24 +1481,59 @@ def _dispatch_packed_device(pub, rs, msg, rn, wrap, n: int,
             wrap8 = np.zeros(bucket, np.uint8)
             wrap8[:m] = wrap2
             device_ok = degen = None
-            if active_kernel() == "glv" and glv_enabled():
-                # GLV leg for the packed path: same host lattice split as
-                # pack_records_glv, fed from the blobs (qy ints only for
-                # the sign fold); failure degrades to the w4 kernel below
+            if (active_kernel() == "glv" and glv_enabled()
+                    and glv_dev_enabled()):
+                # device-decompose GLV leg for the packed path (ISSUE
+                # 11): the blobs pad straight into the fused program's
+                # byte matrices — zero per-record host work beyond the
+                # precompute above; failure degrades to the host lattice
+                # split below, then the w4 kernel
+                try:
+                    INJECTOR.on_call(GLV_DEV_SITE)
+                    INJECTOR.on_call(GLV_SITE)
+                    t0 = time.monotonic()
+                    with dw.phase("ecdsa", "pack"):
+                        arrays = [pad(u1, 32), pad(u2, 32),
+                                  pad(pub2[:, :32], 32),
+                                  pad(pub2[:, 32:], 32), q_inf,
+                                  pad(rs2[:, :32], 32), pad(rn2, 32),
+                                  wrap8]
+                    dt = time.monotonic() - t0
+                    STATS.glv_emit_s += dt
+                    _STAGE_H.labels(stage="emit").observe(dt)
+                    t0 = time.monotonic()
+                    device_ok, degen = _watched_kernel(
+                        _PW_GLV_DEV, bucket, arrays,
+                        lambda: dev.ecdsa_verify_batch_glv_dev(*arrays),
+                        jitfn=(dev._glv_dev_program
+                               if bucket <= 16384 else None))
+                    STATS.glv_dispatch_s += time.monotonic() - t0
+                    if (INJECTOR.should_poison(GLV_DEV_SITE)
+                            or INJECTOR.should_poison(GLV_SITE)):
+                        device_ok = ~device_ok
+                    STATS.glv_dispatches += 1
+                    STATS.glv_dev_dispatches += 1
+                except (KeyboardInterrupt, SystemExit):
+                    raise
+                except Exception as e:
+                    _note_glv_dev_failure(e)
+                    device_ok = degen = None
+            if (device_ok is None and active_kernel() == "glv"
+                    and glv_enabled()):
+                # host-decompose GLV leg: same lattice split as
+                # pack_records_glv (numpy limb batches), fed from the
+                # blobs; failure degrades to the w4 kernel below
                 try:
                     INJECTOR.on_call(GLV_SITE)
-                    qy_ints = [
-                        int.from_bytes(pub2[i, 32:].tobytes(), "big")
-                        for i in range(m)
-                    ]
-                    with dw.phase("ecdsa", "pack"):
-                        arrays = _glv_pack_parts(
-                            u1, u2, pub2[:, :32], qy_ints, rs2[:, :32],
-                            rn2, wrap2.astype(bool), range_bad, bucket)
+                    arrays = _glv_pack_parts(
+                        u1, u2, pub2[:, :32], pub2[:, 32:], rs2[:, :32],
+                        rn2, wrap2.astype(bool), range_bad, bucket)
+                    t0 = time.monotonic()
                     device_ok, degen = _watched_kernel(
                         _PW_GLV, bucket, arrays,
                         lambda: dev.ecdsa_verify_batch_glv(*arrays),
                         jitfn=dev._glv_program if bucket <= 16384 else None)
+                    STATS.glv_dispatch_s += time.monotonic() - t0
                     if INJECTOR.should_poison(GLV_SITE):
                         device_ok = ~device_ok
                     STATS.glv_dispatches += 1

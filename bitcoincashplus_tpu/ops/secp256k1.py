@@ -1341,3 +1341,423 @@ def ecdsa_verify_batch_glv(d1m, d2m, sg1v, sg2v, s1m, s2m, ydiff8, qxb,
         dgs.append(out[1].reshape(n))
     return (jnp.concatenate(oks).astype(bool),
             jnp.concatenate(dgs).astype(bool))
+
+
+# ---- device-side GLV decomposition (round 11) ------------------------------
+#
+# BENCH_r08's dispatch breakdown showed the GLV HOST pack dominating the
+# verify path: 3.37 s of per-record Python-bigint lattice rounding +
+# byte emit against 2.64 s of device execute (host_share 0.56). The split
+# is exact integer arithmetic, so it moves on-device: the program below
+# takes the SAME raw byte matrices as the w4 byte pipeline ((B, 32) uint8
+# per 256-bit field — the host pack collapses to pack_records_w4_bytes'
+# numpy byte emission) and computes the lattice rounding per lane with
+# multi-limb integer arithmetic in the same 13-bit-limb discipline as the
+# field core.
+#
+# Rounding is EXACT, not estimate-grade: c̃K = floor(k·gK / 2^384) (the
+# libsecp g1/g2 Barrett constants, re-derived from the basis at import)
+# lands in {cK − 1, cK} of the true cK = round(mK·k / n) for any k < n
+# (|gK − 2^384·mK/n| <= 1/2 contributes < 2^-129 relative error, the
+# floor at most 1), and one exact-residual correction step — compute
+# ê = mK·k − c̃K·n in limbs, bump c̃K when 2ê >= n (n odd kills ties, so
+# >= and > coincide on the even 2ê) — recovers cK precisely. The device
+# decomposition is therefore BIT-IDENTICAL to glv_decompose's Python-int
+# rounding, which stays in-tree as the KAT oracle and the differential
+# reference, never the hot path.
+#
+# Integer-limb helpers are prefixed _z (no mod-p folding — these are
+# plain multi-limb integers, widths chosen so every accumulation stays
+# < 2^31 in uint32). All multiplications here are variable x CONSTANT
+# (g1/g2/n/a1/a2/b1/b2 baked at trace time); the whole decomposition is
+# ~10 small schoolbook muls + carries per lane — noise next to the
+# verify ladder's 128 doublings. Like the field core, the helpers keep
+# TWO forms behind field_parallel(): compact scan traces on CPU backends
+# (an unrolled carry normalizer measured MINUTES of extra XLA compile on
+# CPU — the same pathology the module header documents for f_mul) and
+# fully parallel static forms on accelerators (where per-iteration
+# buffer copies, not compile time, are the poison).
+
+_GLV_G1_INT = _round_div(_GLV_B2 << 384, N)
+_GLV_G2_INT = _round_div(_GLV_MINUS_B1 << 384, N)
+
+
+def _zconst_limbs(value: int, width: int) -> np.ndarray:
+    """int -> (width,) uint32 13-bit LE limb array (must fit)."""
+    assert 0 <= value < (1 << (LIMB_BITS * width)), (value, width)
+    return np.array(
+        [(value >> (LIMB_BITS * i)) & int(MASK) for i in range(width)],
+        np.uint32,
+    )
+
+
+def _zmul_const(a, c_limbs, width: int):
+    """Exact-limb (La, B) x constant limb vector -> (width, B) raw
+    columns, un-normalized. Accumulation bound: <= min(La, len(c)) <= 20
+    terms of < 2^26 each, < 2^31 — u32-safe. Zero limbs of the constant
+    cost nothing (skipped at trace time)."""
+    La = a.shape[0]
+    cols = jnp.zeros((width,) + a.shape[1:], jnp.uint32)
+    for i, c in enumerate(c_limbs):
+        if int(c):
+            cols = cols.at[i:i + La].add(a * np.uint32(int(c)))
+    return cols
+
+
+def _znorm(cols):
+    """Raw columns (< 2^31 each) -> exact 13-bit limbs, same width (the
+    value must fit the width — top carry is structurally zero). CPU:
+    one sequential carry scan settles exactly (carries ride the scan
+    state). Parallel form: three rounds collapse any < 2^31 magnitudes
+    to <= 2^13 + 1, then `width` single-carry ripple rounds settle
+    exactly (cf. _exact_norm20)."""
+    if not field_parallel():
+        out, _carry = _sweep(cols)  # final carry structurally zero
+        return out
+    v = cols
+    for _ in range(v.shape[0] + 3):
+        c = v >> np.uint32(LIMB_BITS)
+        v = (v & MASK) + jnp.concatenate(
+            [jnp.zeros_like(c[:1]), c[:-1]], axis=0)
+    return v
+
+
+def _zge(a, b):
+    """a >= b over equal-width EXACT limb planes; (B,) bool. CPU: the
+    field core's MSB-first compare scan (width-generic). Parallel form:
+    static unroll."""
+    if not field_parallel():
+        return _f_ge(a, b)
+    gt = a[0] > a[0]   # varying-safe all-False / all-True inits
+    eq = a[0] == a[0]
+    for i in range(a.shape[0] - 1, -1, -1):
+        gt = gt | (eq & (a[i] > b[i]))
+        eq = eq & (a[i] == b[i])
+    return gt | eq
+
+
+def _zsub(a, b):
+    """Exact a - b for equal-width exact limb planes with a >= b (borrow
+    ripple). Garbage when a < b — callers select on _zge. CPU: the field
+    core's borrow scan (width-generic); parallel form: static unroll."""
+    if not field_parallel():
+        return _f_sub_exact(a, b)
+    outs = []
+    borrow = a[0] * U32_0
+    for i in range(a.shape[0]):
+        v = a[i] - b[i] - borrow
+        under = v >> np.uint32(31)
+        outs.append(v + under * np.uint32(1 << LIMB_BITS))
+        borrow = under
+    return jnp.stack(outs, axis=0)
+
+
+def _zdbl(v):
+    """2*v for exact limbs -> (width + 1, B) exact limbs."""
+    lo = (v << np.uint32(1)) & MASK
+    hi = v >> np.uint32(LIMB_BITS - 1)
+    carry = jnp.concatenate([jnp.zeros_like(hi[:1]), hi[:-1]], axis=0)
+    return jnp.concatenate([lo + carry, hi[-1:]], axis=0)
+
+
+def _zshr_384(v40):
+    """floor(v / 2^384) for a (40, B) exact plane -> (11, B).
+    384 = 29*13 + 7: output limb j = (v[29+j] >> 7) | (v[30+j] & 0x7F) << 6."""
+    w = v40[29:]
+    lo = w >> np.uint32(7)
+    hi = (w & np.uint32(0x7F)) << np.uint32(LIMB_BITS - 7)
+    return lo + jnp.concatenate([hi[1:], jnp.zeros_like(hi[:1])], axis=0)
+
+
+def _glv_split_device(k20):
+    """Device lattice decomposition: k20 is the (20, B) EXACT 13-bit limb
+    plane of a scalar k < n. Returns (m1, n1, m2, n2): mK (10, B) exact
+    limb planes of |kK| < 2^128 and nK (B,) bool sign flags with
+    k == (-1)^n1·m1 + λ·(-1)^n2·m2 (mod n) — the same contract AND the
+    same exact rounding as the host glv_decompose."""
+    n_20 = _zconst_limbs(N, 20)
+
+    def round_quot(g_int: int, m_int: int):
+        # c̃ = floor(k·g / 2^384), then the exact-rounding correction:
+        # ê = m·k − c̃·n; the true c has 2|ê| < n, so c̃ is exact unless
+        # ê >= 0 and 2ê >= n, where c = c̃ + 1 (floor never overshoots).
+        prod = _znorm(_zmul_const(k20, _zconst_limbs(g_int, 20), 40))
+        c_est = _zshr_384(prod)                                   # (11, B)
+        t = _znorm(_zmul_const(k20, _zconst_limbs(m_int, 10), 30))
+        cn = _znorm(_zmul_const(c_est, n_20, 31))[:30]
+        ge = _zge(t, cn)
+        diff = _zsub(t, cn)              # = ê, valid only where ge
+        n_31 = jnp.asarray(_zconst_limbs(N, 31)).reshape(
+            (31,) + (1,) * (k20.ndim - 1)).astype(jnp.uint32)
+        plus = ge & _zge(_zdbl(diff), jnp.broadcast_to(
+            n_31, (31,) + diff.shape[1:]))
+        bumped = jnp.concatenate(
+            [c_est[0:1] + plus.astype(jnp.uint32), c_est[1:]], axis=0)
+        return _znorm(bumped)
+
+    c1 = round_quot(_GLV_G1_INT, _GLV_B2)
+    c2 = round_quot(_GLV_G2_INT, _GLV_MINUS_B1)
+    # k1 = k − c1·a1 − c2·a2 ; k2 = c1·(−b1) − c2·b2  (signed, |·| < 2^128)
+    s = _znorm(_zmul_const(c1, _zconst_limbs(_GLV_A1, 10), 21)
+               + _zmul_const(c2, _zconst_limbs(_GLV_A2, 10), 21))
+    k_pad = jnp.concatenate([k20, jnp.zeros_like(k20[:1])], axis=0)
+    n1 = ~_zge(k_pad, s)
+    m1 = jnp.where(n1, _zsub(s, k_pad), _zsub(k_pad, s))[:10]
+    p1 = _znorm(_zmul_const(c1, _zconst_limbs(_GLV_MINUS_B1, 10), 21))
+    p2 = _znorm(_zmul_const(c2, _zconst_limbs(_GLV_B2, 10), 21))
+    n2 = ~_zge(p1, p2)
+    m2 = jnp.where(n2, _zsub(p2, p1), _zsub(p1, p2))[:10]
+    return m1, n1, m2, n2
+
+
+def _mag_bits128(m10):
+    """(10, B) exact limb plane of a value < 2^128 -> (128, B) LSB-first
+    bit planes (uint32 0/1)."""
+    shifts = jnp.arange(13, dtype=jnp.uint32).reshape(1, 13, 1)
+    bits = (m10[:, None, :] >> shifts) & jnp.uint32(1)
+    return bits.reshape(130, m10.shape[1])[:128]
+
+
+def _bits_to_comb_digits(bits):
+    """(128, B) LSB-first bits -> (16, B) int32 8-bit comb digits (digit
+    i = byte i little-endian = weight 256^i) — the device twin of the
+    host packer's to_bytes(16, 'little') emission."""
+    w = (jnp.uint32(1) << jnp.arange(8, dtype=jnp.uint32)).reshape(1, 8, 1)
+    return (bits.reshape(16, 8, -1) * w).sum(1).astype(jnp.int32)
+
+
+def _bits_to_nibble_windows(bits):
+    """(128, B) LSB-first bits -> (32, B) int32 MSB-first 4-bit windows
+    (window 0 = bits 127..124) — matches _expand_nibble_windows over the
+    host packer's big-endian byte emission."""
+    w = (jnp.uint32(1) << jnp.arange(4, dtype=jnp.uint32)).reshape(1, 4, 1)
+    nib = (bits.reshape(32, 4, -1) * w).sum(1)
+    return nib[::-1].astype(jnp.int32)
+
+
+@jax.jit
+def _glv_decompose_program(km):
+    """Decompose-only jit surface: (B, 32) uint8 big-endian scalars
+    (< n) -> (|k1| LE bytes (B, 16), n1 (B,), |k2| LE bytes (B, 16),
+    n2 (B,)) — the differential-test window onto the in-kernel split
+    (the fused _glv_dev_program below is the production consumer)."""
+    m1, n1, m2, n2 = _glv_split_device(_expand_limb_cols(km))
+    b1 = _bits_to_comb_digits(_mag_bits128(m1))
+    b2 = _bits_to_comb_digits(_mag_bits128(m2))
+    return (b1.T.astype(jnp.uint8), n1.astype(jnp.uint8),
+            b2.T.astype(jnp.uint8), n2.astype(jnp.uint8))
+
+
+def glv_decompose_device_batch(scalars) -> tuple:
+    """Host-callable device split over (n, 32) big-endian scalar bytes;
+    returns numpy (|k1| (n, 16) LE, n1, |k2| (n, 16) LE, n2)."""
+    out = _glv_decompose_program(np.asarray(scalars, np.uint8))
+    return tuple(np.asarray(o) for o in out)
+
+
+@jax.jit
+def _glv_dev_program(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8):
+    """The device-decompose GLV pipeline (round 11), ONE dispatch end to
+    end: byte-matrix inputs IDENTICAL to the w4 byte pipeline (so the
+    host pack is pack_records_w4_bytes' pure numpy byte emission),
+    device-side exact lattice decomposition of u1/u2, window/digit/limb
+    expansion, the sign-folded λQ y-select, then the GLV verify core.
+    Returns (2, B) uint32: row 0 ok, row 1 degenerate."""
+    B = qxb.shape[0]
+    # ONE split over the stacked (2B,) lane axis — the decompose is
+    # pure per-lane arithmetic, so stacking u1|u2 halves the traced
+    # decompose graph (XLA CPU compile time scales with trace size)
+    mm1, nn1, mm2, nn2 = _glv_split_device(
+        _expand_limb_cols(jnp.concatenate([u1m, u2m], axis=0)))
+    bb1 = _mag_bits128(mm1)
+    bb2 = _mag_bits128(mm2)
+    a1, na1, a2, na2 = bb1[:, :B], nn1[:B], bb2[:, :B], nn2[:B]
+    b1, nb1, b2, nb2 = bb1[:, B:], nn1[B:], bb2[:, B:], nn2[B:]
+    d1 = _bits_to_comb_digits(a1)      # G-stream digits
+    d2 = _bits_to_comb_digits(a2)      # λG-stream digits
+    w1 = _bits_to_nibble_windows(b1)   # Q-stream windows
+    w2 = _bits_to_nibble_windows(b2)   # λQ-stream windows
+    qy = _expand_limb_cols(qyb)
+    nb1r = nb1.reshape(1, B)
+    # the first Q-stream sign folds into qy (the host packer's P − qy
+    # leg, done in the field here); the second folds into the λQ table's
+    # y-select via ydiff — exactly pack_records_glv's emission contract
+    qy = jnp.where(nb1r, _f_neg(qy), qy)
+    ydiff = (nb1r ^ nb2.reshape(1, B)).astype(jnp.uint32)
+    ok, degen = _verify_core_glv(
+        w1, w2, d1, na1.astype(jnp.int32), d2, na2.astype(jnp.int32),
+        _expand_limb_cols(qxb), qy, ydiff,
+        qinf8.astype(jnp.uint32).reshape(1, B),
+        _expand_limb_cols(r0b), _expand_limb_cols(rnb),
+        wrap8.astype(jnp.uint32).reshape(1, B))
+    return jnp.concatenate(
+        [ok.astype(jnp.uint32), degen.astype(jnp.uint32)], axis=0)
+
+
+def ecdsa_verify_batch_glv_dev(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8):
+    """Byte-matrix GLV verify with the decompose ON DEVICE (see
+    _glv_dev_program). Input signature matches the w4 byte pipeline;
+    batches beyond 16384 lanes split into 16384-lane program calls so
+    compiled shapes stay the bounded bucket set. Returns (ok, degen)
+    bool (B,) arrays — device futures until materialized."""
+    B = qxb.shape[0]
+    SPLIT = 16384
+    if B <= SPLIT:
+        out = _glv_dev_program(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8)
+        return out[0].astype(bool), out[1].astype(bool)
+    oks, dgs = [], []
+    for s in range(0, B, SPLIT):
+        sl = slice(s, s + SPLIT)
+        out = _glv_dev_program(u1m[sl], u2m[sl], qxb[sl], qyb[sl],
+                               qinf8[sl], r0b[sl], rnb[sl], wrap8[sl])
+        n = min(SPLIT, B - s)
+        oks.append(out[0].reshape(n))
+        dgs.append(out[1].reshape(n))
+    return (jnp.concatenate(oks).astype(bool),
+            jnp.concatenate(dgs).astype(bool))
+
+
+# ---- numpy-vectorized host decomposition (fallback + reference) ------------
+#
+# The retained host-decompose path (device-decompose latched broken, or
+# the explicit drill) must still beat the old per-record Python-bigint
+# loop: the same estimate-plus-exact-correction algorithm as the device
+# kernel, vectorized over records in 16-bit limbs on uint64 (products
+# < 2^32, <= 16-term column sums < 2^37 — u64-safe). Also the
+# differential reference the unit suite runs against glv_decompose.
+
+_NP16_MASK = np.uint64(0xFFFF)
+
+
+def _np_limbs16(mat: np.ndarray, width: int) -> np.ndarray:
+    """(n, nb) uint8 big-endian -> (n, width) uint64 16-bit LE limbs."""
+    rev = mat[:, ::-1].astype(np.uint64)
+    out = np.zeros((mat.shape[0], width), np.uint64)
+    half = mat.shape[1] // 2
+    out[:, :half] = rev[:, 0::2] | (rev[:, 1::2] << np.uint64(8))
+    return out
+
+
+def _np_const16(value: int, width: int) -> np.ndarray:
+    assert 0 <= value < (1 << (16 * width)), (value, width)
+    return np.array([(value >> (16 * i)) & 0xFFFF for i in range(width)],
+                    np.uint64)
+
+
+def _np_mul(a: np.ndarray, c: np.ndarray) -> np.ndarray:
+    """(n, La) exact 16-bit limbs x (Lc,) const -> (n, La + Lc) raw
+    columns (u64-safe, un-normalized)."""
+    n, La = a.shape
+    cols = np.zeros((n, La + len(c)), np.uint64)
+    for i, ci in enumerate(c):
+        if int(ci):
+            cols[:, i:i + La] += a * ci
+    return cols
+
+
+def _np_norm(cols: np.ndarray) -> np.ndarray:
+    """Raw columns -> exact 16-bit limbs, same width (value must fit).
+    Three rounds collapse any < 2^37 magnitudes to <= 2^16 + 1; the
+    residual single-carry ripple is data-dependent on host, so loop
+    until quiescent (typically 1-2 more passes) instead of the device
+    kernel's fixed worst-case `width` rounds."""
+    v = cols
+    for _ in range(3):
+        carry = v >> np.uint64(16)
+        v = v & _NP16_MASK
+        v[:, 1:] += carry[:, :-1]
+    while True:
+        carry = v >> np.uint64(16)
+        if not carry.any():
+            return v
+        v = v & _NP16_MASK
+        v[:, 1:] += carry[:, :-1]
+
+
+def _np_sub(a: np.ndarray, b: np.ndarray) -> tuple:
+    """Limbwise a - b with borrow ripple; returns (diff, underflow).
+    underflow True where a < b (diff is then the wrapped complement)."""
+    n, width = a.shape
+    out = np.empty((n, width), np.uint64)
+    borrow = np.zeros(n, np.uint64)
+    for i in range(width):
+        v = a[:, i] - b[:, i] - borrow
+        borrow = v >> np.uint64(63)
+        out[:, i] = v + (borrow << np.uint64(16))
+    return out, borrow.astype(bool)
+
+
+def _np_ge(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    return ~_np_sub(a, b)[1]
+
+
+def _np_dbl(v: np.ndarray) -> np.ndarray:
+    out = np.zeros((v.shape[0], v.shape[1] + 1), np.uint64)
+    out[:, :-1] = (v << np.uint64(1)) & _NP16_MASK
+    out[:, 1:] += v >> np.uint64(15)
+    return out
+
+
+def _np_bytes_le(limbs: np.ndarray) -> np.ndarray:
+    """(n, L) 16-bit limbs -> (n, 2L) uint8 little-endian bytes."""
+    out = np.empty((limbs.shape[0], 2 * limbs.shape[1]), np.uint8)
+    out[:, 0::2] = (limbs & np.uint64(0xFF)).astype(np.uint8)
+    out[:, 1::2] = ((limbs >> np.uint64(8)) & np.uint64(0xFF)).astype(
+        np.uint8)
+    return out
+
+
+def glv_split_batch_np(scalars: np.ndarray) -> tuple:
+    """Numpy-vectorized exact lattice split: (n, 32) big-endian scalar
+    bytes (each < n) -> (m1 (n, 8) u64 16-bit LE limbs, n1 (n,) bool,
+    m2, n2), rounding identical to glv_split (asserted differentially
+    by the unit suite)."""
+    k = _np_limbs16(np.asarray(scalars, np.uint8), 16)
+    n_16 = _np_const16(N, 16)
+
+    def round_quot(g_int: int, m_int: int) -> np.ndarray:
+        prod = _np_norm(_np_mul(k, _np_const16(g_int, 16)))    # (n, 32)
+        c_est = prod[:, 24:].copy()     # floor(· / 2^384): 24 limbs off
+        t = _np_norm(_np_mul(k, _np_const16(m_int, 8)))        # (n, 24)
+        cn = _np_norm(_np_mul(c_est, n_16))                    # (n, 24)
+        diff, under = _np_sub(t, cn)
+        two = _np_dbl(diff)
+        plus = (~under) & _np_ge(
+            two, np.broadcast_to(_np_const16(N, two.shape[1]), two.shape))
+        c_est[:, 0] += plus
+        return _np_norm(c_est)
+
+    c1 = round_quot(_GLV_G1_INT, _GLV_B2)
+    c2 = round_quot(_GLV_G2_INT, _GLV_MINUS_B1)
+    s_cols = _np_mul(c2, _np_const16(_GLV_A2, 9))              # (n, 17)
+    s_cols[:, :16] += _np_mul(c1, _np_const16(_GLV_A1, 8))
+    s = _np_norm(s_cols)
+    k_pad = np.zeros_like(s)
+    k_pad[:, :16] = k
+    d_ks, n1 = _np_sub(k_pad, s)
+    d_sk, _ = _np_sub(s, k_pad)
+    m1 = np.where(n1[:, None], d_sk, d_ks)[:, :8]
+    p1 = _np_norm(_np_mul(c1, _np_const16(_GLV_MINUS_B1, 8)))  # (n, 16)
+    p2 = _np_norm(_np_mul(c2, _np_const16(_GLV_B2, 8)))
+    d12, n2 = _np_sub(p1, p2)
+    d21, _ = _np_sub(p2, p1)
+    m2 = np.where(n2[:, None], d21, d12)[:, :8]
+    return m1, n1, m2, n2
+
+
+def glv_decompose_batch_np(scalars: np.ndarray) -> tuple:
+    """glv_decompose, vectorized: (n, 32) big-endian scalar bytes ->
+    (|k1| (n, 16) LE bytes, n1 (n,) uint8, |k2| (n, 16) LE bytes, n2)."""
+    m1, n1, m2, n2 = glv_split_batch_np(scalars)
+    return (_np_bytes_le(m1), n1.astype(np.uint8),
+            _np_bytes_le(m2), n2.astype(np.uint8))
+
+
+def field_neg_bytes_np(yb: np.ndarray) -> np.ndarray:
+    """(n, 32) big-endian y (< p) -> (n, 32) big-endian p − y, vectorized
+    (the host packer's Q-stream sign fold; y = 0 is never on the curve,
+    so the p − 0 = p edge is unreachable from parsed pubkeys)."""
+    yl = _np_limbs16(np.asarray(yb, np.uint8), 16)
+    d, under = _np_sub(
+        np.broadcast_to(_np_const16(P, 16), yl.shape).copy(), yl)
+    return _np_bytes_le(d)[:, ::-1]

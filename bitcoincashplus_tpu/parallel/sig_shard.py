@@ -19,6 +19,13 @@ The GLV kernel (ops/secp256k1._glv_program, -ecdsakernel=glv, the
 default) shards the same way via _sharded_glv_jit — plain XLA end to
 end, so no interpret split: the fixed-base comb constants replicate per
 chip and the split-scalar byte matrices shard on the batch axis.
+
+Since ISSUE 11 the GLV path shards the FUSED device-decompose program
+(ops/secp256k1._glv_dev_program) by default: inputs are the same raw
+byte matrices as the w4 pipeline (u1/u2 NOT host-split), and each chip
+lattice-decomposes its own shard on device — the mesh-native shape the
+multi-chip roadmap item needs, with the host-decompose _sharded_glv_jit
+kept as the fallback when the fused leg is latched broken.
 """
 
 from __future__ import annotations
@@ -82,6 +89,38 @@ def _sharded_glv_jit(d1m, d2m, sg1, sg2, s1m, s2m, ydiff8, qxb, qyb,
               rnb, wrap8)
 
 
+@partial(jax.jit, static_argnames=("n_chips",))
+def _sharded_glv_dev_jit(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8,
+                         n_chips: int):
+    """Sharded FUSED decompose+verify GLV program (ISSUE 11): raw scalar
+    byte matrices shard on the batch axis and every chip runs the exact
+    in-kernel lattice split over its own lanes — the host ships bytes,
+    never split scalars. Plain XLA end to end (no interpret split)."""
+    from ..ops.secp256k1 import _glv_dev_program
+
+    mesh = chip_mesh(n_chips)
+    row = P(CHIP_AXIS)
+
+    def body(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8):
+        out = _glv_dev_program(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8)
+        b_local = qxb.shape[0]
+        ok = out[0].reshape(b_local).astype(bool)
+        degen = out[1].reshape(b_local).astype(bool)
+        fails = jax.lax.psum(
+            jnp.sum(((~ok | degen) & (qinf8 == 0)).astype(jnp.uint32)),
+            CHIP_AXIS,
+        )
+        return ok, degen, fails
+
+    fn = shard_map_nocheck(
+        body,
+        mesh,
+        in_specs=(row,) * 8,
+        out_specs=(P(CHIP_AXIS), P(CHIP_AXIS), P()),
+    )
+    return fn(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8)
+
+
 @partial(jax.jit, static_argnames=("n_chips", "interpret"))
 def _sharded_w4_jit(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8,
                     n_chips: int, interpret: bool):
@@ -139,12 +178,24 @@ def verify_batch_sharded(records, n_chips: int,
 
     kern = kernel if kernel in ecdsa_batch.ECDSA_KERNELS \
         else ecdsa_batch.active_kernel()
-    if kern == "glv" and ecdsa_batch.glv_enabled():
-        arrays = [np.asarray(a) for a in pack_records_glv(records, bucket)]
+    if (kern == "glv" and ecdsa_batch.glv_enabled()
+            and ecdsa_batch.glv_dev_enabled()):
+        # fused device-decompose program: the host pack is the w4 byte
+        # emit, each chip splits its own scalar shard in-kernel
+        arrays = [np.asarray(a)
+                  for a in pack_records_w4_bytes(records, bucket)]
         dw.note_transfer("sig_shard", "h2d",
                          sum(int(a.nbytes) for a in arrays))
         # mesh-width x bucket is the compiled-shape signature; no budget —
         # virtual meshes legitimately sweep 1/2/4/8
+        with dw.program("sig_shard_glv_dev").dispatch((bucket, n_chips)):
+            ok, degen, _fails = jax.block_until_ready(
+                _sharded_glv_dev_jit(*arrays, n_chips=n_chips)
+            )
+    elif kern == "glv" and ecdsa_batch.glv_enabled():
+        arrays = [np.asarray(a) for a in pack_records_glv(records, bucket)]
+        dw.note_transfer("sig_shard", "h2d",
+                         sum(int(a.nbytes) for a in arrays))
         with dw.program("sig_shard_glv").dispatch((bucket, n_chips)):
             ok, degen, _fails = jax.block_until_ready(
                 _sharded_glv_jit(*arrays, n_chips=n_chips)

@@ -30,12 +30,20 @@ are cache hits for the eventual block connect — exactly what the
 synchronous path guaranteed.
 
 Degradation: every flush goes through ecdsa_batch.dispatch_batch, i.e.
-the supervised glv -> w4 -> XLA -> CPU chain with breaker/KAT gating. A
-flush that raises anyway resolves the affected lanes to an error state
-and TxSigFuture.result() re-verifies those records on the CPU oracle —
-the verdict a caller sees is never dropped or fabricated, and
-``-sigservice=off`` is byte-identical by construction (the callers run
-the unchanged synchronous path).
+the supervised device-decompose -> host-decompose -> w4 -> XLA -> CPU
+chain with breaker/KAT gating. A flush that raises anyway resolves the
+affected lanes to an error state and TxSigFuture.result() re-verifies
+those records on the CPU oracle — the verdict a caller sees is never
+dropped or fabricated, and ``-sigservice=off`` is byte-identical by
+construction (the callers run the unchanged synchronous path).
+
+Since ISSUE 11 the GLV lattice split rides the device program, so the
+host half of a flush (_dispatch_flush) is numpy byte emission only: with
+``-sigservicebuffers`` >= 2 the residual emit of flush N+1 overlaps the
+device decompose+verify of flush N. (The BENCH_r11 re-measure of the
+closed-loop ``concurrent`` level still favors sync — 0.33x — which
+rules pack cost OUT as the cause: bounded concurrency simply cannot
+fill buckets, so the batching tax is structural there, not a host leg.)
 
 Block-import priority: while a block is being connected
 (ChainstateManager wraps process_new_block* in ``import_priority()``),
@@ -540,6 +548,14 @@ class SigService:
         out["buffers"] = self.buffers
         out["running"] = self.running()
         out["backend"] = self.backend
+        # which decompose the GLV flushes ride (ISSUE 11): "device" =
+        # the fused in-kernel lattice split, "host" = the numpy-batch
+        # fallback, "n/a" = a non-GLV kernel is selected
+        from ..ops import ecdsa_batch as _eb
+
+        out["glv_decompose"] = (
+            "n/a" if (self.kernel or _eb.active_kernel()) != "glv"
+            else ("device" if _eb.glv_dev_enabled() else "host"))
         out["deadline_ms"] = round(self.deadline_s * 1e3, 3)
         out["lanes"] = self.lanes
         out["wait_ms"] = {

@@ -134,6 +134,14 @@ _LISTENER_INSTALLED = False
 # compile seconds observed by the jax.monitoring listener while no
 # watched dispatch was active on that thread (other jits in the process)
 _UNATTRIBUTED = {"compile_s": 0.0, "events": 0}
+# persistent XLA compilation cache state (-compilecache / BCP_COMPILE_CACHE
+# -> enable_compile_cache): BENCH_r08 recorded a 92.9 s cold GLV compile
+# that every bench subprocess and kernel-pinned import re-paid; the cache
+# makes it a once-per-toolchain cost. Event tallies come from the
+# jax.monitoring event listener (cache_hits etc.), surfaced in
+# gettpuinfo.device.
+_COMPILE_CACHE = {"dir": None, "enabled": False, "events": {}}
+_CACHE_EVENT_PREFIX = "/jax/compilation_cache/"
 
 
 def _ctx_stack() -> list:
@@ -161,8 +169,20 @@ def _on_compile_event(event: str, duration: float, **_kw) -> None:
             _UNATTRIBUTED["events"] += 1
 
 
+def _on_cache_event(event: str, **_kw) -> None:
+    """jax.monitoring event listener: tally compilation-cache events
+    (/jax/compilation_cache/cache_hits and friends) so gettpuinfo.device
+    can prove the persistent cache is actually being hit."""
+    if not event.startswith(_CACHE_EVENT_PREFIX):
+        return
+    key = event[len(_CACHE_EVENT_PREFIX):]
+    with _LOCK:
+        _COMPILE_CACHE["events"][key] = \
+            _COMPILE_CACHE["events"].get(key, 0) + 1
+
+
 def _ensure_listener() -> bool:
-    """Install the jax.monitoring listener once, lazily, and only when
+    """Install the jax.monitoring listeners once, lazily, and only when
     jax is already imported (a watch must never be the thing that
     initializes the backend). Returns whether the listener is live."""
     global _LISTENER_INSTALLED
@@ -177,10 +197,51 @@ def _ensure_listener() -> bool:
             from jax import monitoring as _jm
 
             _jm.register_event_duration_secs_listener(_on_compile_event)
+            try:
+                _jm.register_event_listener(_on_cache_event)
+            except Exception:  # pragma: no cover - older monitoring API
+                pass
             _LISTENER_INSTALLED = True
         except Exception:  # pragma: no cover - jax without monitoring
             return False
     return True
+
+
+def enable_compile_cache(path: str) -> dict:
+    """Turn on jax's persistent XLA compilation cache at ``path`` (the
+    -compilecache=<dir> knob; default OFF). Seeds BCP_COMPILE_CACHE so
+    subprocesses this process spawns (bench kernel-pinned imports, the
+    functional-test node fleet) inherit the same cache, and installs the
+    monitoring listener so cache hits surface in gettpuinfo.device.
+    Imports jax eagerly — only an explicit opt-in calls this."""
+    import jax
+
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # the kernels this repo cares about are all multi-second compiles;
+    # 2 s keeps trivial jits out of the cache directory
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    os.environ["BCP_COMPILE_CACHE"] = path
+    _ensure_listener()
+    with _LOCK:
+        _COMPILE_CACHE["dir"] = path
+        _COMPILE_CACHE["enabled"] = True
+    return compile_cache_snapshot()
+
+
+def compile_cache_snapshot() -> dict:
+    """Compilation-cache state for gettpuinfo.device: directory, enabled
+    flag, and the monitoring event tallies (cache_hits is the number of
+    compiles this process skipped by reading the cache)."""
+    with _LOCK:
+        events = dict(_COMPILE_CACHE["events"])
+        return {
+            "dir": _COMPILE_CACHE["dir"],
+            "enabled": _COMPILE_CACHE["enabled"],
+            "cache_hits": events.get("cache_hits", 0),
+            "events": events,
+        }
 
 
 def _cost_capture_mode() -> str:
@@ -713,6 +774,7 @@ def snapshot() -> dict:
             "compile_s": round(unattr["compile_s"], 4),
             "events": unattr["events"],
         },
+        "compilation_cache": compile_cache_snapshot(),
         "profiler": profile_snapshot(),
         "watchdog": WATCHDOG.snapshot(),
     }
@@ -727,6 +789,7 @@ def reset() -> None:
         _TRANSFERS.clear()
         _UNATTRIBUTED["compile_s"] = 0.0
         _UNATTRIBUTED["events"] = 0
+        _COMPILE_CACHE["events"].clear()
     with WATCHDOG._lock:
         WATCHDOG._entries.clear()
         WATCHDOG._beat_totals.clear()

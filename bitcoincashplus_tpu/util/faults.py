@@ -54,6 +54,10 @@ NET_SITE = "net"
 # targets the GLV kernel LEG inside the ecdsa dispatch so drills can prove
 # the glv -> w4 -> CPU degradation chain without disturbing the
 # whole-subsystem "ecdsa" site the dead-backend suite arms via "all".
+# "ecdsa_glv_dev" (ops/ecdsa_batch.GLV_DEV_SITE) targets the
+# device-decompose leg specifically (ISSUE 11): fail-* proves the
+# device-decompose -> host-decompose rung, poison-output proves the KAT
+# gate; also explicit-only, for the same reason.
 
 
 class InjectedFault(RuntimeError):

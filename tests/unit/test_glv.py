@@ -286,3 +286,160 @@ def test_glv_sharded_differential():
     expected = _cpu_verdicts(records)
     got = verify_batch_sharded(records, 8, kernel="glv")
     assert got.tolist() == expected
+
+
+# ---- device-side decomposition (ISSUE 11) ----------------------------------
+
+
+def _decompose_edge_scalars():
+    """Crafted decompose inputs: λ-boundary, k2 = 0 (tiny scalars), u1 = 0,
+    max-limb carry patterns (all-ones limbs ripple end to end in the limb
+    normalizers), and enough random mass to hit every sign quadrant."""
+    n = oracle.N
+    specials = [0, 1, 5, 7, dev.LAMBDA - 1, dev.LAMBDA, dev.LAMBDA + 1,
+                n - dev.LAMBDA, n - 1, n - 2, n // 2, (1 << 128) - 1,
+                1 << 127, 1 << 128, (1 << 255) % n,
+                int("1fff" * 16, 16) % n,       # all-ones 13-bit limbs
+                int("ffff" * 16, 16),           # all-ones 16-bit limbs
+                ((1 << 256) - 1) % n]
+    specials += [rng.randrange(n) for _ in range(64)]
+    return specials
+
+
+def _scalar_bytes(ks):
+    return np.frombuffer(
+        b"".join(k.to_bytes(32, "big") for k in ks), np.uint8
+    ).reshape(len(ks), 32)
+
+
+def test_host_decompose_batch_np_differential():
+    """The numpy limb-batch host split (the retained fallback AND the
+    packer's vectorized decompose) is bit-identical to glv_decompose."""
+    ks = _decompose_edge_scalars()
+    m1, n1, m2, n2 = dev.glv_decompose_batch_np(_scalar_bytes(ks))
+    quadrants = set()
+    for i, k in enumerate(ks):
+        s1, e1, s2, e2 = dev.glv_decompose(k)
+        got = (int.from_bytes(m1[i].tobytes(), "little"), int(n1[i]),
+               int.from_bytes(m2[i].tobytes(), "little"), int(n2[i]))
+        assert got == (s1, e1, s2, e2), hex(k)
+        quadrants.add((e1, e2))
+    assert quadrants == {(0, 0), (0, 1), (1, 0), (1, 1)}
+
+
+def test_device_decompose_differential():
+    """The in-kernel device split (the production hot path since ISSUE
+    11) is bit-identical to the glv_decompose host oracle over the
+    crafted edge corpus — exact rounding, not estimate-grade."""
+    ks = _decompose_edge_scalars()[:32]
+    m1, n1, m2, n2 = dev.glv_decompose_device_batch(_scalar_bytes(ks))
+    for i, k in enumerate(ks):
+        s1, e1, s2, e2 = dev.glv_decompose(k)
+        got = (int.from_bytes(m1[i].tobytes(), "little"), int(n1[i]),
+               int.from_bytes(m2[i].tobytes(), "little"), int(n2[i]))
+        assert got == (s1, e1, s2, e2), hex(k)
+
+
+def test_field_neg_bytes_np():
+    ys = [rng.randrange(oracle.P) for _ in range(16)] + [1, oracle.P - 1]
+    got = dev.field_neg_bytes_np(_scalar_bytes(ys))
+    for i, y in enumerate(ys):
+        assert int.from_bytes(got[i].tobytes(), "big") == oracle.P - y
+
+
+def test_glv_dev_failure_bookkeeping():
+    """Mirror of the GLV/pallas invariant for the device-decompose leg:
+    programming errors re-raise, toolchain errors latch, transients
+    don't."""
+    before = ecdsa_batch.STATS.glv_dev_fallbacks
+    with pytest.raises(AttributeError):
+        ecdsa_batch._note_glv_dev_failure(
+            AttributeError("module has no attribute '_GONE'"))
+    old = ecdsa_batch._GLV_DEV_BROKEN
+    try:
+        ecdsa_batch._note_glv_dev_failure(RuntimeError("transient sneeze"))
+        assert ecdsa_batch.STATS.glv_dev_fallbacks == before + 1
+        assert not ecdsa_batch._GLV_DEV_BROKEN
+        ecdsa_batch._note_glv_dev_failure(
+            RuntimeError("NotImplementedError: no lowering"))
+        assert ecdsa_batch._GLV_DEV_BROKEN
+        assert not ecdsa_batch.glv_dev_enabled()
+    finally:
+        ecdsa_batch._GLV_DEV_BROKEN = old
+
+
+def test_glv_dev_fallback_drill(fault_harness):
+    """Degradation-ladder drill for the new leg: a failed device-decompose
+    dispatch degrades to the HOST-decompose GLV pack (same supervised
+    attempt, verdict parity); a poisoned one is caught by the riding KAT
+    lanes and settles on the CPU engine."""
+    pairs = _edge_corpus()[:10]
+    records = [r for r, _ in pairs]
+    expected = _cpu_verdicts(records)
+
+    # leg 1: device-decompose fails -> host-decompose GLV (not w4)
+    fault_harness("fail-always", ops=ecdsa_batch.GLV_DEV_SITE)
+    dev_fb0 = ecdsa_batch.STATS.glv_dev_fallbacks
+    glv0 = ecdsa_batch.STATS.glv_dispatches
+    w4_fb0 = ecdsa_batch.STATS.glv_fallbacks
+    got = ecdsa_batch.verify_batch(records, backend="device", kernel="glv")
+    assert got.tolist() == expected
+    assert ecdsa_batch.STATS.glv_dev_fallbacks == dev_fb0 + 1
+    assert ecdsa_batch.STATS.glv_dispatches == glv0 + 1  # host leg ran
+    assert ecdsa_batch.STATS.glv_fallbacks == w4_fb0     # w4 NOT needed
+
+    # leg 2: device-decompose output poisoned -> KAT gate -> CPU engine
+    fault_harness("poison-output", ops=ecdsa_batch.GLV_DEV_SITE)
+    kat0 = ecdsa_batch.STATS.kat_failures
+    got = ecdsa_batch.verify_batch(records, backend="device", kernel="glv")
+    assert got.tolist() == expected
+    assert ecdsa_batch.STATS.kat_failures == kat0 + 1
+
+
+def test_glv_dev_retrace_sentinel_and_packer():
+    """devicewatch acceptance: >= 3 decompose-program dispatches at
+    DISTINCT batch fills stay inside the declared shape budget with
+    retraces_unexpected == 0 (the fills share the 1024 bucket — that IS
+    the bounded-shape design); one of them rides the cross-block
+    LanePacker so the aggregation layer provably feeds the fused
+    program; host decompose stays untouched the whole time."""
+    from bitcoincashplus_tpu.util import devicewatch as dw
+
+    pw = dw.program("ecdsa_glv_decompose")
+    d0 = pw.snapshot()["dispatches"]
+    dev0 = ecdsa_batch.STATS.glv_dev_dispatches
+    host_dec0 = ecdsa_batch.STATS.glv_decompose_s
+    emit0 = ecdsa_batch.STATS.glv_emit_s
+
+    fills = (6, 40, 90)
+    pairs = _edge_corpus()
+    records = [r for r, _ in pairs]
+    expected = _cpu_verdicts(records)
+    for i, fill in enumerate(fills):
+        recs = [records[j % len(records)] for j in range(fill)]
+        exp = [expected[j % len(records)] for j in range(fill)]
+        if i == 1:
+            packer = ecdsa_batch.LanePacker(backend="device", lanes=fill,
+                                            kernel="glv")
+            fut = packer.add(recs)
+            packer.flush()
+            got = fut.result()
+        else:
+            got = ecdsa_batch.verify_batch(recs, backend="device",
+                                           kernel="glv")
+        assert got.tolist() == exp, fill
+
+    snap = pw.snapshot()
+    assert snap["dispatches"] >= d0 + 3
+    assert snap["retraces_unexpected"] == 0
+    assert snap["shape_budget"] == ecdsa_batch.PALLAS_SHAPE_BUDGET
+    assert snap["shapes"] <= snap["shape_budget"]
+    assert ecdsa_batch.STATS.glv_dev_dispatches >= dev0 + 3
+    # the device path pays byte EMISSION, never host decompose
+    assert ecdsa_batch.STATS.glv_decompose_s == host_dec0
+    assert ecdsa_batch.STATS.glv_emit_s > emit0
+    info = ecdsa_batch.kernel_info()
+    assert info["dev_decompose"]["enabled"]
+    assert info["dev_decompose"]["dispatches"] >= 3
+    for key in ("decompose_s", "pack_s", "emit_s", "dispatch_s"):
+        assert key in info

@@ -122,3 +122,29 @@ def test_txindex_backfill_background(tmp_path):
             node.chainstate.chain[7].hash
     finally:
         node.close()
+
+
+def test_compilecache_knob(tmp_path, monkeypatch):
+    """-compilecache=<dir>: jax's persistent compilation cache points at
+    the directory, BCP_COMPILE_CACHE is seeded for child processes, and
+    gettpuinfo.device gains the compilation_cache block (default: off)."""
+    import jax
+
+    from bitcoincashplus_tpu.util import devicewatch as dw
+
+    monkeypatch.delenv("BCP_COMPILE_CACHE", raising=False)
+    old_dir = jax.config.jax_compilation_cache_dir
+    try:
+        cache_dir = tmp_path / "xla-cache"
+        node = _mk_node(tmp_path / "cc", compilecache=str(cache_dir))
+        try:
+            assert jax.config.jax_compilation_cache_dir == str(cache_dir)
+            assert os.environ["BCP_COMPILE_CACHE"] == str(cache_dir)
+            assert cache_dir.is_dir()
+            snap = dw.snapshot()["compilation_cache"]
+            assert snap["enabled"] and snap["dir"] == str(cache_dir)
+            assert "cache_hits" in snap
+        finally:
+            node.close()
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_dir)
