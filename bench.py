@@ -466,21 +466,33 @@ def _run_reindex(workdir, pipeline_depth=None, force_python=False,
 
 
 def _chainstate_digest(workdir) -> str:
-    """Order-independent-of-nothing digest of the persisted UTXO set +
-    best-block marker: kvstore iteration is key-ordered, so equal digests
-    mean byte-identical chainstates."""
+    """Deterministic digest of the persisted UTXO set + best-block marker:
+    coin rows are merged across the (possibly sharded) layout and hashed
+    in global key order, so equal digests mean identical coin sets.
+    Per-shard epoch/accumulator meta is excluded (flush-cadence local)."""
+    import glob
     import hashlib
 
     from bitcoincashplus_tpu.store.kvstore import KVStore
 
-    kv = KVStore(os.path.join(workdir, "regtest", "chainstate.sqlite"))
+    root = os.path.join(workdir, "regtest")
+    paths = sorted(glob.glob(
+        os.path.join(root, "chainstate.shard*.sqlite"))) or \
+        [os.path.join(root, "chainstate.sqlite")]
+    rows: dict[bytes, bytes] = {}
+    for p in paths:
+        kv = KVStore(p)
+        for k, v in kv.iterate():
+            if k[:1] == b"C" or k == b"B":
+                rows[k] = v
+        kv.close()
     h = hashlib.sha256()
-    for k, v in kv.iterate():
+    for k in sorted(rows):
+        v = rows[k]
         h.update(len(k).to_bytes(4, "little"))
         h.update(k)
         h.update(len(v).to_bytes(4, "little"))
         h.update(v)
-    kv.close()
     return h.hexdigest()
 
 
@@ -1026,6 +1038,157 @@ def bench_fork_storm():
                 "fork_storm_identical": result["chainstate_identical"]}
     except Exception as e:  # pragma: no cover - diagnostics only
         emit("fork_storm", -1, "s", 0.0, error=f"{type(e).__name__}: {e}")
+        return None
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+def _utxo_key(i: int) -> bytes:
+    return i.to_bytes(32, "little") + b"\x00\x00\x00\x00"
+
+
+def _utxo_coin(i: int) -> bytes:
+    # valid Coin serialization: compact(height*2+cb), compact(value),
+    # var_bytes(20-byte script)
+    return bytes([2, 5, 20]) + bytes([i & 0xFF]) * 20
+
+
+def _churn_store(workdir, n_shards, n_coins, chunk, rounds, half):
+    """Seed n_coins into a fresh store in `chunk`-sized commits, then run
+    `rounds` churn commits of `half` adds + `half` deletes each. Returns
+    seed/churn wall times and the store's own flush-phase seconds."""
+    from bitcoincashplus_tpu.store.sharded import ShardedCoinsDB
+
+    db = ShardedCoinsDB(workdir, n_shards=n_shards)
+    best = b"\x11" * 32
+    t0 = time.perf_counter()
+    for lo in range(0, n_coins, chunk):
+        hi = min(lo + chunk, n_coins)
+        db.batch_write_serialized(
+            [(_utxo_key(i), _utxo_coin(i)) for i in range(lo, hi)], best)
+    seed_s = time.perf_counter() - t0
+
+    churn_wall = []
+    churn_flush = []
+    for r in range(rounds):
+        adds = range(n_coins + r * half, n_coins + (r + 1) * half)
+        dels = range(r * half, (r + 1) * half)
+        entries = [(_utxo_key(i), _utxo_coin(i)) for i in adds]
+        entries += [(_utxo_key(i), None) for i in dels]
+        ta = time.perf_counter()
+        db.batch_write_serialized(entries, best)
+        churn_wall.append(time.perf_counter() - ta)
+        churn_flush.append(db.last_flush["seconds"])
+    return db, {
+        "seed_s": round(seed_s, 3),
+        "seed_coins_per_s": round(n_coins / seed_s),
+        "churn_wall_s": round(sum(churn_wall), 3),
+        "churn_flush_s": round(sum(churn_flush), 4),
+        "churn_entries_per_s": round(rounds * 2 * half / sum(churn_wall)),
+        "flush_entries_per_s": round(rounds * 2 * half / sum(churn_flush)),
+    }
+
+
+def bench_utxo_store():
+    """ISSUE 13 satellite metric: sharded chainstate flush throughput (4
+    shards vs the single-shard degenerate case) over a million-coin
+    churn, snapshot dump/load rates at the same scale, and the snapshot
+    path's time-to-first-RPC. Writes BENCH_r12.json."""
+    import shutil
+    import tempfile
+
+    from bitcoincashplus_tpu.store import snapshot as snapshot_mod
+    from bitcoincashplus_tpu.store.sharded import ShardedCoinsDB
+
+    n_coins = int(os.environ.get("BCP_BENCH_UTXO_COINS", "1000000"))
+    chunk = 100_000
+    rounds = 4
+    half = max(1, min(50_000, n_coins // (2 * rounds)))
+    workdir = tempfile.mkdtemp(prefix="bcp-utxostore-")
+    try:
+        configs = {}
+        snap_stats = {}
+        for n_shards in (1, 4):
+            d = os.path.join(workdir, f"s{n_shards}")
+            db, stats = _churn_store(d, n_shards, n_coins, chunk,
+                                     rounds, half)
+            configs[str(n_shards)] = stats
+            if n_shards != 4:
+                db.close()
+                continue
+            # snapshot round-trip from the 4-shard store at full size
+            live = db.count_coins()
+            best = db.best_block()
+            digest = db.muhash_digest()
+            snap_dir = os.path.join(workdir, "snap")
+            ta = time.perf_counter()
+            snapshot_mod.dump_snapshot(db, snap_dir, [bytes(80)], 0,
+                                       best, "regtest")
+            dump_s = time.perf_counter() - ta
+            db.close()
+            dst = ShardedCoinsDB(os.path.join(workdir, "dst"), n_shards=4)
+            tb = time.perf_counter()
+            snapshot_mod.load_snapshot(snap_dir, dst, "regtest",
+                                       expected_hash=best,
+                                       expected_digest=digest)
+            load_s = time.perf_counter() - tb
+            # first RPC off the snapshot: a point read at the new tip
+            probe = _utxo_key(n_coins + rounds * half - 1)  # churn survivor
+            tc = time.perf_counter()
+            got = dst.get_serialized_many([probe])
+            first_read_s = time.perf_counter() - tc
+            assert probe in got
+            dst.close()
+            snap_stats = {
+                "coins": live,
+                "dump_s": round(dump_s, 3),
+                "dump_coins_per_s": round(live / dump_s),
+                "load_s": round(load_s, 3),
+                "load_coins_per_s": round(live / load_s),
+                "first_read_after_load_s": round(first_read_s, 6),
+                "time_to_first_rpc_s": round(load_s + first_read_s, 3),
+            }
+        flush_speedup = round(
+            configs["4"]["flush_entries_per_s"]
+            / max(configs["1"]["flush_entries_per_s"], 1), 4)
+        commit_speedup = round(
+            configs["4"]["churn_entries_per_s"]
+            / max(configs["1"]["churn_entries_per_s"], 1), 4)
+        result = {
+            "metric": "utxo_store",
+            **_bench_stamp(),
+            "coins": n_coins,
+            "churn": {"rounds": rounds, "adds": half, "deletes": half},
+            "shards": configs,
+            "flush_speedup_4v1": flush_speedup,
+            "commit_speedup_4v1": commit_speedup,
+            "meets_1_5x_bar": flush_speedup >= 1.5,
+            "snapshot": snap_stats,
+            "note": "flush_* = the parallel per-shard apply phase "
+                    "(journals/manifest/accumulator excluded — those are "
+                    "identical work at any fanout); commit_* = whole "
+                    "batch_write_serialized wall. On a single-core host "
+                    "the fanout win is bounded by the fsync/IO fraction "
+                    "of the flush (sqlite page work serializes on the "
+                    "one core) — the 1.5x bar presumes cores >= shards. "
+                    "time_to_first_rpc_s = snapshot load + first point "
+                    "read — the assumeutxo serve point; a full IBD "
+                    "instead scales with chain length (see BENCH.md "
+                    "reindex numbers), not UTXO size.",
+        }
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_r12.json"), "w") as f:
+            json.dump(result, f, indent=1)
+            f.write("\n")
+        emit("utxo_store_flush_speedup_4v1", flush_speedup, "x",
+             flush_speedup,
+             **{k: v for k, v in result.items() if k != "metric"})
+        return {"utxo_store_flush_speedup_4v1": flush_speedup,
+                "utxo_snapshot_load_coins_per_s":
+                    snap_stats.get("load_coins_per_s")}
+    except Exception as e:  # pragma: no cover - diagnostics only
+        emit("utxo_store_flush_speedup_4v1", -1, "x", 0.0,
+             error=f"{type(e).__name__}: {e}")
         return None
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
@@ -1816,6 +1979,11 @@ def main():
     except Exception as e:  # pragma: no cover - diagnostics only
         emit("mining_resident_speedup", -1, "x", 0.0,
              error=f"{type(e).__name__}: {e}")
+    try:
+        recap.update(bench_utxo_store() or {})  # ISSUE 13: sharded store
+    except Exception as e:  # pragma: no cover - diagnostics only
+        emit("utxo_store_flush_speedup_4v1", -1, "x", 0.0,
+             error=f"{type(e).__name__}: {e}")
     recap.update(bench_telemetry_overhead() or {})  # ISSUE 6: < 2% budget
     recap.update(bench_serving() or {})  # ISSUE 7: serviced >= 2x sync
     try:
@@ -1840,5 +2008,7 @@ if __name__ == "__main__":
         bench_fork_storm()
     elif len(sys.argv) > 1 and sys.argv[1] == "mining":
         bench_mining()
+    elif len(sys.argv) > 1 and sys.argv[1] == "utxo_store":
+        bench_utxo_store()
     else:
         main()

@@ -36,6 +36,17 @@ Options:
   -txindex               Maintain a full transaction index (default: 0)
   -par=<n>               Script verification batch backend threads; 0 = auto (default: 0)
   -dbcache=<n>           Database cache size in MiB (default: 300)
+  -coinshards=<n>        Hash-partition fan-out of the sharded chainstate
+                         store: power of two in [1, 256] (default: 4). An
+                         existing sharded datadir's manifest pins the count;
+                         legacy single-file datadirs stay on the old layout
+                         until -reindex
+  -assumeutxo=<hash:muhash>  Authorize loadtxoutset to adopt a UTXO snapshot
+                         with exactly this tip block hash and MuHash set
+                         digest (both 32-byte hex). The node serves at the
+                         snapshot tip immediately while background
+                         validation replays history into a shadow
+                         chainstate and promotes on digest equality
   -checkblocks=<n>       How many blocks to verify at startup (default: 6)
   -checklevel=<n>        How thorough the startup block verification is (0-4, default: 3)
   -assumevalid=<hex>     Skip script verification for ancestors of this block

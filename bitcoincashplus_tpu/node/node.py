@@ -28,6 +28,8 @@ from ..mining.generate import MAX_TRIES_DEFAULT, mine_block
 from ..store.blockstore import BlockStore
 from ..store.chainstatedb import BlockIndexDB, CoinsDB
 from ..store.kvstore import KVStore
+from ..store.sharded import MANIFEST_NAME as _COINS_MANIFEST
+from ..store.sharded import ShardedCoinsDB
 from ..util import telemetry
 from ..util.log import log_init, log_print, log_printf
 from ..validation.chain import BlockStatus
@@ -51,6 +53,39 @@ class _NativeImportAbort(Exception):
     """A staged fast-import block's signature batch failed after commit —
     recover by rebuilding from the last flush and replaying through the
     Python engine (node.import_block_files)."""
+
+
+class _ShadowBlockStore:
+    """Block-store facade for the assumeutxo shadow chainstate: reads
+    delegate to the node's real store (under cs_main — BlockStore file
+    handles aren't thread-safe against the main validation path), every
+    write is a no-op (the real store already holds the data; the shadow
+    exists only to re-derive the UTXO set)."""
+
+    def __init__(self, node: "Node"):
+        self._node = node
+
+    def get_block(self, h: bytes):
+        with self._node.cs_main:
+            return self._node.block_store.get_block(h)
+
+    def have_block(self, h: bytes) -> bool:
+        return self.get_block(h) is not None
+
+    def put_block(self, h: bytes, raw: bytes) -> None:
+        pass
+
+    def put_undo(self, h: bytes, raw: bytes) -> None:
+        pass
+
+    def get_undo(self, h: bytes):
+        return None
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
 
 
 class Node:
@@ -143,6 +178,29 @@ class Node:
         index_path = os.path.join(blocks_dir, "index.sqlite")
         coins_path = os.path.join(self.datadir, "chainstate.sqlite")
         journal_path = os.path.join(self.datadir, "chainstate.journal")
+        # -coinshards=<n>: hash-partition fan-out for the sharded coins
+        # store (power of two, 1..256; validated by ShardedCoinsDB). An
+        # existing sharded datadir's manifest pins the count — the flag
+        # only picks the layout for a fresh datadir or a -reindex.
+        coinshards = config.get_int("coinshards", 4)
+        # -assumeutxo=<blockhash>:<muhash>: authorize loadtxoutset to
+        # adopt a UTXO snapshot with exactly this tip hash and set digest
+        # (both 32-byte hex, display order). Without it, loadtxoutset is
+        # refused — snapshot trust is an explicit operator decision.
+        self.assumeutxo: Optional[tuple[bytes, bytes]] = None
+        au = config.get("assumeutxo", "")
+        if au:
+            try:
+                h_hex, _, d_hex = au.partition(":")
+                h_raw, d_raw = bytes.fromhex(h_hex), bytes.fromhex(d_hex)
+                if len(h_raw) != 32 or len(d_raw) != 32:
+                    raise ValueError
+            except ValueError:
+                raise ConfigError(
+                    f"-assumeutxo={au!r}: expected "
+                    "<blockhash_hex>:<muhash_hex> (32 bytes each)")
+            # display order -> internal little-endian hash
+            self.assumeutxo = (h_raw[::-1], d_raw)
         if reindex:
             # wipe the derived state; blk*.dat files are the source of truth
             for p in (index_path, coins_path):
@@ -152,6 +210,11 @@ class Node:
             for p in (journal_path, journal_path + ".tmp"):
                 if os.path.exists(p):
                     os.remove(p)
+            ShardedCoinsDB.wipe(self.datadir)
+            import shutil as _shutil
+
+            _shutil.rmtree(os.path.join(self.datadir, "chainstate_shadow"),
+                           ignore_errors=True)
             # undo data is derived too: the import rebuilds every record,
             # and the wiped undo_positions would otherwise leave the old
             # records stranded in the rev files forever (the reference
@@ -165,7 +228,6 @@ class Node:
 
         os.makedirs(blocks_dir, exist_ok=True)
         self._index_kv = KVStore(index_path)
-        self._coins_kv = KVStore(coins_path)
         # -maxblockfilesize: test/debug knob for block-file rotation (the
         # reference's MAX_BLOCKFILE_SIZE constant) — lets functional tests
         # exercise pruning without writing 128 MiB of chain
@@ -179,8 +241,39 @@ class Node:
         # durable (fsync-before-rename) before it touches the DB, and
         # ChainstateManager replays/rolls back the journal at startup —
         # a crash at ANY point inside a commit leaves the UTXO set at
-        # exactly the pre- or post-block state, never a torn mix
-        self.coins_db = CoinsDB(self._coins_kv, journal_path=journal_path)
+        # exactly the pre- or post-block state, never a torn mix.
+        # Layout selection: a datadir with the legacy single chainstate
+        # file and no shard manifest keeps the old CoinsDB unchanged (the
+        # 1-shard degenerate case with the old paths); everything else —
+        # fresh datadirs, -reindex, existing sharded datadirs — goes
+        # through the sharded facade (store/sharded.py).
+        manifest_path = os.path.join(self.datadir, _COINS_MANIFEST)
+        if os.path.exists(coins_path) and not os.path.exists(manifest_path):
+            self._coins_kv: Optional[KVStore] = KVStore(coins_path)
+            self.coins_db = CoinsDB(self._coins_kv,
+                                    journal_path=journal_path)
+            log_printf("chainstate: legacy single-file layout "
+                       "(-reindex migrates to the sharded store)")
+        else:
+            self._coins_kv = None
+            try:
+                self.coins_db = ShardedCoinsDB(self.datadir,
+                                               n_shards=coinshards)
+            except ValueError as e:
+                raise ConfigError(f"-coinshards={coinshards}: {e}")
+            if self.coins_db.n_shards != coinshards:
+                log_printf("chainstate: manifest pins %d shard(s) "
+                           "(-coinshards=%d ignored)",
+                           self.coins_db.n_shards, coinshards)
+        # assumeutxo bookkeeping: a loaded-but-unvalidated snapshot serves
+        # RPC at its tip while a background thread re-validates history
+        # into a shadow chainstate (load_utxo_snapshot / _snapshot_verify)
+        self.snapshot_state: Optional[dict] = getattr(
+            self.coins_db, "snapshot_state", None)
+        self._snapshot_pending = bool(
+            self.snapshot_state
+            and not self.snapshot_state.get("validated"))
+        self._snapshot_thread: Optional[threading.Thread] = None
 
         # -maxsigcachesize=<MiB>: byte budget for the signature cache
         # (src/init.cpp DEFAULT_MAX_SIG_CACHE_SIZE). The entry cap is
@@ -332,6 +425,12 @@ class Node:
         if loaded:
             log_printf("block index loaded: tip height %d",
                        self.chainstate.tip().height)
+        if self._snapshot_pending and loaded:
+            # restart mid-assumeutxo: headers along the snapshot chain
+            # have no block data yet, so load_block_index left their
+            # chain_tx at 0 and parked every descendant — restore the
+            # fake linkage before candidate selection runs
+            self._fake_snapshot_chaintx()
 
         if reindex:
             n = self.import_block_files()
@@ -349,10 +448,17 @@ class Node:
             log_printf("-loadblock: imported %d blocks, tip height %d",
                        n, self.chainstate.tip().height)
 
-        self.verify_db(
-            n_blocks=config.get_int("checkblocks", 6),
-            level=config.get_int("checklevel", 3),
-        )
+        if self._snapshot_pending:
+            # -checkblocks replays recent blocks from local data; below an
+            # unvalidated snapshot tip there is none yet. The background
+            # verify thread is the (much stronger) integrity check here.
+            log_printf("assumeutxo: skipping -checkblocks replay — "
+                       "history below the snapshot tip is not local yet")
+        else:
+            self.verify_db(
+                n_blocks=config.get_int("checkblocks", 6),
+                level=config.get_int("checklevel", 3),
+            )
 
         self.mempool = CTxMemPool(
             max_size_bytes=config.get_int("maxmempool", 300) * 1_000_000,
@@ -368,6 +474,7 @@ class Node:
         telemetry.register_collector("pipeline", self._pipeline_families)
         telemetry.register_collector("mempool", self._mempool_families)
         telemetry.register_collector("mining", self._mining_families)
+        telemetry.register_collector("store", self._store_families)
         if self.sigservice is not None:
             telemetry.register_collector("serving", self._serving_families)
         # P2P adversarial-supervision limits (p2p/connman.py): the
@@ -474,6 +581,12 @@ class Node:
 
             load_mempool(self, self._mempool_dat)
 
+        if self._snapshot_pending:
+            # restart with an unvalidated snapshot: resume background
+            # history validation (the shadow chainstate persisted its own
+            # progress, so this picks up where the last run stopped)
+            self._start_snapshot_verify()
+
     # -- telemetry collectors (util/telemetry registry) -----------------
 
     def _sigcache_families(self) -> list:
@@ -527,6 +640,30 @@ class Node:
             "bcp_mining_state", scalars, typ="gauge",
             help="device-resident mining loop state (template generation, "
                  "segment pipeline, candidate FIFO, rollover passes)")
+
+    def _store_families(self) -> list:
+        # bcp_store_state_* prefix: the NATIVE bcp_store_flush_seconds
+        # histogram and bcp_store_shard_bytes gauge (store/sharded
+        # module-level) own their names — this collector only projects
+        # the facade's scalar state (same PR 6 name-ownership lesson as
+        # the mining/serving collectors).
+        stats_fn = getattr(self.coins_db, "stats", None)
+        if stats_fn is None:
+            scalars = {"shards": 1, "snapshot_pending": 0}
+        else:
+            s = stats_fn()
+            lf = s.get("last_flush") or {}
+            scalars = {
+                "shards": s["shards"],
+                "epoch": s["epoch"],
+                "last_flush_seconds": lf.get("seconds", 0.0),
+                "last_flush_coins": lf.get("coins", 0),
+                "snapshot_pending": 1 if self._snapshot_pending else 0,
+            }
+        return telemetry.flat_families(
+            "bcp_store_state", scalars, typ="gauge",
+            help="sharded chainstate facade state (fan-out, commit epoch, "
+                 "last flush, assumeutxo progress)")
 
     def _mempool_families(self) -> list:
         return [
@@ -828,11 +965,17 @@ class Node:
             return True
         from ..validation.coins import BlockUndo, CoinsCache
 
+        # blocks at or below an adopted snapshot tip carry no undo data
+        # (history was re-validated by digest in the shadow chainstate,
+        # never connected here) — the replay walk must stop above them
+        snap = getattr(self, "snapshot_state", None) or {}
+        floor = int(snap.get("height", 0))
+
         checked = 0
         idx = tip
         scratch = CoinsCache(cs.coins)
         to_reconnect = []
-        while idx is not None and idx.height > 0 and checked < n_blocks:
+        while idx is not None and idx.height > floor and checked < n_blocks:
             raw = cs.block_store.get_block(idx.hash)
             if raw is None:
                 raise InitError(f"VerifyDB: missing block data at height {idx.height}")
@@ -855,6 +998,226 @@ class Node:
         # scratch view is discarded — this was a read-only replay
         log_print("db", "VerifyDB: %d blocks verified at level %d", checked, level)
         return True
+
+    # -- assumeutxo snapshot onboarding ---------------------------------
+    # Reference: Bitcoin Core's assumeutxo (src/node/utxo_snapshot,
+    # doc/design/assumeutxo.md): adopt an operator-authorized UTXO
+    # snapshot at its tip and serve immediately, while a background
+    # chainstate re-validates all of history from genesis into a SHADOW
+    # store and promotes the node to fully-validated only when the
+    # shadow's recomputed set digest equals the snapshot's.
+
+    def store_info(self) -> dict:
+        """The gettpuinfo 'store' section."""
+        stats_fn = getattr(self.coins_db, "stats", None)
+        if stats_fn is None:
+            info: dict = {"backend": "single"}
+        else:
+            info = stats_fn()
+            info["backend"] = "sharded"
+        info["snapshot"] = self.snapshot_state
+        return info
+
+    def load_utxo_snapshot(self, path: str) -> dict:
+        """loadtxoutset: adopt the snapshot directory at ``path``.
+
+        Requires -assumeutxo authorization and a fresh node (tip still at
+        genesis). On return the node serves RPC at the snapshot tip;
+        history validation proceeds in the background."""
+        from ..consensus.block import CBlockHeader
+        from ..consensus.serialize import ByteReader
+        from ..store import snapshot as snapshot_mod
+        from ..validation.coins import CoinsCache
+
+        if self.assumeutxo is None:
+            raise ValueError(
+                "loadtxoutset requires -assumeutxo=<blockhash>:<muhash> "
+                "authorization")
+        if not isinstance(self.coins_db, ShardedCoinsDB):
+            raise ValueError("loadtxoutset requires the sharded chainstate "
+                             "layout (-reindex migrates legacy datadirs)")
+        exp_hash, exp_digest = self.assumeutxo
+        with self.cs_main:
+            if self.chainstate.tip().height != 0:
+                raise ValueError(
+                    "loadtxoutset requires a fresh node (tip at genesis)")
+            self.chainstate.flush()  # settle genesis state first
+            info = snapshot_mod.load_snapshot(
+                path, self.coins_db, self.params.network,
+                expected_hash=exp_hash, expected_digest=exp_digest)
+            cs = self.chainstate
+            # headers go through the normal PoW/contextual checks — the
+            # snapshot is trusted for the COIN SET only, never for work
+            for raw80 in info["headers"]:
+                hdr = CBlockHeader.deserialize(ByteReader(raw80))
+                if hdr.get_hash() in cs.block_index:
+                    continue  # genesis (and any already-known header)
+                cs.accept_block_header(hdr)
+            tip_idx = cs.block_index.get(info["best_block"])
+            if tip_idx is None or tip_idx.height != info["height"]:
+                raise snapshot_mod.SnapshotError(
+                    "snapshot headers do not reach the snapshot tip")
+            cs.chain.set_tip(tip_idx)
+            self._fake_snapshot_chaintx()
+            # fresh cache over the loaded store — the old one cached
+            # genesis-era state that the bulk load just superseded
+            cs.coins = CoinsCache(self.coins_db)
+            cs.flush()
+            self.snapshot_state = self.coins_db.snapshot_state
+            self._snapshot_pending = True
+            log_printf("assumeutxo: serving at snapshot tip %s (height %d)"
+                       " — background validation starting",
+                       hash_to_hex(tip_idx.hash)[:16], tip_idx.height)
+        with self.notify_cv:
+            self.notify_cv.notify_all()
+        self._start_snapshot_verify()
+        return {"height": info["height"],
+                "hash": info["manifest"]["best_block"],
+                "coins": info["manifest"]["coins"],
+                "muhash": info["manifest"]["muhash"]}
+
+    def _fake_snapshot_chaintx(self) -> None:
+        """Core's fake-nChainTx trick: blocks along the snapshot chain
+        have headers but (until backfill) no data, so their true tx counts
+        are unknown — stamp placeholder n_tx/chain_tx so candidate
+        selection and descendant linkage work above the snapshot tip.
+        Real counts overwrite the fakes as block data arrives."""
+        cs = self.chainstate
+        tip = cs.chain.tip()
+        if tip is None:
+            return
+        running = 0
+        for h in range(tip.height + 1):
+            idx = cs.chain[h]
+            if idx.n_tx == 0:
+                idx.n_tx = 1
+            running += idx.n_tx
+            idx.chain_tx = running
+            cs._dirty_index.add(idx)
+        # relink descendants parked behind chain_tx==0 ancestors
+        for h in range(tip.height + 1):
+            idx = cs.chain[h]
+            for child in cs._unlinked.pop(idx, []):
+                cs._link_chain_tx(child)
+
+    def _start_snapshot_verify(self) -> None:
+        if self._snapshot_thread is not None and \
+                self._snapshot_thread.is_alive():
+            return
+        self._snapshot_thread = threading.Thread(
+            target=self._snapshot_verify_loop,
+            name="assumeutxo-verify", daemon=True)
+        self._snapshot_thread.start()
+
+    def _snapshot_verify_loop(self) -> None:
+        """Background history validation (the assumeutxo promise).
+
+        A SHADOW chainstate — its own sharded coins store + block index
+        under datadir/chainstate_shadow, block/undo writes discarded —
+        replays every block genesis..snapshot-tip through the full
+        consensus path. Blocks not yet local are pulled from peers via
+        connman.request_backfill. On reaching the snapshot height the
+        shadow's recomputed MuHash digest must equal the snapshot digest;
+        only then is the chain marked fully validated. The shadow persists
+        its own progress, so a restart resumes instead of starting over."""
+        import shutil
+
+        state = dict(self.snapshot_state or {})
+        target_h = int(state["height"])
+        shadow_dir = os.path.join(self.datadir, "chainstate_shadow")
+        os.makedirs(shadow_dir, exist_ok=True)
+        shadow_coins = ShardedCoinsDB(
+            shadow_dir, n_shards=getattr(self.coins_db, "n_shards", 1))
+        shadow_index_kv = KVStore(os.path.join(shadow_dir, "index.sqlite"))
+        verifier = BlockScriptVerifier(self.params, backend=self.backend,
+                                       sigcache=SignatureCache(),
+                                       kernel=self.ecdsa_kernel)
+        shadow = ChainstateManager(
+            self.params, shadow_coins, _ShadowBlockStore(self),
+            script_verifier=verifier,
+            index_db=BlockIndexDB(shadow_index_kv))
+        # the shadow's ctor re-registered the pipeline watchdog against
+        # ITSELF (registration replaces by name) — restore the live
+        # manager's probe immediately
+        from ..util import devicewatch as _dw
+
+        _dw.WATCHDOG.register(
+            "pipeline",
+            pending_fn=lambda: len(self.chainstate._spec),
+            quiet_s=self.watchdog_quiet)
+        ok = False
+        try:
+            shadow.load_block_index()
+            h = shadow.tip().height + 1
+            if h > 1:
+                log_printf("assumeutxo: shadow validation resuming at "
+                           "height %d/%d", h, target_h)
+            since_flush = 0
+            while h <= target_h and not self.shutdown_event.is_set():
+                with self.cs_main:
+                    idx = self.chainstate.chain[h]
+                    raw = (self.block_store.get_block(idx.hash)
+                           if idx is not None else None)
+                if raw is None:
+                    # history not local yet — name the missing heights to
+                    # the P2P layer (header sync can't: peers announce
+                    # nothing below our locator's snapshot tip)
+                    missing = []
+                    with self.cs_main:
+                        for hh in range(h, min(h + 64, target_h + 1)):
+                            i2 = self.chainstate.chain[hh]
+                            if i2 is not None and \
+                                    not (i2.status & BlockStatus.HAVE_DATA):
+                                missing.append(i2.hash)
+                    if missing and self.connman is not None:
+                        self.connman.request_backfill(missing)
+                    self.shutdown_event.wait(0.25)
+                    continue
+                if not shadow.process_new_block(CBlock.from_bytes(raw)):
+                    log_printf("assumeutxo: shadow validation REJECTED "
+                               "block at height %d — snapshot chain is "
+                               "invalid, promotion abandoned", h)
+                    return
+                h += 1
+                since_flush += 1
+                if since_flush >= 64:
+                    shadow.flush()
+                    since_flush = 0
+            if h <= target_h:
+                return  # shutdown mid-validation: shadow resumes later
+            shadow.flush()
+            got = shadow_coins.muhash_digest().hex()
+            want = state["digest"]
+            if got != want or shadow.tip().hash != \
+                    bytes.fromhex(state["hash"])[::-1]:
+                log_printf("assumeutxo: DIGEST MISMATCH after full replay "
+                           "(got %s, snapshot %s) — the snapshot was bad; "
+                           "shutting down for manual intervention",
+                           got[:16], want[:16])
+                self.shutdown_event.set()
+                return
+            with self.cs_main:
+                cs = self.chainstate
+                for hh in range(1, target_h + 1):
+                    bidx = cs.chain[hh]
+                    bidx.raise_validity(BlockStatus.VALID_SCRIPTS)
+                    cs._dirty_index.add(bidx)
+                state["validated"] = True
+                self.coins_db.set_snapshot_state(state)
+                self.snapshot_state = state
+                self._snapshot_pending = False
+                cs.flush()
+            ok = True
+            log_printf("assumeutxo: background validation complete at "
+                       "height %d — digest matches, chain fully validated",
+                       target_h)
+        except Exception as e:  # noqa: BLE001 — thread must not die silent
+            log_printf("assumeutxo: shadow validation error: %r", e)
+        finally:
+            shadow_coins.close()
+            shadow_index_kv.close()
+            if ok:
+                shutil.rmtree(shadow_dir, ignore_errors=True)
 
     def import_block_files(self, paths: Optional[list[str]] = None) -> int:
         """LoadExternalBlockFile (src/validation.cpp:~4000) over every
@@ -1724,6 +2087,12 @@ class Node:
     def close(self) -> None:
         """Shutdown (src/init.cpp): stop servers, flush, close stores."""
         self.shutdown_event.set()
+        if self._snapshot_thread is not None:
+            # the verify thread checks shutdown_event between blocks and
+            # persists its shadow progress; it must not race the store
+            # closes below
+            self._snapshot_thread.join(timeout=30)
+            self._snapshot_thread = None
         if self._txindex_thread is not None:
             # the backfill thread checks shutdown_event between chunks and
             # must not race the kv-store closes below
@@ -1767,12 +2136,16 @@ class Node:
             self.chainstate.flush()
             self.block_store.close()
             self._index_kv.close()
-            self._coins_kv.close()
+            if self._coins_kv is not None:
+                self._coins_kv.close()
+            else:
+                self.coins_db.close()
         # drop this node's registry collectors: the bound methods would
         # otherwise keep the closed node's whole object graph (coins
         # cache, mempool, block index) alive in the process-global
         # REGISTRY for the rest of the process
-        for name in ("sigcache", "pipeline", "mempool", "serving", "mining"):
+        for name in ("sigcache", "pipeline", "mempool", "serving", "mining",
+                     "store"):
             telemetry.REGISTRY.unregister_collector(name)
         if self.resident_miner is not None:
             # drops the device template buffers and the miner watchdog

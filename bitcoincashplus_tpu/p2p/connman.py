@@ -572,6 +572,35 @@ class CConnman:
         else:
             self._request_blocks(peer, hashes)
 
+    def request_backfill(self, hashes: list[bytes]) -> None:
+        """Pull specific historical blocks (assumeutxo background sync).
+
+        Header sync can't drive this download: the snapshot node's locator
+        already contains the snapshot tip, so peers announce nothing below
+        it — the verify thread names the heights it is missing instead.
+        Thread-safe (called from the snapshot-verify thread); chunks are
+        spread round-robin across live peers and from there inherit all of
+        the normal in-flight dedupe, stall detection and re-request
+        routing."""
+        if self.loop is None or not hashes:
+            return
+        wanted = list(hashes)
+
+        def _go() -> None:
+            peers = [p for p in self.peers.values()
+                     if p.handshaked and not p.stalling and not p.discharged]
+            if not peers:
+                # no usable peer yet — park them; every future announcer
+                # (or redeemed staller) picks them up via _tick
+                self._unrequested.update(wanted)
+                return
+            for i, peer in enumerate(peers):
+                chunk = wanted[i::len(peers)]
+                if chunk:
+                    self._request_blocks(peer, chunk)
+
+        self.loop.call_soon_threadsafe(_go)
+
     def _note_block_arrival(self, peer: Peer, h: bytes,
                             wire_bytes: int = 0,
                             now: Optional[float] = None) -> None:

@@ -307,22 +307,65 @@ def gettxoutsetinfo(node, params):
     for op, coin in _iterate_coins(node):
         n += 1
         total += coin.out.value
-    return {
+    out = {
         "height": cs.chain.height(),
         "bestblock": hash_to_hex(cs.tip().hash),
         "txouts": n,
         "total_amount": total / 1e8,
     }
+    # the incremental MuHash set digest (sharded store only — the legacy
+    # single-file layout predates accumulator maintenance)
+    digest_fn = getattr(node.coins_db, "muhash_digest", None)
+    if digest_fn is not None:
+        out["muhash"] = digest_fn().hex()
+        out["shards"] = node.coins_db.n_shards
+        out["epoch"] = node.coins_db.epoch
+    return out
 
 
 def _iterate_coins(node):
+    import struct
+
     from ..validation.coins import Coin
 
-    for k, v in node.coins_db.kv.iterate(b"C"):
-        import struct
-
-        op = COutPoint(k[1:33], struct.unpack("<I", k[33:37])[0])
+    # facade-uniform iteration (CoinsDB and ShardedCoinsDB both expose
+    # iterate_coins) — never reach into a .kv that sharded stores lack
+    for k36, v in node.coins_db.iterate_coins():
+        op = COutPoint(k36[:32], struct.unpack("<I", k36[32:36])[0])
         yield op, Coin.deserialize(v)
+
+
+@rpc_method("dumptxoutset")
+def dumptxoutset(node, params):
+    require_params(params, 1, 1, "dumptxoutset \"path\"")
+    cs = node.chainstate
+    cs.flush()  # the snapshot is cut from the PERSISTED set
+    tip = cs.tip()
+    headers = [cs.chain[h].header.serialize() for h in range(tip.height + 1)]
+    from ..store import snapshot as snapshot_mod
+
+    manifest = snapshot_mod.dump_snapshot(
+        node.coins_db, str(params[0]), headers, tip.height, tip.hash,
+        node.params.network)
+    return {
+        "path": str(params[0]),
+        "height": manifest["height"],
+        "bestblock": manifest["best_block"],
+        "coins": manifest["coins"],
+        "muhash": manifest["muhash"],
+        "nfiles": len(manifest["files"]),
+    }
+
+
+@rpc_method("loadtxoutset")
+def loadtxoutset(node, params):
+    require_params(params, 1, 1, "loadtxoutset \"path\"")
+    from ..store.snapshot import SnapshotError
+
+    try:
+        return node.load_utxo_snapshot(str(params[0]))
+    except (SnapshotError, ValueError, OSError) as e:
+        raise RPCError(RPC_MISC_ERROR, f"loadtxoutset: {e}")
 
 
 @rpc_method("invalidateblock")

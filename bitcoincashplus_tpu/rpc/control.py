@@ -111,7 +111,9 @@ def gettpuinfo(node, params):
     enqueue->verdict wait quantiles), and — when P2P is running — the
     peer-supervision ledger (``net``: misbehavior charges, discharge
     reasons, stall re-requests, flood charges, orphan pool accounting,
-    banlist size)."""
+    banlist size), plus the sharded chainstate store (``store``: shard
+    fan-out, commit epoch, MuHash set digest, last parallel flush,
+    assumeutxo snapshot progress — store/sharded.py)."""
     from ..ops import dispatch, ecdsa_batch
     from ..util import faults
 
@@ -148,6 +150,11 @@ def gettpuinfo(node, params):
                      if hasattr(node.chainstate, "pipeline_snapshot")
                      else {}),
         "bip30": dict(getattr(node.chainstate, "bip30_stats", {})),
+        # the sharded chainstate facade (store/sharded): fan-out, commit
+        # epoch, set digest, last parallel flush, assumeutxo progress;
+        # getattr-guarded for harness stubs and legacy single-file nodes
+        "store": (node.store_info()
+                  if hasattr(node, "store_info") else {}),
         "net": (node.connman.net_snapshot()
                 if getattr(node, "connman", None) is not None else {}),
         # the always-on signature service (serving/sigservice): flush

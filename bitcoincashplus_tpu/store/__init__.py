@@ -9,10 +9,19 @@ Reference mapping (SURVEY.md §3.1):
     same crash-safety contract (flush cadence + best-block marker).
   - chainstatedb.py — the coins DB ('chainstate') and block index DB
     (src/txdb.{h,cpp} CCoinsViewDB / CBlockTreeDB) on top of kvstore.
+  - sharded.py — ShardedCoinsDB: N hash-partitioned coins backends behind
+    one CoinsView facade (parallel journaled flush, cross-shard epoch
+    manifest, incremental MuHash set accumulator).
+  - muhash.py — the multiplicative UTXO-set hash (MuHash3072-shaped;
+    numpy limb batch products) shards and snapshots are committed to.
+  - snapshot.py — dumptxoutset/loadtxoutset serialization (per-shard
+    streams + digest-stamped manifest, the assumeutxo onboarding format).
 """
 
 from .blockstore import BlockStore, MemoryBlockStore
 from .kvstore import KVStore
 from .chainstatedb import CoinsDB, BlockIndexDB
+from .sharded import ShardedCoinsDB
 
-__all__ = ["BlockStore", "MemoryBlockStore", "KVStore", "CoinsDB", "BlockIndexDB"]
+__all__ = ["BlockStore", "MemoryBlockStore", "KVStore", "CoinsDB",
+           "BlockIndexDB", "ShardedCoinsDB"]

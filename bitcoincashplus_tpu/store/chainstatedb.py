@@ -188,6 +188,12 @@ class CoinsDB(CoinsView):
     def count_coins(self) -> int:
         return sum(1 for _ in self.kv.iterate(_COIN))
 
+    def iterate_coins(self) -> Iterator[tuple[bytes, bytes]]:
+        """(key36, coin_ser) rows — the facade-uniform iteration surface
+        shared with ShardedCoinsDB (gettxoutsetinfo, snapshot dump)."""
+        for k, v in self.kv.iterate(_COIN):
+            yield k[1:], v
+
     # -- raw-key entry points for the native connect engine --------------
     # (native/connect.cpp speaks 36-byte outpoint keys + Coin.serialize
     # blobs; these avoid a COutPoint/Coin object round trip per row)

@@ -58,6 +58,13 @@ NET_SITE = "net"
 # device-decompose leg specifically (ISSUE 11): fail-* proves the
 # device-decompose -> host-decompose rung, poison-output proves the KAT
 # gate; also explicit-only, for the same reason.
+# "store_shard" (store/sharded.STORE_SHARD_SITE) fires at the head of
+# every shard's journal leg inside a sharded chainstate commit: fail-*
+# proves one failing shard aborts the WHOLE commit with the already-
+# written journals unlinked (no shard ever ahead of the manifest epoch),
+# latency-spike models one slow shard dragging the parallel flush.
+# Explicit-only: "all" must keep meaning the accelerator subsystems so
+# the dead-backend drills don't suddenly fail chainstate flushes.
 
 
 class InjectedFault(RuntimeError):

@@ -79,9 +79,13 @@ def pytest_collection_modifyitems(config, items):
             if item.get_closest_marker("telemetry"):
                 return 2
             return 1 if item.get_closest_marker("pipeline") else 0
+        # the ``snapshot`` onboarding test runs after the plain
+        # functional group, then adversarial, then forkstorm dead last
         if item.get_closest_marker("forkstorm"):
+            return 9
+        if item.get_closest_marker("adversarial"):
             return 8
-        return 7 if item.get_closest_marker("adversarial") else 6
+        return 7 if item.get_closest_marker("snapshot") else 6
 
     items.sort(key=group)
 

@@ -56,11 +56,24 @@ RING = [(0, 1), (1, 2), (2, 3), (3, 0)]
 
 
 def _chainstate_dict(datadir: str) -> dict[bytes, bytes]:
+    """Coin rows + best-block marker merged across the (possibly
+    sharded) chainstate layout. Per-shard epoch/accumulator meta is
+    excluded — flush cadence legitimately differs between nodes; only
+    the coin set and tip marker are consensus."""
+    import glob
+
     from bitcoincashplus_tpu.store.kvstore import KVStore
 
-    kv = KVStore(os.path.join(datadir, "chainstate.sqlite"))
-    out = dict(kv.iterate())
-    kv.close()
+    paths = sorted(glob.glob(
+        os.path.join(datadir, "chainstate.shard*.sqlite"))) or \
+        [os.path.join(datadir, "chainstate.sqlite")]
+    out: dict[bytes, bytes] = {}
+    for p in paths:
+        kv = KVStore(p)
+        for k, v in kv.iterate():
+            if k[:1] == b"C" or k == b"B":
+                out[k] = v
+        kv.close()
     return out
 
 

@@ -48,11 +48,23 @@ VICTIM_ARGS = [
 
 
 def _chainstate_dict(datadir: str) -> dict[bytes, bytes]:
+    """Coin rows + best-block marker merged across the (possibly
+    sharded) chainstate layout — per-shard epoch/accumulator meta is
+    node-local (flush cadence), so only C/B rows are compared."""
+    import glob
+
     from bitcoincashplus_tpu.store.kvstore import KVStore
 
-    kv = KVStore(os.path.join(datadir, "chainstate.sqlite"))
-    out = dict(kv.iterate())
-    kv.close()
+    paths = sorted(glob.glob(
+        os.path.join(datadir, "chainstate.shard*.sqlite"))) or \
+        [os.path.join(datadir, "chainstate.sqlite")]
+    out: dict[bytes, bytes] = {}
+    for p in paths:
+        kv = KVStore(p)
+        for k, v in kv.iterate():
+            if k[:1] == b"C" or k == b"B":
+                out[k] = v
+        kv.close()
     return out
 
 

@@ -1,0 +1,90 @@
+"""assumeutxo snapshot onboarding, end to end over real bcpd processes.
+
+Node A mines a chain and dumps a UTXO snapshot; node B — restarted with
+the matching ``-assumeutxo=<hash>:<digest>`` authorization — loads it and
+must serve RPC at the snapshot tip BEFORE any peer connection exists,
+then converge: once connected to A, the background shadow chainstate
+backfills and re-validates all of history and promotes the node to fully
+validated with a byte-identical set digest. (qa analogue:
+feature_assumeutxo.py in the reference's functional suite.)
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from bitcoincashplus_tpu.consensus.params import regtest_params
+from bitcoincashplus_tpu.wallet.keys import CKey
+
+from .framework import FunctionalFramework, connect_nodes, wait_until
+
+pytestmark = [pytest.mark.functional, pytest.mark.snapshot]
+
+KEY = CKey(0x5A57)
+ADDR = KEY.p2pkh_address(regtest_params())
+
+CHAIN_H = 30
+
+
+def test_snapshot_onboarding_and_background_validation():
+    with FunctionalFramework(num_nodes=2) as f:
+        a, b = f.nodes
+        a.rpc.generatetoaddress(CHAIN_H, ADDR)
+        tip_info = a.rpc.gettxoutsetinfo()
+        assert tip_info["height"] == CHAIN_H
+        snap_path = os.path.join(a.datadir, "utxo-snapshot")
+        dump = a.rpc.dumptxoutset(snap_path)
+        assert dump["height"] == CHAIN_H
+        assert dump["muhash"] == tip_info["muhash"]
+
+        # an unauthorized node must refuse the snapshot outright
+        with pytest.raises(Exception, match="assumeutxo"):
+            b.rpc.loadtxoutset(snap_path)
+
+        # restart B with the matching authorization and load
+        b.stop()
+        b.extra_args.append(
+            f"-assumeutxo={dump['bestblock']}:{dump['muhash']}")
+        b.start()
+        res = b.rpc.loadtxoutset(snap_path)
+        assert res["height"] == CHAIN_H
+        assert res["coins"] == dump["coins"]
+
+        # the assumeutxo promise: B serves at the snapshot tip with NO
+        # peer connection and NO local history
+        assert b.rpc.getblockcount() == CHAIN_H
+        assert b.rpc.getbestblockhash() == dump["bestblock"]
+        cb1 = a.rpc.getblock(a.rpc.getblockhash(1))["tx"][0]
+        out = b.rpc.gettxout(cb1, 0)
+        assert out is not None and out["coinbase"]
+        assert b.rpc.gettxoutsetinfo()["muhash"] == dump["muhash"]
+        store = b.rpc.gettpuinfo()["store"]
+        assert store["snapshot"]["validated"] is False
+
+        # connect: the background shadow chainstate names the missing
+        # heights to the P2P layer (request_backfill), replays history,
+        # and promotes on digest equality
+        connect_nodes(b, a)
+        wait_until(
+            lambda: b.rpc.gettpuinfo()["store"]["snapshot"]["validated"],
+            timeout=180, sleep=1.0)
+
+        # fully validated: the shadow is retired and B extends normally
+        assert not os.path.exists(
+            os.path.join(b.datadir, "chainstate_shadow"))
+        a.rpc.generatetoaddress(2, ADDR)
+        wait_until(lambda: b.rpc.getblockcount() == CHAIN_H + 2,
+                   timeout=60)
+        assert b.rpc.getbestblockhash() == a.rpc.getbestblockhash()
+        ia, ib = a.rpc.gettxoutsetinfo(), b.rpc.gettxoutsetinfo()
+        assert ia["muhash"] == ib["muhash"]
+        assert ia["bestblock"] == ib["bestblock"]
+
+        # and the onboarding survives a restart as a VALIDATED node
+        # (normal startup path: -checkblocks replay above the snapshot)
+        b.stop()
+        b.start()
+        assert b.rpc.getblockcount() == CHAIN_H + 2
+        assert b.rpc.gettpuinfo()["store"]["snapshot"]["validated"] is True

@@ -175,6 +175,116 @@ def test_uninjected_commit_completes(tmp_path):
     assert not os.path.exists(journal)
 
 
+# ---------------------------------------------------------------------------
+# Sharded drill: the same hard-kill acceptance matrix over the N-shard
+# facade (store/sharded.py). The commit is a multi-file protocol —
+# per-shard journals (sequential) -> parallel sqlite applies -> epoch
+# manifest -> journal clear — so the contract widens: the recovered store
+# must land on a whole pre- or post-batch state ACROSS ALL SHARDS, with
+# the incremental accumulator equal to a from-scratch recompute and no
+# shard ever ahead of the recovered epoch.
+# ---------------------------------------------------------------------------
+
+SHARDED_WORKER = f"""
+import struct, sys
+sys.path.insert(0, {REPO!r})
+from bitcoincashplus_tpu.store.sharded import ShardedCoinsDB
+datadir, n = sys.argv[1], int(sys.argv[2])
+key = lambda i: bytes([i % 251]) * 32 + struct.pack("<I", i)
+coin = lambda i: bytes([2, 5, 20]) + bytes([i % 256]) * 20
+db = ShardedCoinsDB(datadir, n_shards=n)
+entries = [(key(i), coin(i)) for i in range(40, 60)]
+entries += [(key(i), None) for i in range(0, 10)]
+db.batch_write_serialized(entries, b"\\x22" * 32)
+"""
+
+# (step, expected-state-fn(n_shards)): before ANY journal is durable the
+# batch never happened; with only SOME journals durable (journal:durable
+# fires after each shard's leg — the kill lands after shard 0's) the
+# partial set must roll back, except at 1 shard where "some" == "all";
+# from the all-journals-durable barrier on, recovery replays forward.
+SHARDED_STEPS = [
+    ("journal:tmp-written", lambda n: "pre"),
+    ("journal:durable", lambda n: "post" if n == 1 else "pre"),
+    ("shard:journals-durable", lambda n: "post"),
+    ("kv:begin", lambda n: "post"),
+    ("kv:applied", lambda n: "post"),
+    ("kv:committed", lambda n: "post"),
+    ("shard:applied", lambda n: "post"),
+    ("manifest:written", lambda n: "post"),
+    ("journal:pre-clear", lambda n: "post"),
+]
+
+
+def _skey(i: int) -> bytes:
+    import struct
+
+    return bytes([i % 251]) * 32 + struct.pack("<I", i)
+
+
+def _scoin(i: int) -> bytes:
+    return bytes([2, 5, 20]) + bytes([i % 256]) * 20
+
+
+def _seed_sharded(tmp_path, n_shards: int) -> str:
+    from bitcoincashplus_tpu.store.sharded import ShardedCoinsDB
+
+    datadir = str(tmp_path)
+    db = ShardedCoinsDB(datadir, n_shards=n_shards)
+    db.batch_write_serialized(
+        [(_skey(i), _scoin(i)) for i in range(40)], b"\x11" * 32)
+    db.close()
+    return datadir
+
+def _assert_sharded_state(datadir: str, n_shards: int, expect: str, ctx):
+    from bitcoincashplus_tpu.store.sharded import ShardedCoinsDB
+
+    db = ShardedCoinsDB(datadir, n_shards=n_shards)
+    db.recover_journal()
+    want_keys = (set(range(40)) if expect == "pre"
+                 else set(range(10, 60)))
+    want_best = b"\x11" * 32 if expect == "pre" else b"\x22" * 32
+    rows = dict(db.iterate_coins())
+    assert set(rows) == {_skey(i) for i in want_keys}, ctx
+    assert all(rows[_skey(i)] == _scoin(i) for i in want_keys), ctx
+    assert db.best_block() == want_best, ctx
+    # accumulator recovered alongside the rows, and every shard sits at
+    # the recovered epoch — no shard ahead, no journal left behind
+    assert db.muhash_digest() == db.recompute_digest(), ctx
+    for i in range(n_shards):
+        assert db._shard_epoch(i) <= db.epoch, ctx
+        assert not os.path.exists(db.shards[i].journal_path), ctx
+    db.close()
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 4])
+@pytest.mark.parametrize("step,expect_fn", SHARDED_STEPS)
+def test_sharded_crash_at_every_step(tmp_path, n_shards, step, expect_fn):
+    """Hard-kill the sharded commit at ``step`` for every shard count;
+    recovery must land on a whole cross-shard state."""
+    datadir = _seed_sharded(tmp_path, n_shards)
+    env = dict(os.environ)
+    env["BCP_FAULT_CRASH"] = step
+    proc = subprocess.run(
+        [sys.executable, "-c", SHARDED_WORKER, datadir, str(n_shards)],
+        env=env, capture_output=True, timeout=120,
+    )
+    assert proc.returncode == 137, (step, proc.stderr.decode()[-500:])
+    _assert_sharded_state(datadir, n_shards, expect_fn(n_shards),
+                          (step, n_shards))
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 4])
+def test_sharded_uninjected_commit_completes(tmp_path, n_shards):
+    datadir = _seed_sharded(tmp_path, n_shards)
+    proc = subprocess.run(
+        [sys.executable, "-c", SHARDED_WORKER, datadir, str(n_shards)],
+        env=dict(os.environ), capture_output=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+    _assert_sharded_state(datadir, n_shards, "post", n_shards)
+
+
 def test_chainstate_manager_replays_journal_at_startup(tmp_path):
     """The startup replay path (validation/chainstate.py): a journal left
     by a crash is applied before the chainstate reads anything."""

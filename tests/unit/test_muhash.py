@@ -1,0 +1,128 @@
+"""MuHash3072 accumulator algebra (store/muhash.py).
+
+The sharded chainstate's set digest must be a true multiset homomorphism:
+order/partition independent, invertible, and the numpy limb batch-product
+path must agree bit-for-bit with the python-int reference. These are the
+properties the cross-shard digest, snapshot verification, and the
+incremental commit-time maintenance all lean on.
+"""
+
+import random
+
+import pytest
+
+from bitcoincashplus_tpu.store import muhash
+
+
+def _rand_elems(rng, n):
+    return [muhash.element(rng.randbytes(rng.randint(1, 80)))
+            for _ in range(n)]
+
+
+class TestElement:
+    def test_element_is_reduced_and_nonzero(self):
+        rng = random.Random(1)
+        for _ in range(50):
+            e = muhash.element(rng.randbytes(40))
+            assert 0 < e < muhash.MUHASH_P
+
+    def test_element_deterministic(self):
+        assert muhash.element(b"abc") == muhash.element(b"abc")
+        assert muhash.element(b"abc") != muhash.element(b"abd")
+
+    def test_coin_element_binds_key_and_value(self):
+        k = b"k" * 36
+        assert muhash.coin_element(k, b"v1") != muhash.coin_element(k, b"v2")
+        assert muhash.coin_element(k, b"v1") != \
+            muhash.coin_element(b"j" * 36, b"v1")
+
+
+class TestAccumulator:
+    def test_insert_remove_roundtrip(self):
+        acc = muhash.MuHash()
+        base = acc.digest()
+        acc.insert(b"one")
+        acc.insert(b"two")
+        acc.remove(b"one")
+        acc.remove(b"two")
+        assert acc.digest() == base
+
+    def test_order_independence(self):
+        items = [b"a", b"b", b"c", b"d"]
+        a, b = muhash.MuHash(), muhash.MuHash()
+        for it in items:
+            a.insert(it)
+        for it in reversed(items):
+            b.insert(it)
+        assert a.digest() == b.digest()
+
+    def test_apply_batch_equals_singles(self):
+        rng = random.Random(2)
+        added = [rng.randbytes(20) for _ in range(17)]
+        removed = added[:5]
+        a = muhash.MuHash()
+        for it in added:
+            a.insert(it)
+        for it in removed:
+            a.remove(it)
+        b = muhash.MuHash()
+        b.apply([muhash.element(x) for x in added],
+                [muhash.element(x) for x in removed])
+        assert a.digest() == b.digest()
+
+    def test_serialization_roundtrip(self):
+        acc = muhash.MuHash()
+        acc.insert(b"state")
+        again = muhash.MuHash.from_bytes(acc.to_bytes())
+        assert again.digest() == acc.digest()
+        assert len(acc.to_bytes()) == 384
+
+    def test_partition_independence(self):
+        """digest(all) == digest(combine(per-shard states)) for any split
+        — the cross-shard invariant gettxoutsetinfo relies on."""
+        rng = random.Random(3)
+        items = [rng.randbytes(30) for _ in range(40)]
+        whole = muhash.MuHash()
+        shards = [muhash.MuHash() for _ in range(4)]
+        for it in items:
+            whole.insert(it)
+            shards[rng.randrange(4)].insert(it)
+        combined = muhash.combine([s.state for s in shards])
+        assert muhash.digest_of(combined) == whole.digest()
+
+
+class TestBatchProduct:
+    @pytest.mark.parametrize("n", [1, 2, 7, 8, 9, 31, 64, 100])
+    def test_limb_backend_matches_reference(self, n):
+        if muhash._np is None:
+            pytest.skip("numpy unavailable")
+        rng = random.Random(n)
+        vals = _rand_elems(rng, n)
+        assert muhash._batch_product_limbs(vals) == \
+            muhash.batch_product_ref(vals)
+
+    @pytest.mark.parametrize("n", [1, 8, 100])
+    def test_dispatch_matches_reference(self, n):
+        rng = random.Random(100 + n)
+        vals = _rand_elems(rng, n)
+        assert muhash.batch_product(vals) == muhash.batch_product_ref(vals)
+
+    def test_values_near_p(self):
+        """Reduction edge: products whose partial results straddle p."""
+        if muhash._np is None:
+            pytest.skip("numpy unavailable")
+        vals = [muhash.MUHASH_P - 1, muhash.MUHASH_P - 2,
+                muhash.MUHASH_P - muhash.MUHASH_C, 2, 3, 5, 7, 11]
+        assert muhash._batch_product_limbs(vals) == \
+            muhash.batch_product_ref(vals)
+
+    def test_empty(self):
+        assert muhash.batch_product([]) == 1
+
+    def test_limb_roundtrip(self):
+        if muhash._np is None:
+            pytest.skip("numpy unavailable")
+        rng = random.Random(5)
+        vals = _rand_elems(rng, 8)
+        limbs = muhash._to_limbs(vals)
+        assert [muhash._from_limbs(limbs[i]) for i in range(8)] == vals
