@@ -41,6 +41,13 @@ Options:
                          existing sharded datadir's manifest pins the count;
                          legacy single-file datadirs stay on the old layout
                          until -reindex
+  -coinswal              Per-shard WAL commit discipline: sync'd shard
+                         flushes fsync the sqlite WAL at COMMIT
+                         (synchronous=FULL) instead of running a full
+                         wal_checkpoint per flush. Equal durability for
+                         committed batches; trades checkpoint latency in
+                         the parallel shard flush for WAL-fsync latency
+                         at commit (default: 0)
   -assumeutxo=<hash:muhash>  Authorize loadtxoutset to adopt a UTXO snapshot
                          with exactly this tip block hash and MuHash set
                          digest (both 32-byte hex). The node serves at the

@@ -30,7 +30,7 @@ from ..store.chainstatedb import BlockIndexDB, CoinsDB
 from ..store.kvstore import KVStore
 from ..store.sharded import MANIFEST_NAME as _COINS_MANIFEST
 from ..store.sharded import ShardedCoinsDB
-from ..util import telemetry
+from ..util import lockwatch, telemetry
 from ..util.log import log_init, log_print, log_printf
 from ..validation.chain import BlockStatus
 from ..validation.chainstate import BlockValidationError, ChainstateManager
@@ -161,8 +161,11 @@ class Node:
             par = max(1, (os.cpu_count() or 1) + par)
         _native.PAR_THREADS = par
 
-        # cs_main — one lock serializing all chainstate/mempool access
-        self.cs_main = threading.RLock()
+        # cs_main — one lock serializing all chainstate/mempool access.
+        # Plain RLock normally; BCP_LOCKWATCH=1 substitutes the lockwatch
+        # sentinel wrapper (util/lockwatch) that feeds the lock-order
+        # graph behind gettpuinfo.lockwatch and the atexit cycle report.
+        self.cs_main = lockwatch.watched_rlock("cs_main")
         self.shutdown_event = threading.Event()
         self.start_time = int(time.time())
         # wake channel for blocking RPCs (getblocktemplate longpoll,
@@ -170,7 +173,7 @@ class Node:
         # their predicate under cs_main between short cv waits — notifiers
         # fire while holding cs_main, so waiters must never hold the cv
         # while taking cs_main in the other order.
-        self.notify_cv = threading.Condition()
+        self.notify_cv = lockwatch.watched_condition("notify_cv")
 
         reindex = config.get_bool("reindex")
         self.last_import_stats: Optional[dict] = None
@@ -257,8 +260,9 @@ class Node:
         else:
             self._coins_kv = None
             try:
-                self.coins_db = ShardedCoinsDB(self.datadir,
-                                               n_shards=coinshards)
+                self.coins_db = ShardedCoinsDB(
+                    self.datadir, n_shards=coinshards,
+                    wal=config.get_bool("coinswal"))
             except ValueError as e:
                 raise ConfigError(f"-coinshards={coinshards}: {e}")
             if self.coins_db.n_shards != coinshards:
@@ -477,6 +481,9 @@ class Node:
         telemetry.register_collector("store", self._store_families)
         if self.sigservice is not None:
             telemetry.register_collector("serving", self._serving_families)
+        if lockwatch.enabled():
+            telemetry.register_collector("lockwatch",
+                                         self._lockwatch_families)
         # P2P adversarial-supervision limits (p2p/connman.py): the
         # ban-score discharge threshold, the block-download stall timeout,
         # the supervision tick cadence, the per-peer receive-rate ceiling
@@ -674,6 +681,22 @@ class Node:
              "help": "Serialized mempool size (bytes)",
              "samples": [({}, self.mempool.total_size)]},
         ]
+
+    def _lockwatch_families(self) -> list:
+        # only registered when the BCP_LOCKWATCH sentinel is on; the
+        # bcp_lockwatch prefix owns its namespace (no native families)
+        snap = lockwatch.snapshot()
+        scalars = {
+            "locks": len(snap.get("locks", ())),
+            "acquisitions_total": snap.get("acquisitions_total", 0),
+            "max_depth": snap.get("max_depth", 0),
+            "order_edges": len(snap.get("order_edges", ())),
+            "inversions": snap.get("inversions", 0),
+        }
+        return telemetry.flat_families(
+            "bcp_lockwatch", scalars, typ="gauge",
+            help="runtime lock-order sentinel (util/lockwatch, "
+                 "BCP_LOCKWATCH=1)")
 
     # -- validation-interface callbacks (CMainSignals analogues) --------
 
@@ -2145,7 +2168,7 @@ class Node:
         # cache, mempool, block index) alive in the process-global
         # REGISTRY for the rest of the process
         for name in ("sigcache", "pipeline", "mempool", "serving", "mining",
-                     "store"):
+                     "store", "lockwatch"):
             telemetry.REGISTRY.unregister_collector(name)
         if self.resident_miner is not None:
             # drops the device template buffers and the miner watchdog

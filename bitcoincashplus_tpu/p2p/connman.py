@@ -36,6 +36,7 @@ from ..consensus.tx import CTransaction
 from ..consensus.pow import check_headers_pow_batch
 from ..mempool.mempool import MempoolError
 from ..store.kvstore import atomic_write_json, read_json
+from ..util import lockwatch
 from ..util import telemetry as tm
 from ..util.faults import INJECTOR, Backoff, InjectedFault, NET_SITE
 from ..util.log import log_print, log_printf
@@ -293,8 +294,8 @@ class CConnman:
         # by _ban_io_lock with a sequence check so an older snapshot can
         # never overwrite a newer one (atomic_write_bytes renames a fixed
         # path + ".tmp", so concurrent writers must not interleave)
-        self._ban_lock = threading.Lock()
-        self._ban_io_lock = threading.Lock()
+        self._ban_lock = lockwatch.watched_lock("ban_lock")
+        self._ban_io_lock = lockwatch.watched_lock("ban_io_lock")
         self._ban_seq = 0        # bumped under _ban_lock per mutation
         self._ban_saved_seq = 0  # last seq persisted (under _ban_io_lock)
         self._banned: dict[str, float] = self._load_banlist()

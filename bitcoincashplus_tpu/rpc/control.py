@@ -126,7 +126,7 @@ def gettpuinfo(node, params):
     except Exception:
         pass
     from ..mempool.accept import accept_latency_quantiles
-    from ..util import devicewatch, telemetry
+    from ..util import devicewatch, lockwatch, telemetry
 
     return {
         "backend": node.backend,
@@ -179,6 +179,11 @@ def gettpuinfo(node, params):
         # transfer byte totals per site, profiler state, and the stall
         # watchdog
         "device": devicewatch.snapshot(),
+        # runtime lock-order sentinel (util/lockwatch): locks watched,
+        # acquisition counts, max held-depth, the live ordering edges,
+        # and any inversions/cycles; {"enabled": False} unless the
+        # process runs with BCP_LOCKWATCH=1
+        "lockwatch": lockwatch.snapshot(),
     }
 
 

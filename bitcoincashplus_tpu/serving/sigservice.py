@@ -62,6 +62,7 @@ import numpy as np
 
 from ..ops import ecdsa_batch
 from ..util import devicewatch as dw
+from ..util import lockwatch
 from ..util import telemetry as tm
 from ..util.log import log_printf
 from ..validation.sigcache import SignatureCache
@@ -232,7 +233,9 @@ class SigService:
         # flushes ride concurrently, so the host packs flush N+1 while
         # the device verifies flush N. 1 = the PR 7 single-slot loop.
         self.buffers = buffers
-        self._cond = threading.Condition()
+        # condition over a (possibly lockwatch-watched) lock: submitters,
+        # the flush thread, and settle callbacks all rendezvous here
+        self._cond = lockwatch.watched_condition("sigservice_cond")
         self._pending: list[_Lane] = []
         self._by_key: dict[bytes, _Lane] = {}  # pending + in-flight lanes
         self._inflight: list[dict] = []  # dispatched, unsettled flushes

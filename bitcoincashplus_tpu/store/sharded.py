@@ -99,7 +99,7 @@ def _shard_paths(datadir: str, i: int) -> tuple[str, str]:
 class ShardedCoinsDB(CoinsView):
     """The facade: CoinsDB-compatible surface over N shard backends."""
 
-    def __init__(self, datadir: str, n_shards: int = 4):
+    def __init__(self, datadir: str, n_shards: int = 4, wal: bool = False):
         if n_shards < 1 or n_shards > 256 or (n_shards & (n_shards - 1)):
             raise ValueError(
                 f"n_shards={n_shards}: must be a power of two in [1, 256]")
@@ -113,11 +113,17 @@ class ShardedCoinsDB(CoinsView):
         if manifest and int(manifest.get("shards", n_shards)) != n_shards:
             n_shards = int(manifest["shards"])
         self.n_shards = n_shards
+        # -coinswal: per-shard WAL commit discipline (store/kvstore) —
+        # sync'd shard batches fsync the WAL at COMMIT instead of running
+        # a full checkpoint each flush. Operational knob, not layout: the
+        # manifest does not pin it, so it can be toggled per restart.
+        self.wal = wal
         self.shards: list[CoinsDB] = []
         for i in range(n_shards):
             db_path, journal_path = _shard_paths(datadir, i)
             self.shards.append(
-                CoinsDB(KVStore(db_path), journal_path=journal_path))
+                CoinsDB(KVStore(db_path, wal=wal),
+                        journal_path=journal_path))
         self._pool = (ThreadPoolExecutor(
             max_workers=n_shards, thread_name_prefix="coins-shard")
             if n_shards > 1 else None)
@@ -467,6 +473,7 @@ class ShardedCoinsDB(CoinsView):
     def stats(self) -> dict:
         return {
             "shards": self.n_shards,
+            "wal": self.wal,
             "epoch": self._epoch,
             "muhash": self.muhash_digest().hex(),
             "last_flush": dict(self.last_flush),

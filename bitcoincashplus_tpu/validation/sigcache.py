@@ -17,9 +17,10 @@ first; hit/miss/insert/eviction counters feed gettpuinfo.sigcache.
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 from typing import Optional
+
+from ..util import lockwatch
 
 # Estimated resident cost per entry: the 129-byte key's bytes object
 # (~162 B via sys.getsizeof) plus the OrderedDict slot/link overhead.
@@ -37,8 +38,9 @@ class SignatureCache:
         # probe (membership + move_to_end) and insert (set + evict) are
         # NOT GIL-atomic — an unguarded probe could move_to_end a key the
         # settle thread's eviction just popped (KeyError out of a valid
-        # block's validation)
-        self._lock = threading.Lock()
+        # block's validation). Plain Lock normally; the BCP_LOCKWATCH
+        # sentinel wraps it into the lock-order graph (util/lockwatch).
+        self._lock = lockwatch.watched_lock("sigcache")
         self.hits = 0
         self.misses = 0
         self.inserts = 0

@@ -70,6 +70,12 @@ def pytest_collection_modifyitems(config, items):
         # the newest, heaviest coverage and the first thing a CI timeout
         # should cut
         if "functional" not in str(item.fspath):
+            # the ``lint`` suite (bcplint static analysis + lockwatch
+            # sentinel — ISSUE 15) runs FIRST: pure-AST, no jax import,
+            # and an invariant violation is the cheapest, highest-signal
+            # failure the run can produce
+            if item.get_closest_marker("lint"):
+                return -1
             if item.get_closest_marker("serving"):
                 return 5
             if item.get_closest_marker("mining"):

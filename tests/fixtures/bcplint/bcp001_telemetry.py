@@ -1,0 +1,18 @@
+"""Seeded BCP001 violation: a collector re-emits a native family name.
+
+Never imported — parsed by tools/bcplint only (the golden corpus keeps
+each check honest: if a refactor stops the rule from firing here, the
+fixture test fails before the real tree can regress).
+"""
+
+from util import telemetry as tm  # noqa — AST-only, never imported
+
+_DEPTH_G = tm.gauge("bcp_fix_depth", "native gauge owning its name")
+
+
+def _families():
+    return [
+        {"name": "bcp_fix_depth", "type": "counter",  # BCPLINT-EXPECT
+         "help": "re-emits the native family with a conflicting TYPE",
+         "samples": [({}, 1.0)]},
+    ]

@@ -1,0 +1,14 @@
+"""Seeded BCP002 violation: register_collector with no unregister
+reachable from close()."""
+
+
+class Leaky:
+    def __init__(self, registry):
+        self.registry = registry
+        registry.register_collector("leaky", self._families)  # BCPLINT-EXPECT
+
+    def _families(self):
+        return []
+
+    def close(self):
+        pass  # forgot registry.unregister_collector("leaky")

@@ -1,0 +1,17 @@
+"""Seeded BCP003 violation: fsync while cs_main is statically held."""
+
+import os
+
+
+class NodeLike:
+    def flush(self, fd):
+        with self.cs_main:
+            os.fsync(fd)  # BCPLINT-EXPECT
+
+    def ok_released(self, fd, fut):
+        with self.cs_main:
+            self.cs_main.release()
+            try:
+                fut.result()  # fine: cs_main explicitly released around it
+            finally:
+                self.cs_main.acquire()

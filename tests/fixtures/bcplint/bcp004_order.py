@@ -1,0 +1,14 @@
+"""Seeded BCP004 violation: two methods take the same lock pair in
+opposite orders — a latent deadlock the runtime may never hit."""
+
+
+class TwoLocks:
+    def ab(self):
+        with self.a_lock:
+            with self.b_lock:  # BCPLINT-EXPECT
+                pass
+
+    def ba(self):
+        with self.b_lock:
+            with self.a_lock:
+                pass

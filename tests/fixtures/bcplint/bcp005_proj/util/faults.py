@@ -1,0 +1,5 @@
+"""Seeded BCP005 violation: a declared fault site no test ever drills.
+AST-only fixture (path shape matters: the SITES rule keys on
+util/faults.py)."""
+
+SITES = ("fixture_untested_site",)  # BCPLINT-EXPECT

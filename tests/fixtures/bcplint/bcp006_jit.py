@@ -1,0 +1,13 @@
+"""Seeded BCP006 violations: a traced-value coercion inside a jitted
+body, and a devicewatch program registered with no shape budget."""
+
+import jax
+
+
+@jax.jit
+def bad_coercion(x):
+    return int(x) + 1  # BCPLINT-EXPECT
+
+
+def register(dw):
+    return dw.program("fixture_unbudgeted_prog")  # BCPLINT-EXPECT-PROGRAM

@@ -58,6 +58,12 @@ class TestNode:
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        # every functional node runs under the lock-order sentinel
+        # (util/lockwatch): an introduced lock inversion surfaces in
+        # gettpuinfo.lockwatch and the node's atexit cycle report instead
+        # of waiting for the unlucky schedule. Opt out per-environment
+        # with BCP_LOCKWATCH=0.
+        env.setdefault("BCP_LOCKWATCH", "1")
         self.process = subprocess.Popen(
             self.args(extra), env=env, cwd=REPO_ROOT,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,

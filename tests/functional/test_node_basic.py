@@ -153,6 +153,18 @@ def test_single_node_end_to_end():
         assert "batch" in tpu and "connectblock" in tpu
         assert tpu["connectblock"]["blocks"] >= 102
 
+        # -- lock-order sentinel (ISSUE 15): the framework runs every
+        # node under BCP_LOCKWATCH=1, so by now the real lock sites have
+        # been exercised through mining/mempool/RPC — the acquisition
+        # graph must be live, cs_main watched, and CYCLE-FREE (a lock-
+        # order inversion introduced by a patch fails here even if the
+        # schedules never actually deadlocked during the run)
+        lw = tpu["lockwatch"]
+        assert lw["enabled"] is True
+        assert "cs_main" in lw["locks"]
+        assert lw["acquisitions_total"] > 0
+        assert lw["inversions"] == 0, lw["cycles"]
+
         # -- clean restart resumes (chain AND mempool) --------------------
         block2 = node.rpc.getblock(hashes[1], 2)
         raw3 = _spend_coinbase(node, block2["tx"][0]["txid"],

@@ -1,0 +1,238 @@
+"""bcplint static-analysis suite (ISSUE 15, tier-1, ``lint`` marker).
+
+Three layers of coverage:
+
+1. **Golden fixtures** — one seeded violation per check under
+   ``tests/fixtures/bcplint/``.  Each fixture carries a
+   ``# BCPLINT-EXPECT`` marker on the offending line; the test asserts
+   the rule fires at exactly that file:line with the expected message.
+   If a checks.py refactor stops a rule from firing, this fails before
+   the real tree can regress.
+2. **Repo-tree clean** — ``run_lint`` over the actual package with the
+   checked-in baseline must be clean, every baselined entry justified.
+   This is the same invariant CI enforces via the ``bcplint`` script.
+3. **Baseline machinery** — unjustified and stale entries are
+   themselves failures (the baseline can only shrink honestly).
+
+Pure-AST: nothing here imports jax or the analyzed modules, so the
+conftest orders the ``lint`` group first for the cheapest signal.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.bcplint.cli import DEFAULT_BASELINE, main as cli_main
+from tools.bcplint.engine import parse_baseline, run_lint
+
+pytestmark = pytest.mark.lint
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+FIXTURES = os.path.join(ROOT, "tests", "fixtures", "bcplint")
+
+
+def _expect_line(relpath: str, marker: str = "BCPLINT-EXPECT") -> int:
+    """1-based line of the seeded violation in a fixture (the marker
+    comment sits on the offending line, so the fixtures stay
+    self-documenting and the tests never hard-code line numbers)."""
+    with open(os.path.join(ROOT, relpath), encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            if marker in line and marker + "-" not in line:
+                return i
+    raise AssertionError("no %s marker in %s" % (marker, relpath))
+
+
+def _lint_fixture(name: str, tests_dir=None):
+    path = os.path.join(FIXTURES, name)
+    return run_lint(ROOT, paths=[path], tests_dir=tests_dir)
+
+
+def _sole_finding(result, rule):
+    matches = [f for f in result.findings if f.rule == rule]
+    assert matches, "expected a %s finding, got: %r" % (
+        rule, [f.render() for f in result.findings])
+    assert len(matches) == 1, [f.render() for f in matches]
+    return matches[0]
+
+
+# ---------------------------------------------------------------------------
+# golden fixtures: one seeded violation per check
+# ---------------------------------------------------------------------------
+
+
+def test_bcp001_fires_on_native_family_reemission():
+    rel = "tests/fixtures/bcplint/bcp001_telemetry.py"
+    f = _sole_finding(_lint_fixture("bcp001_telemetry.py"), "BCP001")
+    assert f.path == rel
+    assert f.line == _expect_line(rel)
+    assert "bcp_fix_depth" in f.message
+    assert "native" in f.message
+
+
+def test_bcp002_fires_on_unpaired_register():
+    rel = "tests/fixtures/bcplint/bcp002_pairing.py"
+    f = _sole_finding(_lint_fixture("bcp002_pairing.py"), "BCP002")
+    assert f.path == rel
+    assert f.line == _expect_line(rel)
+    assert "'leaky'" in f.message
+    assert "unregister" in f.message
+
+
+def test_bcp003_fires_on_fsync_under_cs_main():
+    rel = "tests/fixtures/bcplint/bcp003_blocking.py"
+    result = _lint_fixture("bcp003_blocking.py")
+    f = _sole_finding(result, "BCP003")
+    assert f.path == rel
+    assert f.line == _expect_line(rel)
+    assert "fsync" in f.message and "cs_main" in f.message
+    # the release/.result()/acquire pattern in the same fixture must NOT
+    # be flagged — the sole finding above already proves it, but make the
+    # intent explicit: no finding anchors on the released .result() call
+    assert not any("result" in g.anchor for g in result.findings)
+
+
+def test_bcp004_fires_on_lock_order_inversion():
+    rel = "tests/fixtures/bcplint/bcp004_order.py"
+    f = _sole_finding(_lint_fixture("bcp004_order.py"), "BCP004")
+    assert f.path == rel
+    assert f.line == _expect_line(rel)
+    assert "TwoLocks.a_lock" in f.message and "TwoLocks.b_lock" in f.message
+    assert "opposite orders" in f.message
+
+
+def test_bcp005_fires_on_undrilled_fault_site():
+    rel = "tests/fixtures/bcplint/bcp005_proj/util/faults.py"
+    result = run_lint(
+        ROOT, paths=[os.path.join(FIXTURES, "bcp005_proj")],
+        tests_dir=os.path.join(FIXTURES, "bcp005_tests"))
+    f = _sole_finding(result, "BCP005")
+    assert f.path == rel
+    assert f.line == _expect_line(rel)
+    assert "fixture_untested_site" in f.message
+    assert "no test" in f.message
+
+
+def test_bcp006_fires_on_coercion_and_missing_budget():
+    rel = "tests/fixtures/bcplint/bcp006_jit.py"
+    result = _lint_fixture("bcp006_jit.py")
+    found = [f for f in result.findings if f.rule == "BCP006"]
+    assert len(found) == 2, [f.render() for f in result.findings]
+    by_line = {f.line: f for f in found}
+    coerce = by_line[_expect_line(rel)]
+    assert "int(x)" in coerce.message and "traced" in coerce.message
+    budget = by_line[_expect_line(rel, "BCPLINT-EXPECT-PROGRAM")]
+    assert "fixture_unbudgeted_prog" in budget.message
+    assert "shape_budget" in budget.message
+
+
+# ---------------------------------------------------------------------------
+# repo-tree invariant: the actual package is clean under the baseline
+# ---------------------------------------------------------------------------
+
+
+def test_repo_tree_clean_under_baseline():
+    result = run_lint(ROOT, baseline_path=DEFAULT_BASELINE)
+    assert result.ok, "bcplint regression:\n" + "\n".join(
+        [f.render() for f in result.findings]
+        + ["stale: " + k for k in result.stale_entries]
+        + ["unjustified: " + k for k in result.unjustified_entries]
+        + ["%s: %s" % e for e in result.errors])
+    # the deliberate designs stay visible, not silently suppressed
+    assert result.baselined, "baseline matched nothing — was it emptied?"
+
+
+def test_every_baseline_entry_is_justified():
+    entries = parse_baseline(DEFAULT_BASELINE)
+    assert entries, "baseline file is empty"
+    missing = [k for k, just in entries.items() if not just]
+    assert not missing, "unjustified baseline entries: %r" % missing
+
+
+# ---------------------------------------------------------------------------
+# baseline machinery: unjustified and stale entries are failures
+# ---------------------------------------------------------------------------
+
+
+def test_unjustified_baseline_entry_is_a_failure(tmp_path):
+    fixture = os.path.join(FIXTURES, "bcp002_pairing.py")
+    raw = run_lint(ROOT, paths=[fixture])
+    key = _sole_finding(raw, "BCP002").key
+    bl = tmp_path / "baseline"
+    bl.write_text(key + "\n")  # no " # why" justification
+    result = run_lint(ROOT, paths=[fixture], baseline_path=str(bl))
+    assert not result.ok
+    assert result.unjustified_entries == [key]
+
+
+def test_stale_baseline_entry_is_a_failure(tmp_path):
+    fixture = os.path.join(FIXTURES, "bcp002_pairing.py")
+    raw = run_lint(ROOT, paths=[fixture])
+    key = _sole_finding(raw, "BCP002").key
+    bl = tmp_path / "baseline"
+    bl.write_text(
+        key + "  # the seeded leak is deliberate\n"
+        "BCP001 no/such/file.py::gone::flat:bcp_x  # stale\n")
+    result = run_lint(ROOT, paths=[fixture], baseline_path=str(bl))
+    assert not result.ok
+    assert not result.findings  # the real finding IS baselined...
+    assert result.stale_entries == [  # ...but the dead entry fails the run
+        "BCP001 no/such/file.py::gone::flat:bcp_x"]
+
+
+def test_justified_baseline_suppresses_finding(tmp_path):
+    fixture = os.path.join(FIXTURES, "bcp002_pairing.py")
+    raw = run_lint(ROOT, paths=[fixture])
+    key = _sole_finding(raw, "BCP002").key
+    bl = tmp_path / "baseline"
+    bl.write_text(key + "  # the seeded leak is deliberate\n")
+    result = run_lint(ROOT, paths=[fixture], baseline_path=str(bl))
+    assert result.ok
+    assert [f.key for f in result.baselined] == [key]
+
+
+def test_finding_keys_are_line_stable():
+    """The baseline key must not embed line numbers — unrelated churn
+    above a deliberate design must not invalidate its entry."""
+    raw = run_lint(ROOT, paths=[os.path.join(FIXTURES, "bcp002_pairing.py")])
+    key = _sole_finding(raw, "BCP002").key
+    assert "%d" % _sole_finding(raw, "BCP002").line not in key.split("::")[-1]
+    assert key.startswith("BCP002 tests/fixtures/bcplint/bcp002_pairing.py::")
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes and the console-script contract
+# ---------------------------------------------------------------------------
+
+
+def test_cli_clean_tree_exits_zero(capsys):
+    rc = cli_main(["--root", ROOT])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "bcplint: clean" in out
+
+
+def test_cli_findings_exit_one(capsys):
+    rc = cli_main(["--root", ROOT, "--no-baseline",
+                   os.path.join(FIXTURES, "bcp003_blocking.py")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "BCP003" in out
+
+
+def test_cli_list_checks(capsys):
+    assert cli_main(["--list-checks"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("BCP001", "BCP002", "BCP003", "BCP004", "BCP005", "BCP006"):
+        assert rule in out
+
+
+def test_module_invocation_matches_console_script():
+    """`python -m tools.bcplint.cli` is the no-install path CI uses."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.bcplint.cli"],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "bcplint: clean" in proc.stdout

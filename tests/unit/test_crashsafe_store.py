@@ -189,10 +189,10 @@ SHARDED_WORKER = f"""
 import struct, sys
 sys.path.insert(0, {REPO!r})
 from bitcoincashplus_tpu.store.sharded import ShardedCoinsDB
-datadir, n = sys.argv[1], int(sys.argv[2])
+datadir, n, wal = sys.argv[1], int(sys.argv[2]), sys.argv[3] == "1"
 key = lambda i: bytes([i % 251]) * 32 + struct.pack("<I", i)
 coin = lambda i: bytes([2, 5, 20]) + bytes([i % 256]) * 20
-db = ShardedCoinsDB(datadir, n_shards=n)
+db = ShardedCoinsDB(datadir, n_shards=n, wal=wal)
 entries = [(key(i), coin(i)) for i in range(40, 60)]
 entries += [(key(i), None) for i in range(0, 10)]
 db.batch_write_serialized(entries, b"\\x22" * 32)
@@ -226,20 +226,21 @@ def _scoin(i: int) -> bytes:
     return bytes([2, 5, 20]) + bytes([i % 256]) * 20
 
 
-def _seed_sharded(tmp_path, n_shards: int) -> str:
+def _seed_sharded(tmp_path, n_shards: int, wal: bool = False) -> str:
     from bitcoincashplus_tpu.store.sharded import ShardedCoinsDB
 
     datadir = str(tmp_path)
-    db = ShardedCoinsDB(datadir, n_shards=n_shards)
+    db = ShardedCoinsDB(datadir, n_shards=n_shards, wal=wal)
     db.batch_write_serialized(
         [(_skey(i), _scoin(i)) for i in range(40)], b"\x11" * 32)
     db.close()
     return datadir
 
-def _assert_sharded_state(datadir: str, n_shards: int, expect: str, ctx):
+def _assert_sharded_state(datadir: str, n_shards: int, expect: str, ctx,
+                          wal: bool = False):
     from bitcoincashplus_tpu.store.sharded import ShardedCoinsDB
 
-    db = ShardedCoinsDB(datadir, n_shards=n_shards)
+    db = ShardedCoinsDB(datadir, n_shards=n_shards, wal=wal)
     db.recover_journal()
     want_keys = (set(range(40)) if expect == "pre"
                  else set(range(10, 60)))
@@ -266,7 +267,7 @@ def test_sharded_crash_at_every_step(tmp_path, n_shards, step, expect_fn):
     env = dict(os.environ)
     env["BCP_FAULT_CRASH"] = step
     proc = subprocess.run(
-        [sys.executable, "-c", SHARDED_WORKER, datadir, str(n_shards)],
+        [sys.executable, "-c", SHARDED_WORKER, datadir, str(n_shards), "0"],
         env=env, capture_output=True, timeout=120,
     )
     assert proc.returncode == 137, (step, proc.stderr.decode()[-500:])
@@ -274,15 +275,66 @@ def test_sharded_crash_at_every_step(tmp_path, n_shards, step, expect_fn):
                           (step, n_shards))
 
 
-@pytest.mark.parametrize("n_shards", [1, 2, 4])
-def test_sharded_uninjected_commit_completes(tmp_path, n_shards):
-    datadir = _seed_sharded(tmp_path, n_shards)
+@pytest.mark.parametrize("step,expect_fn", SHARDED_STEPS)
+def test_sharded_wal_crash_at_every_step(tmp_path, step, expect_fn):
+    """The ``-coinswal`` knob (synchronous=FULL, no per-commit WAL
+    checkpoint) through the same hard-kill matrix: the durability
+    boundary moves from the explicit checkpoint to sqlite's COMMIT
+    record, and the whole-state acceptance contract must hold
+    unchanged. 2 shards: the only count where the partial-journal and
+    cross-shard barrier cases are all distinct and cheap."""
+    n_shards = 2
+    datadir = _seed_sharded(tmp_path, n_shards, wal=True)
+    env = dict(os.environ)
+    env["BCP_FAULT_CRASH"] = step
     proc = subprocess.run(
-        [sys.executable, "-c", SHARDED_WORKER, datadir, str(n_shards)],
+        [sys.executable, "-c", SHARDED_WORKER, datadir, str(n_shards), "1"],
+        env=env, capture_output=True, timeout=120,
+    )
+    assert proc.returncode == 137, (step, proc.stderr.decode()[-500:])
+    _assert_sharded_state(datadir, n_shards, expect_fn(n_shards),
+                          (step, n_shards, "wal"), wal=True)
+
+
+@pytest.mark.parametrize("wal", [False, True])
+@pytest.mark.parametrize("n_shards", [1, 2, 4])
+def test_sharded_uninjected_commit_completes(tmp_path, n_shards, wal):
+    datadir = _seed_sharded(tmp_path, n_shards, wal=wal)
+    proc = subprocess.run(
+        [sys.executable, "-c", SHARDED_WORKER, datadir, str(n_shards),
+         "1" if wal else "0"],
         env=dict(os.environ), capture_output=True, timeout=120,
     )
     assert proc.returncode == 0, proc.stderr.decode()[-500:]
-    _assert_sharded_state(datadir, n_shards, "post", n_shards)
+    _assert_sharded_state(datadir, n_shards, "post", n_shards, wal=wal)
+
+
+def test_wal_knob_sets_synchronous_full(tmp_path):
+    """wal=True is operational, not layout: same on-disk WAL-mode sqlite
+    files, but COMMIT itself fsyncs (synchronous=FULL) instead of the
+    per-sync'd-batch wal_checkpoint(FULL), and a store written with the
+    knob on reopens cleanly with it off (and vice versa)."""
+    from bitcoincashplus_tpu.store.sharded import ShardedCoinsDB
+
+    datadir = str(tmp_path)
+    db = ShardedCoinsDB(datadir, n_shards=2, wal=True)
+    assert db.stats()["wal"] is True
+    for shard in db.shards:
+        assert shard.kv.wal is True
+        (sync,) = shard.kv._db.execute("PRAGMA synchronous").fetchone()
+        assert sync == 2  # FULL
+    db.batch_write_serialized(
+        [(_skey(i), _scoin(i)) for i in range(8)], b"\x11" * 32)
+    db.close()
+
+    db = ShardedCoinsDB(datadir, n_shards=2)  # reopen with the knob OFF
+    assert db.stats()["wal"] is False
+    for shard in db.shards:
+        (sync,) = shard.kv._db.execute("PRAGMA synchronous").fetchone()
+        assert sync == 1  # NORMAL + explicit checkpoint on sync'd batches
+    assert dict(db.iterate_coins()) == {
+        _skey(i): _scoin(i) for i in range(8)}
+    db.close()
 
 
 def test_chainstate_manager_replays_journal_at_startup(tmp_path):

@@ -1,0 +1,222 @@
+"""Runtime lock-order sentinel tests (util/lockwatch, ``lint`` marker).
+
+The core provocation: two threads take a fake lock pair in opposite
+orders — with schedules arranged so the runs never actually deadlock —
+and the monitor must still report the inversion, because the order
+*graph* has the cycle even when the timeline got lucky. That is the
+whole point of the sentinel: it generalizes over schedules the way
+bcplint's BCP004 generalizes over call sites.
+"""
+
+import threading
+
+import pytest
+
+from bitcoincashplus_tpu.util import lockwatch
+from bitcoincashplus_tpu.util.lockwatch import (
+    MONITOR,
+    WatchedLock,
+    watched_condition,
+    watched_lock,
+    watched_rlock,
+)
+
+pytestmark = pytest.mark.lint
+
+
+@pytest.fixture(autouse=True)
+def _fresh_monitor(monkeypatch):
+    """Every test runs against an armed gate and an empty graph; the
+    process-global MONITOR is scrubbed afterwards so nothing leaks into
+    the telemetry/functional suites."""
+    monkeypatch.setenv("BCP_LOCKWATCH", "1")
+    MONITOR.reset()
+    yield
+    MONITOR.reset()
+
+
+def _run_threads(*targets):
+    threads = [threading.Thread(target=t) for t in targets]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive(), "worker wedged"
+
+
+# ---------------------------------------------------------------------------
+# the inversion provocation
+# ---------------------------------------------------------------------------
+
+
+def test_two_lock_inversion_is_reported():
+    a = watched_lock("fake_a")
+    b = watched_lock("fake_b")
+    gate = threading.Barrier(2, timeout=30)
+
+    def ab():
+        with a:
+            with b:
+                pass
+        gate.wait()  # thread 2 starts only after this one fully released
+
+    def ba():
+        gate.wait()
+        with b:
+            with a:
+                pass
+
+    _run_threads(ab, ba)
+
+    cycles = MONITOR.cycles()
+    assert len(cycles) == 1, cycles
+    cyc = cycles[0]
+    assert cyc["locks"] == ["fake_a", "fake_b"]
+    # both legs are present, each with the real acquire site recorded
+    assert set(cyc["edges"]) == {"fake_a->fake_b", "fake_b->fake_a"}
+    for site in cyc["edges"].values():
+        assert site.startswith("test_lockwatch.py:"), site
+
+    snap = MONITOR.snapshot()
+    assert snap["inversions"] == 1
+    assert snap["acquisitions_total"] == 4
+    assert snap["max_depth"] == 2
+
+
+def test_consistent_order_reports_no_cycle():
+    a = watched_lock("ord_a")
+    b = watched_lock("ord_b")
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with a:
+            with b:
+                pass
+
+    _run_threads(t1, t2)
+    assert MONITOR.cycles() == []
+    assert MONITOR.snapshot()["order_edges"] == {"ord_a->ord_b": 2}
+
+
+# ---------------------------------------------------------------------------
+# re-entrancy, gating, condition bookkeeping
+# ---------------------------------------------------------------------------
+
+
+def test_rlock_reentry_adds_depth_never_edges():
+    r = watched_rlock("reent")
+    with r:
+        with r:
+            with r:
+                pass
+    snap = MONITOR.snapshot()
+    # one first-hold acquisition, zero edges, zero self-cycles
+    assert snap["acquisitions"]["reent"] == 1
+    assert snap["order_edges"] == {}
+    assert snap["inversions"] == 0
+
+
+def test_gate_off_returns_plain_primitives(monkeypatch):
+    monkeypatch.setenv("BCP_LOCKWATCH", "0")
+    assert isinstance(watched_lock("off"), type(threading.Lock()))
+    assert isinstance(watched_rlock("off"), type(threading.RLock()))
+    cond = watched_condition("off")
+    assert isinstance(cond, threading.Condition)
+    assert not isinstance(cond._lock, WatchedLock)
+    assert lockwatch.snapshot() == {"enabled": False}
+    # nothing registered: the monitor never heard about these locks
+    assert "off" not in MONITOR.snapshot()["locks"]
+
+
+def test_condition_wait_keeps_stack_coherent():
+    """Across a cv.wait() the lock is released (stack must drop it) and
+    reacquired (stack must regain it) — holding another lock over the
+    wake-side acquire still mints the correct edge, and nothing wedges."""
+    cv = watched_condition("fake_cv")
+    outer = watched_lock("fake_outer")
+    ready = threading.Event()
+    woke = threading.Event()
+
+    def waiter():
+        with cv:
+            ready.set()
+            assert cv.wait(timeout=30)
+        woke.set()
+
+    def waker():
+        assert ready.wait(timeout=30)
+        with outer:
+            with cv:
+                cv.notify_all()
+        assert woke.wait(timeout=30)
+
+    _run_threads(waiter, waker)
+
+    snap = MONITOR.snapshot()
+    # waiter: enter + reacquire-after-wait; waker: one acquire
+    assert snap["acquisitions"]["fake_cv"] == 3
+    assert snap["order_edges"] == {"fake_outer->fake_cv": 1}
+    assert snap["inversions"] == 0
+
+
+def test_condition_over_rlock_wait_restores_depth():
+    """An RLock-backed condition entered re-entrantly: wait() must drop
+    every recursion level (or the notifier could never acquire) and the
+    restore must reinstate the full depth."""
+    lock = watched_rlock("fake_rcv")
+    cv = threading.Condition(lock)
+    ready = threading.Event()
+
+    def waiter():
+        with lock:          # depth 1
+            with cv:        # depth 2, same lock
+                ready.set()
+                assert cv.wait(timeout=30)
+            # __exit__ back to depth 1 without underflow
+        # fully released here
+
+    def waker():
+        assert ready.wait(timeout=30)
+        with cv:  # only acquirable if wait() really dropped both levels
+            cv.notify_all()
+
+    _run_threads(waiter, waker)
+    snap = MONITOR.snapshot()
+    assert snap["inversions"] == 0
+    # first-holds only: waiter enter + reacquire, waker enter
+    assert snap["acquisitions"]["fake_rcv"] == 3
+
+
+def test_release_out_of_acquisition_order():
+    """The held-set is not a strict LIFO: A-acquire, B-acquire,
+    A-release, B-release must keep counts coherent."""
+    a = watched_lock("ooo_a")
+    b = watched_lock("ooo_b")
+    a.acquire()
+    b.acquire()
+    a.release()
+    b.release()
+    snap = MONITOR.snapshot()
+    assert snap["order_edges"] == {"ooo_a->ooo_b": 1}
+    # a second pass must not double-register or wedge
+    a.acquire()
+    a.release()
+    assert MONITOR.snapshot()["acquisitions"]["ooo_a"] == 2
+
+
+def test_snapshot_shape_matches_gettpuinfo_contract():
+    """gettpuinfo's ``lockwatch`` section and the telemetry collector
+    both project these exact keys — keep the contract pinned."""
+    lk = watched_lock("contract")
+    with lk:
+        pass
+    snap = lockwatch.snapshot()
+    assert snap["enabled"] is True
+    for key in ("locks", "acquisitions", "acquisitions_total",
+                "max_depth", "order_edges", "inversions", "cycles"):
+        assert key in snap, key
+    assert "contract" in snap["locks"]

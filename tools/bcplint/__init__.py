@@ -1,0 +1,11 @@
+"""bcplint: project-invariant static analysis for bitcoincashplus-tpu.
+
+Each check codifies a bug class this repository has actually shipped and
+re-fixed (see README "Static analysis & invariants" for the catalog and
+the originating PR lesson per rule). Stdlib-only by design: the linter
+parses the tree with ``ast`` and never imports the package under
+analysis, so it runs in milliseconds with no jax/device footprint.
+"""
+
+from .engine import Finding, LintResult, run_lint  # noqa: F401
+from .checks import ALL_CHECKS, check_by_rule  # noqa: F401

@@ -1,0 +1,815 @@
+"""bcplint checks BCP001-BCP006.
+
+Each check is a two-phase object: ``collect(module)`` gathers per-file
+facts from the AST, ``finalize(ctx)`` folds them into Findings — so the
+cross-module rules (native-family ownership, lock-order cycles, fault-
+site parity) see the whole tree before judging any one file.
+
+All analysis is syntactic and deliberately shallow: constant arguments,
+one level of name resolution inside a function, for-loop constant
+propagation over literal tuples. Anything unresolvable errs toward
+silence — a lint that cries wolf gets baselined wholesale and dies.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .engine import Finding, Module, iter_py_files
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+def attr_parts(node) -> list[str] | None:
+    """``self.node.cs_main`` -> ["self", "node", "cs_main"]; None when the
+    expression is not a pure Name/Attribute chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def const_str(node) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def call_terminal(call: ast.Call) -> str | None:
+    """Terminal name of the called expression: ``a.b.c()`` -> "c"."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def iter_funcs(tree):
+    """Yields (qualname, func_node, enclosing_class_node_or_None) for
+    every function/method, including nested ones (qualname dot-joined)."""
+    def walk(node, prefix, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = prefix + child.name if prefix else child.name
+                yield qual, child, cls
+                yield from walk(child, qual + ".", cls)
+            elif isinstance(child, ast.ClassDef):
+                qual = prefix + child.name if prefix else child.name
+                yield from walk(child, qual + ".", child)
+    yield from walk(tree, "", None)
+
+
+def local_assignments(func: ast.AST) -> dict[str, ast.AST]:
+    """Simple ``name = expr`` bindings in a function body (last wins)."""
+    out: dict[str, ast.AST] = {}
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name):
+                out[t.id] = node.value
+    return out
+
+
+def contains_snapshot_call(node) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            term = call_terminal(sub)
+            if term and "snapshot" in term:
+                return True
+    return False
+
+
+def find_cycles(edges: dict[tuple[str, str], str]):
+    """SCCs with >1 node (or a self-loop) in the directed graph given as
+    ``{(a, b): site}``; returns [(sorted_locks, {(a,b): site})]."""
+    adj: dict[str, list[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, [])
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+    for root in adj:
+        if root in index:
+            continue
+        work = [(root, 0)]
+        while work:
+            node, i = work.pop()
+            if i == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            recurse = False
+            succs = adj[node]
+            while i < len(succs):
+                w = succs[i]
+                i += 1
+                if w not in index:
+                    work.append((node, i))
+                    work.append((w, 0))
+                    recurse = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if recurse:
+                continue
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+    out = []
+    for scc in sccs:
+        members = set(scc)
+        if len(scc) < 2 and not any((n, n) in edges for n in scc):
+            continue
+        cyc = {(a, b): s for (a, b), s in edges.items()
+               if a in members and b in members}
+        out.append((sorted(members), cyc))
+    return out
+
+
+class Check:
+    rule = "BCP000"
+    title = ""
+
+    def collect(self, mod: Module) -> None:
+        raise NotImplementedError
+
+    def finalize(self, ctx) -> list[Finding]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# BCP001 — telemetry namespace discipline (the PR 6 in_flight/TYPE lesson)
+# ---------------------------------------------------------------------------
+
+_TELEMETRY_OWNERS = {"tm", "telemetry", "REGISTRY"}
+_FAMILY_KINDS = {"counter", "gauge", "histogram"}
+
+
+class TelemetryNamespace(Check):
+    """A registry collector must never emit a family name owned by a
+    native Counter/Gauge/Histogram (same name, two TYPE lines in the
+    exposition), must not project under a prefix that shadows native
+    family names without justification, and must not stamp
+    ``typ="counter"`` onto a point-in-time snapshot projection."""
+
+    rule = "BCP001"
+    title = "telemetry namespace discipline"
+
+    def __init__(self):
+        self.natives: dict[str, tuple[str, int, str]] = {}  # name -> site
+        self.emits = []       # (mod, line, qual, name)
+        self.flats = []       # (mod, line, qual, prefix, typ, snapshotish)
+
+    def collect(self, mod: Module) -> None:
+        for qual, func, _cls in iter_funcs(mod.tree):
+            assigns = local_assignments(func)
+            for node in ast.walk(func):
+                if isinstance(node, ast.Call):
+                    self._on_call(mod, qual, node, assigns)
+                elif isinstance(node, ast.Dict):
+                    self._on_dict(mod, qual, node)
+        # module-level natives (the common case) and dict emissions
+        for node in ast.iter_child_nodes(mod.tree):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    self._maybe_native(mod, sub)
+
+    def _maybe_native(self, mod, call: ast.Call) -> None:
+        f = call.func
+        kind = None
+        if isinstance(f, ast.Attribute) and f.attr in _FAMILY_KINDS:
+            owner = attr_parts(f.value)
+            if owner and owner[-1] in _TELEMETRY_OWNERS:
+                kind = f.attr
+        elif isinstance(f, ast.Name) and f.id in _FAMILY_KINDS:
+            kind = f.id
+        if kind is None:
+            return
+        name = const_str(call.args[0]) if call.args else None
+        if name and name not in self.natives:
+            self.natives[name] = (mod.path, call.lineno, kind)
+
+    def _on_call(self, mod, qual, call: ast.Call, assigns) -> None:
+        self._maybe_native(mod, call)
+        if call_terminal(call) != "flat_families":
+            return
+        prefix = const_str(call.args[0]) if call.args else None
+        if prefix is None:
+            return
+        typ = "gauge"
+        for kw in call.keywords:
+            if kw.arg == "typ":
+                typ = const_str(kw.value) or "?"
+        if len(call.args) >= 3:
+            typ = const_str(call.args[2]) or typ
+        data = call.args[1] if len(call.args) >= 2 else None
+        snapshotish = False
+        if data is not None:
+            expr = data
+            if isinstance(data, ast.Name) and data.id in assigns:
+                expr = assigns[data.id]
+            snapshotish = contains_snapshot_call(expr)
+        self.flats.append((mod.path, call.lineno, qual, prefix, typ,
+                           snapshotish))
+
+    def _on_dict(self, mod, qual, node: ast.Dict) -> None:
+        keys = {const_str(k) for k in node.keys if k is not None}
+        if "name" not in keys or "samples" not in keys:
+            return
+        for k, v in zip(node.keys, node.values):
+            if const_str(k) == "name":
+                name = const_str(v)
+                if name:
+                    self.emits.append((mod.path, node.lineno, qual, name))
+
+    def finalize(self, ctx) -> list[Finding]:
+        out = []
+        for path, line, qual, name in self.emits:
+            if name in self.natives:
+                npath, nline, kind = self.natives[name]
+                out.append(Finding(
+                    self.rule, path, line,
+                    "collector emits family %r owned by the native %s at "
+                    "%s:%d (duplicate family/TYPE in the exposition)"
+                    % (name, kind, npath, nline),
+                    "%s::emit:%s" % (qual, name)))
+        for path, line, qual, prefix, typ, snapshotish in self.flats:
+            shadowed = sorted(n for n in self.natives
+                              if n.startswith(prefix + "_"))
+            if shadowed:
+                out.append(Finding(
+                    self.rule, path, line,
+                    "flat_families prefix %r shadows native family "
+                    "namespace (%s) — a snapshot key matching a native "
+                    "suffix would duplicate its family/TYPE"
+                    % (prefix, ", ".join(shadowed[:3])
+                       + (", ..." if len(shadowed) > 3 else "")),
+                    "%s::flat:%s" % (qual, prefix)))
+            if typ == "counter" and snapshotish:
+                out.append(Finding(
+                    self.rule, path, line,
+                    "flat_families(typ=\"counter\") over a snapshot() "
+                    "projection — non-monotonic families must export "
+                    "typ=\"gauge\" or justify monotonicity",
+                    "%s::counter-snapshot:%s" % (qual, prefix)))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# BCP002 — register/unregister pairing (the closure-leak lesson)
+# ---------------------------------------------------------------------------
+
+_CLOSEISH = {"close", "stop", "__exit__", "shutdown"}
+
+
+class RegisterPairing(Check):
+    """Every ``registry.register_collector`` / watchdog ``register`` in a
+    class must have a matching unregister reachable from a close-ish
+    method (close/stop/__exit__/shutdown, following self-calls) — else
+    the registry closure pins the instance for the process lifetime."""
+
+    rule = "BCP002"
+    title = "register/unregister pairing"
+
+    def __init__(self):
+        self.classes = []  # (mod.path, class_name, regs, unregs, wildcard)
+
+    @staticmethod
+    def _reg_kind(call: ast.Call) -> str | None:
+        term = call_terminal(call)
+        if term == "register_collector":
+            return "collector"
+        if term == "register":
+            owner = (attr_parts(call.func.value)
+                     if isinstance(call.func, ast.Attribute) else None)
+            if owner and owner[-1] == "WATCHDOG":
+                return "watchdog"
+        return None
+
+    @staticmethod
+    def _unreg_kind(call: ast.Call) -> str | None:
+        term = call_terminal(call)
+        if term == "unregister_collector":
+            return "collector"
+        if term == "unregister":
+            owner = (attr_parts(call.func.value)
+                     if isinstance(call.func, ast.Attribute) else None)
+            if owner and owner[-1] == "WATCHDOG":
+                return "watchdog"
+        return None
+
+    def collect(self, mod: Module) -> None:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            methods = {n.name: n for n in node.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            # close-ish reachability: close-ish methods plus the
+            # transitive closure of their self.X() calls
+            reachable = set(m for m in methods if m in _CLOSEISH)
+            frontier = list(reachable)
+            while frontier:
+                body = methods[frontier.pop()]
+                for sub in ast.walk(body):
+                    if isinstance(sub, ast.Call):
+                        parts = (attr_parts(sub.func)
+                                 if isinstance(sub.func, ast.Attribute)
+                                 else None)
+                        if (parts and len(parts) == 2
+                                and parts[0] == "self"
+                                and parts[1] in methods
+                                and parts[1] not in reachable):
+                            reachable.add(parts[1])
+                            frontier.append(parts[1])
+
+            regs = []     # (kind, name, line)
+            unregs = set()   # (kind, name)
+            wildcard = set()  # kinds with an unresolvable unregister arg
+            for mname, body in methods.items():
+                loop_consts = self._loop_consts(body)
+                for sub in ast.walk(body):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    ukind = self._unreg_kind(sub)
+                    if ukind and mname in reachable:
+                        names = self._resolve_names(sub, loop_consts)
+                        if names is None:
+                            wildcard.add(ukind)
+                        else:
+                            unregs.update((ukind, n) for n in names)
+                        continue
+                    rkind = self._reg_kind(sub)
+                    if rkind and mname not in _CLOSEISH:
+                        name = const_str(sub.args[0]) if sub.args else None
+                        if name:  # dynamic registration names: out of scope
+                            regs.append((rkind, name, sub.lineno))
+            if regs:
+                self.classes.append(
+                    (mod.path, node.name, regs, unregs, wildcard))
+
+    @staticmethod
+    def _loop_consts(body) -> dict[str, set[str]]:
+        """``for name in ("a", "b"):`` -> {"name": {"a", "b"}} — the
+        constant propagation the close() unregister loop pattern needs."""
+        out: dict[str, set[str]] = {}
+        for sub in ast.walk(body):
+            if (isinstance(sub, ast.For)
+                    and isinstance(sub.target, ast.Name)
+                    and isinstance(sub.iter, (ast.Tuple, ast.List))):
+                consts = {const_str(e) for e in sub.iter.elts}
+                if None not in consts:
+                    out.setdefault(sub.target.id, set()).update(consts)
+        return out
+
+    @staticmethod
+    def _resolve_names(call: ast.Call, loop_consts) -> set[str] | None:
+        if not call.args:
+            return None
+        arg = call.args[0]
+        s = const_str(arg)
+        if s is not None:
+            return {s}
+        if isinstance(arg, ast.Name) and arg.id in loop_consts:
+            return loop_consts[arg.id]
+        return None  # unresolvable -> wildcard (suppresses the pairing)
+
+    def finalize(self, ctx) -> list[Finding]:
+        out = []
+        for path, cls, regs, unregs, wildcard in self.classes:
+            for kind, name, line in regs:
+                if kind in wildcard or (kind, name) in unregs:
+                    continue
+                out.append(Finding(
+                    self.rule, path, line,
+                    "%s registration %r in class %s has no matching "
+                    "unregister reachable from close()/stop() — the "
+                    "registry closure outlives the instance"
+                    % (kind, name, cls),
+                    "%s::%s:%s" % (cls, kind, name)))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# BCP003 — no blocking calls under cs_main (PR 2 banlist / PR 7 verify-wait)
+# ---------------------------------------------------------------------------
+
+_BLOCKING_ATTRS = {"fsync", "fdatasync", "sleep", "result", "wait",
+                   "wait_for", "commit", "wal_checkpoint"}
+_BLOCKING_NAMES = {"fsync", "sleep"}
+
+
+class BlockingUnderCsMain(Check):
+    """Inside a ``with ...cs_main:`` block, flag direct calls that can
+    block indefinitely or hit disk: fsync, Future.result, condvar wait,
+    sleep, sqlite commit/checkpoint. An explicit ``cs_main.release()``
+    earlier in the block suspends the check until the paired
+    ``acquire()`` (the PR 7 verify-wait pattern)."""
+
+    rule = "BCP003"
+    title = "no blocking calls under cs_main"
+
+    def __init__(self):
+        self.findings: list[Finding] = []
+
+    @staticmethod
+    def _is_cs_main(expr) -> bool:
+        parts = attr_parts(expr)
+        return bool(parts) and parts[-1] == "cs_main"
+
+    def collect(self, mod: Module) -> None:
+        for qual, func, _cls in iter_funcs(mod.tree):
+            for node in func.body:
+                self._scan_stmt(mod, qual, node, under=False,
+                                released=[False])
+
+    def _scan_stmt(self, mod, qual, node, under, released) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # closures execute later, outside the lock
+        if isinstance(node, ast.With):
+            takes = any(self._is_cs_main(item.context_expr)
+                        for item in node.items)
+            inner_under = under or takes
+            state = [False] if (takes and not under) else released
+            for child in node.body:
+                self._scan_stmt(mod, qual, child, inner_under, state)
+            return
+        # document order: expressions flagged as seen, child statements
+        # recursed — so an explicit cs_main.release() suspends flagging
+        # for everything after it until the paired acquire()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._scan_stmt(mod, qual, child, under, released)
+            else:
+                self._scan_expr(mod, qual, child, under, released)
+
+    def _scan_expr(self, mod, qual, node, under, released) -> None:
+        if isinstance(node, ast.Lambda):
+            return  # deferred execution
+        if isinstance(node, ast.Call):
+            term = call_terminal(node)
+            if (term in ("release", "acquire")
+                    and isinstance(node.func, ast.Attribute)
+                    and self._is_cs_main(node.func.value)):
+                released[0] = (term == "release")
+            elif under and not released[0]:
+                self._maybe_flag(mod, qual, node)
+        for child in ast.iter_child_nodes(node):
+            self._scan_expr(mod, qual, child, under, released)
+
+    def _maybe_flag(self, mod, qual, call: ast.Call) -> None:
+        f = call.func
+        name = None
+        if isinstance(f, ast.Attribute) and f.attr in _BLOCKING_ATTRS:
+            if f.attr in ("release", "acquire"):
+                return
+            name = f.attr
+        elif isinstance(f, ast.Name) and f.id in _BLOCKING_NAMES:
+            name = f.id
+        if name is None:
+            return
+        self.findings.append(Finding(
+            self.rule, mod.path, call.lineno,
+            "blocking call .%s() while cs_main is statically held — "
+            "release around it (PR 7 verify-wait pattern) or move the "
+            "I/O outside the lock (PR 2 banlist pattern)" % name,
+            "%s::%s" % (qual, name)))
+
+    def finalize(self, ctx) -> list[Finding]:
+        # dedupe repeated identical anchors (same call name, same func)
+        seen: set[str] = set()
+        out = []
+        for f in self.findings:
+            if f.anchor in seen:
+                continue
+            seen.add(f.anchor)
+            out.append(f)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# BCP004 — lock-acquisition-order extraction + cycle detection
+# ---------------------------------------------------------------------------
+
+_GLOBAL_LOCKS = {"cs_main", "notify_cv"}
+_LOCKISH_RE = re.compile(
+    r"(^cs_main$|^notify_cv$|_lock$|_cond$|_cv$|^lock$|^mutex$|_mu$)")
+
+
+class LockOrder(Check):
+    """Extract the static lock-order graph from nested ``with`` blocks
+    over lock-shaped attributes, across every module, and report cycles.
+    The runtime half (util/lockwatch, BCP_LOCKWATCH=1) sees through the
+    indirection this syntactic pass cannot."""
+
+    rule = "BCP004"
+    title = "lock-order cycle detection"
+
+    def __init__(self):
+        self.edges: dict[tuple[str, str], str] = {}  # (a, b) -> site
+
+    def _lock_name(self, expr, cls) -> str | None:
+        parts = attr_parts(expr)
+        if not parts:
+            return None
+        term = parts[-1]
+        if term in _GLOBAL_LOCKS:
+            return term
+        if not _LOCKISH_RE.search(term):
+            return None
+        if len(parts) >= 2 and parts[-2] != "self":
+            return "%s.%s" % (parts[-2], term)
+        if cls is not None:
+            return "%s.%s" % (cls.name, term)
+        return term
+
+    def collect(self, mod: Module) -> None:
+        for _qual, func, cls in iter_funcs(mod.tree):
+            self._scan(mod, cls, func.body, held=[])
+
+    def _scan(self, mod, cls, stmts, held) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # separate scope, scanned by iter_funcs
+            if isinstance(stmt, ast.With):
+                names = [n for n in (
+                    self._lock_name(item.context_expr, cls)
+                    for item in stmt.items) if n]
+                pushed = []
+                for n in names:
+                    for h in held:
+                        if h != n and (h, n) not in self.edges:
+                            self.edges[(h, n)] = (mod.path, stmt.lineno)
+                    held.append(n)
+                    pushed.append(n)
+                self._scan(mod, cls, stmt.body, held)
+                for n in pushed:
+                    held.remove(n)
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub and isinstance(sub, list):
+                    self._scan(mod, cls, sub, held)
+            for handler in getattr(stmt, "handlers", ()):
+                self._scan(mod, cls, handler.body, held)
+
+    def finalize(self, ctx) -> list[Finding]:
+        out = []
+        for locks, cyc in find_cycles(self.edges):
+            path, line = min(cyc.values())
+            legs = "; ".join("%s->%s at %s:%d" % (a, b, p, ln)
+                             for (a, b), (p, ln) in sorted(cyc.items()))
+            out.append(Finding(
+                self.rule, path, line,
+                "lock-order cycle between {%s}: %s — two paths take "
+                "these locks in opposite orders (latent deadlock)"
+                % (", ".join(locks), legs),
+                "cycle:%s" % "<->".join(locks)))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# BCP005 — fault-site parity (every declared site drilled by some test)
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"[^a-z0-9_]+")
+
+
+class FaultSiteParity(Check):
+    """Every fault site declared in util/faults.py (the SITES tuple) or
+    as a module-level ``*_SITE = "..."`` constant anywhere must appear in
+    at least one test — an undrilled crash/poison site is dead armor."""
+
+    rule = "BCP005"
+    title = "fault-site parity"
+
+    def __init__(self):
+        self.sites: dict[str, tuple[str, int]] = {}  # site -> decl site
+        self.symbols: dict[str, set[str]] = {}  # site -> declaring consts
+
+    def collect(self, mod: Module) -> None:
+        for node in mod.tree.body:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            t = node.targets[0]
+            if not isinstance(t, ast.Name):
+                continue
+            if (t.id == "SITES" and mod.path.endswith("util/faults.py")
+                    and isinstance(node.value, (ast.Tuple, ast.List))):
+                for e in node.value.elts:
+                    s = const_str(e)
+                    if s:
+                        self.sites.setdefault(s, (mod.path, e.lineno))
+            elif t.id.endswith("_SITE"):
+                s = const_str(node.value)
+                if s:
+                    self.sites.setdefault(s, (mod.path, node.lineno))
+                    self.symbols.setdefault(s, set()).add(t.id)
+
+    def finalize(self, ctx) -> list[Finding]:
+        tests_dir = ctx.get("tests_dir")
+        if not self.sites or not tests_dir:
+            return []
+        tokens: set[str] = set()
+        names: set[str] = set()  # identifiers: symbolic site references
+        for path in iter_py_files([tests_dir]):
+            try:
+                with open(path, "rb") as f:
+                    tree = ast.parse(f.read().decode("utf-8", "replace"))
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                s = const_str(node)
+                if s:
+                    tokens.update(_TOKEN_RE.split(s))
+                elif isinstance(node, ast.Name):
+                    names.add(node.id)
+                elif isinstance(node, ast.Attribute):
+                    names.add(node.attr)
+        out = []
+        for site, (path, line) in sorted(self.sites.items()):
+            if site in tokens:
+                continue
+            if self.symbols.get(site, set()) & names:
+                continue  # drilled via the declaring constant's symbol
+            out.append(Finding(
+                self.rule, path, line,
+                "fault site %r is declared but appears in no test "
+                "under %s — undrilled crash/poison armor"
+                % (site, os.path.basename(tests_dir)),
+                "site:%s" % site))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# BCP006 — jit-tracing hygiene
+# ---------------------------------------------------------------------------
+
+_COERCERS = {"int", "float", "bool"}
+_STATIC_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+
+class JitHygiene(Check):
+    """Inside a jitted body, ``int()/float()/bool()`` of a traced value
+    forces a trace-time concretization error (or worse, a silent
+    host sync); and every devicewatch-watched program must declare a
+    shape budget somewhere, or the retrace sentinel can only count."""
+
+    rule = "BCP006"
+    title = "jit-tracing hygiene"
+
+    def __init__(self):
+        self.coercions: list[Finding] = []
+        self.programs: dict[str, list[tuple[str, int, bool]]] = {}
+
+    @staticmethod
+    def _jit_static_names(func) -> tuple[bool, set[str]]:
+        """(is_jitted, static_argnames) from the decorator list."""
+        for dec in func.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            parts = attr_parts(target) or []
+            term = parts[-1] if parts else None
+            if term == "jit":
+                return True, set()
+            if term == "partial" and isinstance(dec, ast.Call):
+                inner = dec.args[0] if dec.args else None
+                iparts = attr_parts(inner) or []
+                if iparts and iparts[-1] == "jit":
+                    statics: set[str] = set()
+                    for kw in dec.keywords:
+                        if kw.arg in ("static_argnames", "static_argnums"):
+                            v = kw.value
+                            s = const_str(v)
+                            if s:
+                                statics.add(s)
+                            elif isinstance(v, (ast.Tuple, ast.List)):
+                                statics.update(
+                                    x for x in (const_str(e)
+                                                for e in v.elts) if x)
+                    return True, statics
+        return False, set()
+
+    @staticmethod
+    def _static_valued(expr, statics) -> bool:
+        """Heuristically static at trace time: constants, static args,
+        len()/shape/dtype projections."""
+        if isinstance(expr, ast.Constant):
+            return True
+        if isinstance(expr, ast.Name) and expr.id in statics:
+            return True
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call) and call_terminal(sub) == "len":
+                return True
+            if (isinstance(sub, ast.Attribute)
+                    and sub.attr in _STATIC_ATTRS):
+                return True
+        return False
+
+    def collect(self, mod: Module) -> None:
+        for qual, func, _cls in iter_funcs(mod.tree):
+            jitted, statics = self._jit_static_names(func)
+            if jitted:
+                self._scan_jit_body(mod, qual, func, statics)
+            for node in ast.walk(func):
+                if isinstance(node, ast.Call):
+                    self._maybe_program(mod, node)
+        for node in ast.iter_child_nodes(mod.tree):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    self._maybe_program(mod, sub)
+
+    def _scan_jit_body(self, mod, qual, func, statics) -> None:
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Name) and f.id in _COERCERS):
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if self._static_valued(arg, statics):
+                continue
+            try:
+                rendered = ast.unparse(arg)[:40]
+            except Exception:
+                rendered = "?"
+            self.coercions.append(Finding(
+                self.rule, mod.path, node.lineno,
+                "%s(%s) inside a jitted body coerces a traced value to "
+                "a Python scalar — concretization error at trace time"
+                % (f.id, rendered),
+                "%s::coerce:%s:%s" % (qual, f.id, rendered)))
+
+    def _maybe_program(self, mod, call: ast.Call) -> None:
+        f = call.func
+        if not (isinstance(f, ast.Attribute) and f.attr == "program"):
+            return
+        owner = attr_parts(f.value)
+        if not owner or owner[-1] not in ("dw", "devicewatch"):
+            return
+        name = const_str(call.args[0]) if call.args else None
+        if not name:
+            return
+        budgeted = len(call.args) >= 2 or any(
+            kw.arg == "shape_budget" and not (
+                isinstance(kw.value, ast.Constant) and kw.value.value is None)
+            for kw in call.keywords)
+        self.programs.setdefault(name, []).append(
+            (mod.path, call.lineno, budgeted))
+
+    def finalize(self, ctx) -> list[Finding]:
+        out = list(self.coercions)
+        for name, sites in sorted(self.programs.items()):
+            if any(b for _, _, b in sites):
+                continue  # a budgeted registration upgrades the watch
+            path, line, _ = sites[0]
+            out.append(Finding(
+                self.rule, path, line,
+                "devicewatch program %r declares no shape_budget at any "
+                "registration — the retrace sentinel can count shapes "
+                "but never flag a blowout" % name,
+                "program:%s" % name))
+        # dedupe coercion anchors
+        seen: set[str] = set()
+        deduped = []
+        for f in out:
+            if f.key in seen:
+                continue
+            seen.add(f.key)
+            deduped.append(f)
+        return deduped
+
+
+ALL_CHECKS = [TelemetryNamespace, RegisterPairing, BlockingUnderCsMain,
+              LockOrder, FaultSiteParity, JitHygiene]
+
+
+def check_by_rule(rule: str):
+    for c in ALL_CHECKS:
+        if c.rule == rule:
+            return c
+    raise KeyError(rule)

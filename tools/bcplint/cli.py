@@ -1,0 +1,77 @@
+"""bcplint console entry point.
+
+Usage::
+
+    bcplint                      # lint the repo tree with the baseline
+    bcplint pkg/mod.py           # lint specific files/dirs
+    bcplint --no-baseline        # raw findings, baseline ignored
+    bcplint --list-checks        # the check catalog
+
+Exit status: 0 clean, 1 findings (or stale/unjustified baseline
+entries), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .checks import ALL_CHECKS
+from .engine import render_report, run_lint
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline")
+
+
+def _find_root(start: str) -> str:
+    """Walk up to the checkout root (the dir holding the package),
+    trying the cwd first and this file's own checkout as the fallback
+    (an installed console script can run from anywhere)."""
+    here = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    for base in (start, here):
+        d = os.path.abspath(base)
+        while True:
+            if os.path.isdir(os.path.join(d, "bitcoincashplus_tpu")):
+                return d
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+    return os.path.abspath(start)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bcplint",
+        description="project-invariant static analysis (BCP001-BCP006)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the package + tools)")
+    ap.add_argument("--root", default=None,
+                    help="checkout root (default: auto-detected)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: the checked-in one)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report raw findings, ignore the baseline")
+    ap.add_argument("--tests-dir", default=None,
+                    help="tests tree for BCP005 parity (default: <root>/tests)")
+    ap.add_argument("--list-checks", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_checks:
+        for c in ALL_CHECKS:
+            print("%s  %s" % (c.rule, c.title))
+        return 0
+
+    root = args.root or _find_root(os.getcwd())
+    paths = [os.path.abspath(p) for p in args.paths] or None
+    result = run_lint(
+        root, paths=paths,
+        baseline_path=None if args.no_baseline else args.baseline,
+        tests_dir=args.tests_dir)
+    print(render_report(result))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

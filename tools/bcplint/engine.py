@@ -1,0 +1,168 @@
+"""bcplint engine: module loading, check driving, baseline handling.
+
+Findings carry a *stable key* — ``RULE path::anchor`` where the anchor
+names the syntactic subject (qualname + offending name), never a line
+number — so a baseline entry survives unrelated line churn in the file.
+
+Baseline format (one entry per line)::
+
+    BCP001 pkg/mod.py::Class.meth::flat:bcp_foo  # why this is deliberate
+
+Every entry MUST carry a justification after `` # `` — an unjustified
+entry is itself a lint failure, as is a stale entry that no longer
+matches any finding (so the baseline can only shrink honestly).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str        # root-relative, forward slashes
+    line: int
+    message: str
+    anchor: str      # stable subject id (no line numbers)
+
+    @property
+    def key(self) -> str:
+        return "%s %s::%s" % (self.rule, self.path, self.anchor)
+
+    def render(self) -> str:
+        return "%s:%d: %s %s" % (self.path, self.line, self.rule,
+                                 self.message)
+
+
+class Module:
+    """One parsed source file."""
+
+    def __init__(self, root: str, abspath: str):
+        self.abspath = abspath
+        self.path = os.path.relpath(abspath, root).replace(os.sep, "/")
+        with open(abspath, "rb") as f:
+            self.source = f.read().decode("utf-8", "replace")
+        self.tree = ast.parse(self.source, filename=self.path)
+
+
+@dataclass
+class LintResult:
+    findings: list = field(default_factory=list)      # unbaselined Findings
+    baselined: list = field(default_factory=list)     # suppressed Findings
+    stale_entries: list = field(default_factory=list)      # baseline keys
+    unjustified_entries: list = field(default_factory=list)
+    errors: list = field(default_factory=list)        # (path, message)
+
+    @property
+    def ok(self) -> bool:
+        return not (self.findings or self.stale_entries
+                    or self.unjustified_entries or self.errors)
+
+
+def parse_baseline(path: str):
+    """Returns (entries: dict key -> justification-or-None, order list)."""
+    entries: dict[str, str | None] = {}
+    with open(path, encoding="utf-8") as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if " # " in line:
+                key, just = line.split(" # ", 1)
+                entries[key.strip()] = just.strip() or None
+            else:
+                entries[line] = None
+    return entries
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in ("__pycache__", ".git", ".pytest_cache"))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def run_lint(root: str, paths=None, checks=None, baseline_path=None,
+             tests_dir=None) -> LintResult:
+    """Drive ``checks`` over every .py file under ``paths`` (default: the
+    package and tools trees under ``root``), then apply the baseline."""
+    from .checks import ALL_CHECKS
+
+    root = os.path.abspath(root)
+    if paths is None:
+        paths = [os.path.join(root, "bitcoincashplus_tpu"),
+                 os.path.join(root, "tools")]
+    if tests_dir is None:
+        cand = os.path.join(root, "tests")
+        tests_dir = cand if os.path.isdir(cand) else None
+
+    result = LintResult()
+    check_classes = checks if checks is not None else ALL_CHECKS
+    instances = [c() for c in check_classes]
+    ctx = {"root": root, "tests_dir": tests_dir}
+
+    for abspath in iter_py_files(paths):
+        try:
+            mod = Module(root, abspath)
+        except SyntaxError as e:
+            result.errors.append(
+                (os.path.relpath(abspath, root), "syntax error: %s" % e))
+            continue
+        for check in instances:
+            check.collect(mod)
+
+    findings: list[Finding] = []
+    for check in instances:
+        findings.extend(check.finalize(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.anchor))
+
+    if baseline_path and os.path.exists(baseline_path):
+        entries = parse_baseline(baseline_path)
+        matched: set[str] = set()
+        for f in findings:
+            if f.key in entries:
+                matched.add(f.key)
+                if entries[f.key] is None:
+                    result.unjustified_entries.append(f.key)
+                    result.findings.append(f)
+                else:
+                    result.baselined.append(f)
+            else:
+                result.findings.append(f)
+        result.stale_entries.extend(
+            k for k in entries if k not in matched)
+    else:
+        result.findings = findings
+
+    return result
+
+
+def render_report(result: LintResult) -> str:
+    out = []
+    for path, msg in result.errors:
+        out.append("%s: ERROR %s" % (path, msg))
+    for f in result.findings:
+        out.append(f.render())
+    for key in result.unjustified_entries:
+        out.append("baseline entry lacks a justification: %s" % key)
+    for key in result.stale_entries:
+        out.append("stale baseline entry (no matching finding): %s" % key)
+    if result.ok:
+        out.append("bcplint: clean (%d baselined finding(s) justified)"
+                   % len(result.baselined))
+    else:
+        out.append("bcplint: %d finding(s), %d stale, %d unjustified"
+                   % (len(result.findings), len(result.stale_entries),
+                      len(result.unjustified_entries)))
+    return "\n".join(out)
