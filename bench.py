@@ -1940,6 +1940,342 @@ def bench_reindex(device_sps=None):
         shutil.rmtree(mixdir, ignore_errors=True)
 
 
+def _load_functional_framework():
+    """tests/functional/framework.py as a module (the fleet bench drives
+    real bcpd processes through the same harness the functional suite
+    uses; tests/ is not an installed package, so load by path)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tests", "functional", "framework.py")
+    spec = importlib.util.spec_from_file_location("bcp_fleet_framework", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _gw_request(conn_box, port, auth, client_id, method, params,
+                timeout=60.0):
+    """One JSON-RPC call against the gateway's HTTP front door with an
+    explicit per-client identity (X-Client-Id is what the gateway's
+    token buckets key on — every bench client is its own principal).
+    Returns (kind, payload, latency_s) where kind is 'ok' | 'shed' |
+    'rpc_error'. Keep-alive connection per worker, one reconnect on a
+    stale socket."""
+    from http.client import HTTPConnection
+
+    body = json.dumps({"jsonrpc": "2.0", "id": 1, "method": method,
+                       "params": params}).encode()
+    headers = {"Authorization": "Basic " + auth,
+               "Content-Type": "application/json",
+               "X-Client-Id": client_id}
+    for attempt in (0, 1):
+        conn = conn_box[0]
+        if conn is None:
+            conn = conn_box[0] = HTTPConnection("127.0.0.1", port,
+                                                timeout=timeout)
+        t0 = time.monotonic()
+        try:
+            conn.request("POST", "/", body, headers)
+            resp = conn.getresponse()
+            data = json.loads(resp.read())
+        except Exception:
+            try:
+                conn.close()
+            finally:
+                conn_box[0] = None
+            if attempt:
+                raise
+            continue
+        lat = time.monotonic() - t0
+        err = data.get("error")
+        if resp.status == 429 or (err and err.get("code") == -429):
+            return "shed", err, lat
+        if err:
+            return "rpc_error", err, lat
+        return "ok", data.get("result"), lat
+
+
+def bench_fleet():
+    """ISSUE 16 acceptance harness: >= 1000 concurrent seeded clients
+    hold a p99 latency bar against the gateway while a forkfeeder-driven
+    fork storm reorgs the validator underneath and a chaos kill -9 takes
+    a replica out (and back) mid-run. Asserted: zero inconsistent
+    replies (every replied tip is a block the validator recognizes),
+    nonzero shed + coalesce counters, >= 1 mid-request failover, and a
+    byte-identical chainstate digest across validator and replicas at
+    quiesce. Writes BENCH_r16.json (schema_version=2 host stamp)."""
+    import base64
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    fw = _load_functional_framework()
+    from bitcoincashplus_tpu.consensus.params import regtest_params
+    from bitcoincashplus_tpu.wallet.keys import CKey
+
+    n_clients = int(os.environ.get("BCP_BENCH_FLEET_CLIENTS", "1000"))
+    reqs_per = int(os.environ.get("BCP_BENCH_FLEET_REQS", "3"))
+    workers = int(os.environ.get("BCP_BENCH_FLEET_WORKERS", "16"))
+    p99_bar_ms = float(os.environ.get("BCP_BENCH_FLEET_P99_MS", "2500"))
+    seed = int(os.environ.get("BCP_BENCH_FLEET_SEED", "1607"))
+    chain_h = 24
+    addr = CKey(0xF1EE7).p2pkh_address(regtest_params())
+
+    f = fw.FunctionalFramework(num_nodes=4)
+    # node0 validator+gateway, nodes 1-2 replicas, node3 storm miner
+    # (NOT in the pool). Tight per-client buckets so the hot clients
+    # below provably shed: burst 10, refill 5/s, read floor 2.5.
+    fw.setup_fleet(f, replicas=f.nodes[1:3])
+    f.nodes[0].extra_args += ["-gatewayrate=5", "-gatewayburst=10"]
+    t_run0 = time.monotonic()
+    with f:
+        validator, r1, r2, storm = f.nodes
+        gw_port, auth = validator.gateway_port, base64.b64encode(
+            f"{fw.FLEET_USER}:{fw.FLEET_PASSWORD}".encode()).decode()
+        validator.rpc.generatetoaddress(chain_h, addr)
+        fw.connect_nodes(storm, validator)
+        fw.sync_blocks([validator, storm], timeout=60)
+
+        # snapshot-bootstrap both replicas (the 30-second spin-up path)
+        snap = os.path.join(validator.datadir, "fleet-bench-snapshot")
+        dump = validator.rpc.dumptxoutset(snap)
+        for rep in (r1, r2):
+            fw.bootstrap_replica_from_snapshot(rep, validator, snap, dump)
+
+        def rotation():
+            pool = validator.rpc.gettpuinfo()["gateway"]["pool"]
+            return {r["name"] for r in pool["replicas"] if r["in_rotation"]}
+
+        fw.wait_until(lambda: len(rotation()) == 2, timeout=60)
+        for rep in (r1, r2):
+            fw.wait_until(lambda rep=rep: rep.rpc.gettpuinfo()["store"]
+                          ["snapshot"]["validated"], timeout=180, sleep=1.0)
+
+        # pre-mine the competing branch: the storm miner forks the tip
+        # and out-works the validator's own extension by one block. Its
+        # raw blocks become the forkfeeder's ammunition; the miner then
+        # leaves the stage (this host is small).
+        fw.disconnect_nodes(storm, validator)
+        validator.rpc.generatetoaddress(3, addr)
+        b_hashes = storm.rpc.generatetoaddress(4, addr)
+        branch_b = [bytes.fromhex(storm.rpc.getblock(h, 0))
+                    for h in b_hashes]
+        b_tip = b_hashes[-1]
+        storm.stop()
+
+        # -- the storm: seeded client fleet + fork reorg + chaos kill --
+        state = {"tip": validator.rpc.getbestblockhash()}
+        storm_done = threading.Event()
+        rng = random.Random(seed)
+        jobs = []
+        for i in range(n_clients):
+            crng = random.Random(seed + i)
+            for _ in range(reqs_per):
+                r = crng.random()
+                if r < 0.5:
+                    jobs.append((f"c{i}", "getbestblockhash", None))
+                elif r < 0.7:
+                    jobs.append((f"c{i}", "getblockcount", None))
+                elif r < 0.9:
+                    jobs.append((f"c{i}", "getblock", "TIP"))
+                else:
+                    jobs.append((f"c{i}", "getblockhash",
+                                 [crng.randint(1, chain_h)]))
+        rng.shuffle(jobs)
+        # 5 hot clients hammer 40 rapid reads each, spliced in as
+        # CONTIGUOUS runs (shuffling would spread them across the whole
+        # run and let their buckets refill): 40 near-simultaneous reads
+        # against a burst-10 bucket guarantees the shed counter moves
+        for h in range(5):
+            cut = (h + 1) * len(jobs) // 6
+            jobs[cut:cut] = [(f"hot{h}", "getbestblockhash", None)] * 40
+        job_q, counts_lock = iter(jobs), threading.Lock()
+        shared = {"lat": [], "tips": set(), "ok": 0, "shed": 0,
+                  "rpc_error": 0, "transport_error": 0}
+
+        def drain(job_iter, wid):
+            conn_box, local_lat, local_tips = [None], [], set()
+            ok = shed = rpc_err = terr = 0
+            k = 0
+            while True:
+                with counts_lock:
+                    job = next(job_iter, None)
+                if job is None:
+                    if storm_done.is_set():
+                        break
+                    # keep the pressure on until the storm script ends:
+                    # filler reads on rotating seeded identities
+                    job = (f"c{(k * 131 + wid) % n_clients}",
+                           "getbestblockhash", None)
+                    k += 1
+                cid, method, params = job
+                if params == "TIP":
+                    params = [state["tip"]]
+                try:
+                    kind, payload, lat = _gw_request(
+                        conn_box, gw_port, auth, cid, method, params or [])
+                except Exception:
+                    terr += 1
+                    continue
+                if kind == "shed":
+                    shed += 1
+                    continue
+                if kind == "rpc_error":
+                    rpc_err += 1
+                    local_lat.append(lat)
+                    continue
+                ok += 1
+                local_lat.append(lat)
+                if method == "getbestblockhash":
+                    local_tips.add(payload)
+                    state["tip"] = payload
+                elif method == "getblock":
+                    local_tips.add(payload["hash"])
+            with counts_lock:
+                shared["lat"] += local_lat
+                shared["tips"] |= local_tips
+                shared["ok"] += ok
+                shared["shed"] += shed
+                shared["rpc_error"] += rpc_err
+                shared["transport_error"] += terr
+
+        pool_exec = ThreadPoolExecutor(max_workers=workers)
+        futures = [pool_exec.submit(drain, job_q, w)
+                   for w in range(workers)]
+        events = {}
+        try:
+            # event 1: forkfeeder replays the longer competing branch —
+            # the validator MUST reorg underneath the serving load
+            t0 = time.monotonic()
+            feeder = fw.ChaosPeer(validator.p2p_port, "forkfeeder",
+                                  seed=seed, blocks=branch_b,
+                                  block_rate=200)
+            feeder.start()
+            fw.wait_until(
+                lambda: validator.rpc.getbestblockhash() == b_tip,
+                timeout=90)
+            events["reorg_s"] = round(time.monotonic() - t0, 3)
+            feeder.stop()
+
+            # event 2: chaos kill -9 of replica 1 mid-run, then restart
+            # and re-admission — serving must not flinch in between
+            t0 = time.monotonic()
+            r1.kill9()
+            time.sleep(1.0)
+            r1.start()
+            fw.connect_nodes(r1, validator)
+            fw.wait_until(lambda: len(rotation()) == 2, timeout=120)
+            events["kill_rejoin_s"] = round(time.monotonic() - t0, 3)
+
+            # event 3: one more reorg cycle (invalidate/extend/
+            # reconsider) so the storm has > 1 reorg in it
+            count = validator.rpc.getblockcount()
+            h = validator.rpc.getblockhash(count - 1)
+            validator.rpc.invalidateblock(h)
+            validator.rpc.generatetoaddress(3, addr)
+            validator.rpc.reconsiderblock(h)
+            events["reorgs"] = 2
+        finally:
+            storm_done.set()
+            for fut in futures:
+                fut.result(timeout=300)
+            pool_exec.shutdown()
+
+        # coalesce flush: one barrier-released wave of identical reads
+        # (the organic mix usually coalesces too; this makes it certain)
+        tip = validator.rpc.getbestblockhash()
+        barrier = threading.Barrier(workers)
+
+        def identical(w):
+            # distinct client ids: coalescing keys on method+params, and
+            # a shared id would shed the wave in its own token bucket
+            box = [None]
+            barrier.wait()
+            return _gw_request(box, gw_port, auth, f"burst{w}", "getblock",
+                               [tip])
+        with ThreadPoolExecutor(max_workers=workers) as ex:
+            burst = list(ex.map(identical, range(workers)))
+        assert all(k == "ok" and p["hash"] == tip for k, p, _ in burst)
+
+        # -- quiesce: settle, then the byte-identical chainstate check --
+        validator.rpc.generatetoaddress(1, addr)
+        final_tip = validator.rpc.getbestblockhash()
+        fw.wait_until(lambda: r1.rpc.getbestblockhash() == final_tip
+                      and r2.rpc.getbestblockhash() == final_tip,
+                      timeout=120)
+        infos = [n.rpc.gettxoutsetinfo() for n in (validator, r1, r2)]
+        identical_chainstate = (
+            len({i["muhash"] for i in infos}) == 1
+            and len({i["bestblock"] for i in infos}) == 1)
+
+        # consistency: every tip a client was ever told is a block the
+        # validator recognizes — no invented, corrupt, or cross-wired
+        # reply survived the storm
+        inconsistent = 0
+        for h in shared["tips"]:
+            try:
+                validator.rpc.getblockheader(h)
+            except Exception:
+                inconsistent += 1
+        stats = validator.rpc.gettpuinfo()["gateway"]
+
+    lat = sorted(shared["lat"])
+
+    def pctl(q):
+        return round(lat[int(q * (len(lat) - 1))] * 1e3, 2)
+
+    p99 = pctl(0.99)
+    served = shared["ok"] + shared["rpc_error"]
+    # the acceptance bar, asserted (env-tunable for slower hosts)
+    assert inconsistent == 0, f"{inconsistent} inconsistent replies"
+    assert identical_chainstate, "chainstate digests diverged at quiesce"
+    assert stats["sheds"]["read"] > 0, "shed counter never moved"
+    assert stats["coalesce_hits"] > 0, "coalesce counter never moved"
+    assert stats["failovers"] >= 1, "no mid-request failover recorded"
+    assert shared["shed"] > 0 and served >= n_clients
+    p99_ok = p99 <= p99_bar_ms
+    assert p99_ok, f"p99 {p99} ms over the {p99_bar_ms} ms bar"
+    result = {
+        "metric": "fleet_storm",
+        **_bench_stamp(),
+        "clients": n_clients,
+        "workers": workers,
+        "requests": {"served": served, "ok": shared["ok"],
+                     "shed": shared["shed"],
+                     "rpc_error": shared["rpc_error"],
+                     "transport_error": shared["transport_error"]},
+        "latency_ms": {"p50": pctl(0.50), "p95": pctl(0.95), "p99": p99},
+        "p99_bar_ms": p99_bar_ms,
+        "p99_ok": p99_ok,
+        "events": events,
+        "gateway": {"admitted": stats["admitted"],
+                    "sheds": stats["sheds"],
+                    "coalesce_hits": stats["coalesce_hits"],
+                    "failovers": stats["failovers"],
+                    "validator_fallback": stats["validator_fallback"],
+                    "rotations_out": stats["pool"]["rotations_out"]},
+        "distinct_tips_replied": len(shared["tips"]),
+        "inconsistent_replies": inconsistent,
+        "chainstate_identical": identical_chainstate,
+        "wall_s": round(time.monotonic() - t_run0, 3),
+        "note": "gateway front door over 2 snapshot-bootstrapped "
+                "replicas: seeded client fleet holds the p99 bar while "
+                "a forkfeeder fork storm reorgs the validator and a "
+                "chaos kill -9 takes a replica out and back mid-run; "
+                "every replied tip verified against the validator's "
+                "block index, chainstate digests compared at quiesce",
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_r16.json"), "w") as fh:
+        json.dump(result, fh, indent=1)
+        fh.write("\n")
+    emit("fleet_storm_p99", p99, "ms", round(p99_bar_ms / max(p99, 1e-3), 3),
+         **{k: v for k, v in result.items() if k != "metric"})
+    return {"fleet_p99_ms": p99,
+            "fleet_inconsistent_replies": inconsistent,
+            "fleet_chainstate_identical": identical_chainstate}
+
+
 def _device_reachable(timeout_s: int = 180) -> bool:
     """Guard against a wedged device tunnel: backend init hangs forever in
     that state (observed this round) inside C code, where neither signals
@@ -1986,6 +2322,12 @@ def main():
              error=f"{type(e).__name__}: {e}")
     recap.update(bench_telemetry_overhead() or {})  # ISSUE 6: < 2% budget
     recap.update(bench_serving() or {})  # ISSUE 7: serviced >= 2x sync
+    if os.environ.get("BCP_BENCH_FLEET", "1") != "0":
+        try:
+            recap.update(bench_fleet() or {})  # ISSUE 16: front door
+        except Exception as e:  # pragma: no cover - diagnostics only
+            emit("fleet_storm_p99", -1, "ms", 0.0,
+                 error=f"{type(e).__name__}: {e}")
     try:
         recap.update(bench_dispatch_breakdown() or {})  # ISSUE 8: phases
     except Exception as e:  # pragma: no cover - diagnostics only
@@ -2010,5 +2352,9 @@ if __name__ == "__main__":
         bench_mining()
     elif len(sys.argv) > 1 and sys.argv[1] == "utxo_store":
         bench_utxo_store()
+    elif len(sys.argv) > 1 and sys.argv[1] == "fleet":
+        # multi-process fleet storm: children force JAX_PLATFORMS=cpu,
+        # no device needed in this process either
+        bench_fleet()
     else:
         main()

@@ -50,6 +50,8 @@ def main(argv=None) -> int:
             node.start_p2p()
         except Exception as e:
             print(f"P2P disabled: {e}", file=sys.stderr)
+    if config.get_int("gateway", 0):
+        node.start_gateway()
 
     print(f"bcpd started: network={node.params.network} datadir={node.datadir}",
           flush=True)

@@ -119,11 +119,30 @@ Options:
   -nettick=<n>           P2P supervision tick interval in seconds (default: 5)
   -netseed=<n>           Seed for the network rng (orphan eviction); -1 = OS
                          entropy (default: -1)
+  -backfilltimeout=<n>   Seconds before an assumeutxo backfill request is
+                         torn off its peer and retried on another (default:
+                         min(10, -blockdownloadtimeout))
   -rpcport=<port>        Listen for JSON-RPC connections on <port>
   -rpcbind=<addr>        Bind RPC to address (default: 127.0.0.1)
   -rpcuser=<user>        Username for JSON-RPC connections (default: cookie auth)
   -rpcpassword=<pw>      Password for JSON-RPC connections
   -server                Accept JSON-RPC commands (default: 1 for bcpd)
+  -gateway=<port>        Run the fleet serving front door on <port>: a
+                         load-balancing JSON-RPC gateway over the -replicas
+                         pool with per-client token-bucket admission,
+                         graduated shedding, request coalescing and
+                         mid-request failover (default: off)
+  -replicas=<host:port,...>  Read-replica RPC endpoints behind -gateway
+                         (snapshot-bootstrapped bcpd nodes sharing this
+                         node's -rpcuser/-rpcpassword)
+  -maxreplicalag=<n>     Consistency gate: rotate a replica out of serving
+                         once its probed tip lags the pool fan-out height
+                         by more than <n> blocks (default: 2)
+  -gatewayrate=<n>       Per-client admission refill in requests/sec
+                         (default: 500); -gatewayburst=<n> bucket capacity
+                         (default: 200); -gatewaysoft/-gatewayhard in-flight
+                         ceilings where read-only / all traffic sheds
+                         (defaults: 64 / 256)
   -flushinterval=<n>     Flush chainstate every <n> connected blocks (default: 64)
 """
 

@@ -499,6 +499,9 @@ class Node:
             "maxunconnectingheaders":
                 config.get_int("maxunconnectingheaders", 10),
         }
+        bft = config.get_int("backfilltimeout", 0)
+        if bft > 0:
+            self.net_limits["backfilltimeout"] = bft
         # -limitancestorcount/-limitancestorsize (kB)/-limitdescendantcount/
         # -limitdescendantsize (kB): ATMP chain limits (validation.h defaults)
         self.ancestor_limits = {
@@ -547,6 +550,33 @@ class Node:
 
         self.rpc_server = None
         self.connman = None  # set by start_p2p
+        # fleet serving front door (serving/gateway, ISSUE 16):
+        # -gateway=<port> binds the admission-controlled load balancer,
+        # -replicas=<host:port,...> names the snapshot-bootstrapped read
+        # replicas, -maxreplicalag bounds how far a served replica may
+        # trail the pool fan-out height (the consistency gate). Flags are
+        # validated here so a malformed fleet spec fails init, not the
+        # first probe.
+        self.gateway = None  # set by start_gateway
+        self.gateway_port = config.get_int("gateway", 0)
+        if self.gateway_port < 0 or self.gateway_port > 65535:
+            raise ConfigError(f"-gateway: invalid port {self.gateway_port}")
+        self.max_replica_lag = config.get_int("maxreplicalag", 2)
+        if self.max_replica_lag < 0:
+            raise ConfigError(
+                f"-maxreplicalag must be >= 0 (got {self.max_replica_lag})")
+        self.replica_addrs: list[tuple[str, int]] = []
+        for spec in str(config.get("replicas", "")).split(","):
+            spec = spec.strip()
+            if not spec:
+                continue
+            host, _, port = spec.rpartition(":")
+            try:
+                self.replica_addrs.append((host or "127.0.0.1", int(port)))
+            except ValueError:
+                raise ConfigError(
+                    f"-replicas: malformed entry '{spec}' "
+                    f"(want host:port[,host:port...])") from None
         self.wallet = None  # set by load_wallet
         # wallet-load coordination: RPC threads arriving while another
         # thread is mid-rescan must NOT see partial coin state (the rescan
@@ -1968,6 +1998,64 @@ class Node:
             self.connman.connect_to(host or "127.0.0.1", int(p))
         return self.connman.port
 
+    def start_gateway(self) -> int:
+        """Bind the fleet serving front door (-gateway) over the
+        -replicas pool; returns the bound port. The validator leg
+        executes RPC handlers in-process (same dispatch as rpc/server);
+        the replica legs speak JSON-RPC HTTP with the node's own
+        -rpcuser/-rpcpassword — a fleet shares RPC credentials."""
+        import base64
+
+        from ..rpc.registry import RPC_METHODS, RPCError
+        from ..serving.gateway import BackendRPCError, Gateway
+        from ..serving.replicas import Replica, ReplicaPool, http_transport
+
+        def _backend(method, params):
+            handler = RPC_METHODS.get(method)
+            if handler is None:
+                raise BackendRPCError(
+                    {"code": -32601, "message": "Method not found"})
+            try:
+                if getattr(handler, "no_cs_main", False):
+                    return handler(self, list(params))
+                with self.cs_main:
+                    return handler(self, list(params))
+            except RPCError as e:
+                raise BackendRPCError(
+                    {"code": e.code, "message": e.message}) from e
+
+        def _tip_height() -> int:
+            with self.cs_main:
+                return self.chainstate.tip().height
+
+        user = self.config.get("rpcuser")
+        password = self.config.get("rpcpassword")
+        if user and password:
+            auth = base64.b64encode(f"{user}:{password}".encode()).decode()
+        elif self.rpc_server is not None:
+            auth = self.rpc_server._auth  # cookie-auth fleet (tests)
+        else:
+            raise InitError("-gateway needs -rpcuser/-rpcpassword (or a "
+                            "running RPC server's cookie) for replica auth")
+        replicas = [
+            Replica(f"{host}:{port}", http_transport(host, port, auth))
+            for host, port in self.replica_addrs
+        ]
+        pool = ReplicaPool(
+            replicas, max_lag=self.max_replica_lag,
+            probe_interval=self.config.get_int("gatewayprobems", 500) / 1e3,
+            validator_tip=_tip_height)
+        self.gateway = Gateway(
+            _backend, pool,
+            rate=self.config.get_int("gatewayrate", 500),
+            burst=self.config.get_int("gatewayburst", 200),
+            soft_inflight=self.config.get_int("gatewaysoft", 64),
+            hard_inflight=self.config.get_int("gatewayhard", 256),
+            bind=self.config.get("gatewaybind", "127.0.0.1"),
+            port=self.gateway_port, auth_b64=auth)
+        self.gateway.start()
+        return self.gateway.port
+
     def load_wallet(self):
         from ..wallet.wallet import Wallet
 
@@ -2131,6 +2219,11 @@ class Node:
                 self.chainstate.on_block_connected.remove(self._zmq_block)
             except ValueError:
                 pass
+        if self.gateway is not None:
+            # front door first: stop admitting before the backends close
+            # (also unregisters the gateway's registry collector)
+            self.gateway.close()
+            self.gateway = None
         if self.rpc_server is not None:
             self.rpc_server.close()
             self.rpc_server = None

@@ -282,7 +282,25 @@ class CConnman:
             "flood_charges": 0,         # recv-rate ceiling violations
             "orphans_evicted": 0,       # random evictions at the budget
             "net_faults_injected": 0,   # BCP_FAULT_OPS=net drops
+            "backfill_retries": 0,      # backfill deadlines that fired
+            "backfill_peer_evictions": 0,  # peers struck from backfill
         }
+        # assumeutxo backfill supervision: the shadow-validation thread's
+        # history pull must never wedge behind one dead/stalling peer for
+        # a full blockdownloadtimeout — every backfill hash carries its
+        # own (shorter) deadline; on expiry the hash is torn off its
+        # owner and re-requested from the NEXT peer after a jittered
+        # Backoff pause, and a peer that repeatedly eats backfill
+        # requests is struck out of the backfill rotation (it still
+        # serves normal announcements — the strike-out is scoped to the
+        # pull the peer demonstrably can't serve).
+        self.backfill_timeout = float(limits.get(
+            "backfilltimeout", min(10.0, self.block_download_timeout)))
+        # hash -> {"peer": owner id, "deadline": abs time, "boff":
+        #          per-hash Backoff, "retry_at": pause gate (0 = none)}
+        self._backfill: dict[bytes, dict] = {}
+        self._backfill_strikes: dict[int, int] = {}
+        self._backfill_evicted: set[int] = set()
         self.discharge_reasons: dict[str, int] = {}  # reason -> evictions
         # CConnman/BanMan (src/banman.cpp): ip -> ban-expiry unix time.
         # Host granularity (no CIDR) matching how we track peers. Persisted
@@ -435,6 +453,9 @@ class CConnman:
                 self.net_stats["flood_charges"] += 1
                 self.misbehaving(peer, CHARGE_RECV_FLOOD, "recv-flood")
             self._check_stall(peer, now)
+        # backfill deadline sweep (assumeutxo history pull supervision)
+        if self._backfill:
+            self._tick_backfill(now)
         # blocks orphaned by a stalled/vanished owner with no available
         # announcer at the time: hand them to an announcer that freed up
         # (hashes whose announcers are all gone are dropped inside)
@@ -573,6 +594,10 @@ class CConnman:
         else:
             self._request_blocks(peer, hashes)
 
+    # consecutive backfill deadline misses before a peer is struck out
+    # of the backfill rotation (redeemed by delivering any wanted block)
+    BACKFILL_EVICT_STRIKES = 3
+
     def request_backfill(self, hashes: list[bytes]) -> None:
         """Pull specific historical blocks (assumeutxo background sync).
 
@@ -582,25 +607,104 @@ class CConnman:
         Thread-safe (called from the snapshot-verify thread); chunks are
         spread round-robin across live peers and from there inherit all of
         the normal in-flight dedupe, stall detection and re-request
-        routing."""
-        if self.loop is None or not hashes:
+        routing — plus a per-hash backfill deadline (backfilltimeout,
+        much shorter than the stall window) so a dead peer can't wedge
+        the shadow-validation thread: _tick_backfill retries elsewhere."""
+        if not hashes:
             return
         wanted = list(hashes)
 
         def _go() -> None:
-            peers = [p for p in self.peers.values()
-                     if p.handshaked and not p.stalling and not p.discharged]
-            if not peers:
-                # no usable peer yet — park them; every future announcer
-                # (or redeemed staller) picks them up via _tick
-                self._unrequested.update(wanted)
-                return
-            for i, peer in enumerate(peers):
-                chunk = wanted[i::len(peers)]
-                if chunk:
-                    self._request_blocks(peer, chunk)
+            self._backfill_dispatch(wanted, time.time())
 
-        self.loop.call_soon_threadsafe(_go)
+        if self.loop is None:
+            _go()  # unit tests drive connman with no event loop
+        else:
+            self.loop.call_soon_threadsafe(_go)
+
+    def _backfill_peers(self, exclude: int = -1) -> list[Peer]:
+        """Peers eligible to serve a backfill pull. Struck-out peers are
+        skipped while any alternative exists; when every live peer is
+        struck out they are used anyway — a degraded pull beats a wedged
+        one, and a delivery un-strikes the peer."""
+        live = [p for p in self.peers.values()
+                if p.handshaked and not p.stalling and not p.discharged
+                and p.id != exclude]
+        fresh = [p for p in live if p.id not in self._backfill_evicted]
+        return fresh if fresh else live
+
+    def _backfill_dispatch(self, wanted: list[bytes], now: float) -> None:
+        boff = lambda: Backoff(base=0.25, factor=2.0, maximum=5.0,  # noqa: E731
+                               rng=self._rng)
+        for h in wanted:
+            self._backfill.setdefault(h, {
+                "peer": -1, "deadline": now + self.backfill_timeout,
+                "boff": boff(), "retry_at": 0.0,
+            })
+        peers = self._backfill_peers()
+        if not peers:
+            # no usable peer yet — park them; every future announcer
+            # (or redeemed staller) picks them up via _tick
+            self._unrequested.update(wanted)
+            return
+        for i, peer in enumerate(peers):
+            chunk = [h for h in wanted[i::len(peers)]
+                     if h not in self._requested_blocks]
+            if chunk:
+                self._request_blocks(peer, chunk, now=now)
+                for h in chunk:
+                    self._backfill[h]["peer"] = peer.id
+
+    def _tick_backfill(self, now: float) -> None:
+        """Per-tick backfill deadline sweep: expire overdue pulls, strike
+        their owners, and re-request each hash from the next eligible
+        peer after a jittered Backoff pause (the pause keeps a flapping
+        peer set from being hammered in lockstep)."""
+        for h, entry in list(self._backfill.items()):
+            owner_id = self._requested_blocks.get(h)
+            if owner_id is None and h not in self._unrequested \
+                    and not entry["retry_at"]:
+                # delivered (or dropped) through the normal path — retire
+                self._backfill.pop(h, None)
+                continue
+            if entry["retry_at"]:
+                if now >= entry["retry_at"]:
+                    entry["retry_at"] = 0.0
+                    self._backfill_retry(h, entry, now)
+                continue
+            if now < entry["deadline"]:
+                continue
+            # deadline fired: tear the hash off its owner and schedule
+            # the retry; the owner is struck (evicted from the backfill
+            # rotation at BACKFILL_EVICT_STRIKES)
+            self.net_stats["backfill_retries"] += 1
+            if owner_id is not None:
+                owner = self.peers.get(owner_id)
+                if owner is not None:
+                    owner.inflight.discard(h)
+                strikes = self._backfill_strikes.get(owner_id, 0) + 1
+                self._backfill_strikes[owner_id] = strikes
+                if strikes >= self.BACKFILL_EVICT_STRIKES \
+                        and owner_id not in self._backfill_evicted:
+                    self._backfill_evicted.add(owner_id)
+                    self.net_stats["backfill_peer_evictions"] += 1
+                    log_print("net", "peer=%d struck out of backfill "
+                              "rotation (%d missed deadlines)",
+                              owner_id, strikes)
+                entry["peer"] = owner_id
+            self._requested_blocks.pop(h, None)
+            self._unrequested.discard(h)
+            entry["retry_at"] = now + entry["boff"].next()
+
+    def _backfill_retry(self, h: bytes, entry: dict, now: float) -> None:
+        peers = self._backfill_peers(exclude=entry["peer"])
+        if not peers:
+            self._unrequested.add(h)  # parked; _tick hands it out later
+            return
+        peer = peers[self._rng.randrange(len(peers))]
+        if self._request_blocks(peer, [h], now=now):
+            entry["peer"] = peer.id
+            entry["deadline"] = now + self.backfill_timeout
 
     def _note_block_arrival(self, peer: Peer, h: bytes,
                             wire_bytes: int = 0,
@@ -615,6 +719,11 @@ class CConnman:
         original owner finally delivered): clear the recorded owner's
         in-flight entry too, or that owner would be falsely marked
         stalling over a block we already have."""
+        if self._backfill.pop(h, None) is not None:
+            # a delivered backfill block redeems the deliverer's strikes
+            # and re-admits it to the backfill rotation
+            self._backfill_strikes.pop(peer.id, None)
+            self._backfill_evicted.discard(peer.id)
         owner_id = self._requested_blocks.pop(h, None)
         parked = h in self._unrequested
         self._unrequested.discard(h)
@@ -1031,6 +1140,9 @@ class CConnman:
             self._erase_orphans_for(peer.id)
             self._reassign_inflight(peer)
             self._erase_sources_for(peer.id)
+            # peer ids are never reused — drop its backfill ledger rows
+            self._backfill_strikes.pop(peer.id, None)
+            self._backfill_evicted.discard(peer.id)
             try:
                 peer.writer.close()
             except Exception:

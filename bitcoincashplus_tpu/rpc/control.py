@@ -113,7 +113,10 @@ def gettpuinfo(node, params):
     reasons, stall re-requests, flood charges, orphan pool accounting,
     banlist size), plus the sharded chainstate store (``store``: shard
     fan-out, commit epoch, MuHash set digest, last parallel flush,
-    assumeutxo snapshot progress — store/sharded.py)."""
+    assumeutxo snapshot progress — store/sharded.py), and — when the
+    fleet front door is up — the gateway (``gateway``: admission/shed/
+    coalesce/failover tallies and the replica rotation with per-replica
+    breaker state and probed tips — serving/gateway.py)."""
     from ..ops import dispatch, ecdsa_batch
     from ..util import faults
 
@@ -163,6 +166,13 @@ def gettpuinfo(node, params):
         # -sigservice=off
         "serving": (node.sigservice.snapshot()
                     if getattr(node, "sigservice", None) is not None
+                    else {"enabled": False}),
+        # fleet serving front door (serving/gateway): admission/shed/
+        # coalesce/failover tallies plus the replica rotation (per-replica
+        # breaker state, probed tip, lag verdict); {"enabled": False}
+        # unless -gateway is up
+        "gateway": ({"enabled": True, **node.gateway.snapshot()}
+                    if getattr(node, "gateway", None) is not None
                     else {"enabled": False}),
         # unified-telemetry view (util/telemetry): the active level, span
         # ring-buffer occupancy, and the serving path's p50/p90/p99

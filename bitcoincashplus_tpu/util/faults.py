@@ -66,6 +66,17 @@ NET_SITE = "net"
 # Explicit-only: "all" must keep meaning the accelerator subsystems so
 # the dead-backend drills don't suddenly fail chainstate flushes.
 
+# Fleet serving injection sites (ISSUE 16), both explicit-only for the
+# same reason as "net": "all" keeps meaning the accelerator subsystems.
+# GATEWAY_SITE fires at the gateway's admission/dispatch boundary —
+# fail-* models a front-door hiccup the client sees as a retryable RPC
+# error, latency-spike a slow front door (burns the admission budget,
+# drives graduated shedding). REPLICA_RPC_SITE fires on the replica leg
+# of every proxied read — fail-* models a dying replica (drives breaker
+# trips and mid-request failover), latency-spike a GC-pausing one.
+GATEWAY_SITE = "gateway"
+REPLICA_RPC_SITE = "replica_rpc"
+
 
 class InjectedFault(RuntimeError):
     """A deliberately injected device failure (never raised in production

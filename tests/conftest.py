@@ -86,7 +86,12 @@ def pytest_collection_modifyitems(config, items):
                 return 2
             return 1 if item.get_closest_marker("pipeline") else 0
         # the ``snapshot`` onboarding test runs after the plain
-        # functional group, then adversarial, then forkstorm dead last
+        # functional group, then adversarial, then forkstorm, then the
+        # ``fleet`` multi-node serving campaigns dead last (ISSUE 16 —
+        # the newest, heaviest topologies are the first thing a CI
+        # timeout cuts)
+        if item.get_closest_marker("fleet"):
+            return 10
         if item.get_closest_marker("forkstorm"):
             return 9
         if item.get_closest_marker("adversarial"):

@@ -44,6 +44,12 @@ class TestNode:
         self.extra_args = list(extra_args)
         self.process: subprocess.Popen | None = None
         self.rpc = None
+        # fleet mode (setup_fleet): explicit shared RPC credentials
+        # instead of per-datadir cookie auth, and the gateway's bound
+        # port when this node fronts the fleet
+        self.rpc_user: str | None = None
+        self.rpc_password: str | None = None
+        self.gateway_port: int | None = None
 
     def args(self, extra: list[str] = ()) -> list[str]:
         return [
@@ -111,8 +117,14 @@ class TestNode:
                     f"node{self.index} died at startup:\n{err.decode()[-2000:]}"
                 )
             try:
-                self.rpc = RPCClient(port=self.rpc_port, datadir=self.datadir,
-                                     timeout=60.0)
+                if self.rpc_user:
+                    self.rpc = RPCClient(port=self.rpc_port,
+                                         user=self.rpc_user,
+                                         password=self.rpc_password,
+                                         timeout=60.0)
+                else:
+                    self.rpc = RPCClient(port=self.rpc_port,
+                                         datadir=self.datadir, timeout=60.0)
                 self.rpc.getblockcount()
                 return
             except Exception as e:  # cookie not written / socket refused yet
@@ -480,6 +492,63 @@ def raw_headers_for(node: TestNode, count: int) -> list[bytes]:
         raw_block = node.rpc.getblock(node.rpc.getblockhash(height), 0)
         out.append(bytes.fromhex(raw_block)[:80])
     return out
+
+
+# -- fleet topology (ISSUE 16: gateway + read replicas) ----------------
+
+FLEET_USER, FLEET_PASSWORD = "fleet", "fleetpw"
+
+
+def setup_fleet(f: FunctionalFramework, user: str = FLEET_USER,
+                password: str = FLEET_PASSWORD,
+                replicas: list[TestNode] | None = None) -> int:
+    """Wire a (not-yet-started) FunctionalFramework as a serving fleet:
+    node0 is the validator AND runs the -gateway front door; every other
+    node (or the explicit ``replicas`` subset — a bench fleet may carry
+    extra storm-miner nodes that must stay OUT of the pool) is a read
+    replica in its -replicas pool. The whole fleet shares explicit RPC
+    credentials (the gateway's replica legs authenticate with the
+    validator's own -rpcuser/-rpcpassword — cookie files are per-datadir
+    and unusable across processes). Returns the gateway port. Call
+    BEFORE ``with f:`` / ``f.__enter__``."""
+    for node in f.nodes:
+        node.rpc_user, node.rpc_password = user, password
+        node.extra_args += [f"-rpcuser={user}", f"-rpcpassword={password}"]
+    validator = f.nodes[0]
+    replicas = list(replicas) if replicas is not None else f.nodes[1:]
+    gport = _free_port()
+    validator.gateway_port = gport
+    validator.extra_args += [
+        f"-gateway={gport}",
+        "-replicas=" + ",".join(
+            f"127.0.0.1:{r.rpc_port}" for r in replicas),
+    ]
+    return gport
+
+
+def gateway_client(validator: TestNode, user: str = FLEET_USER,
+                   password: str = FLEET_PASSWORD, timeout: float = 60.0):
+    """RPC client speaking to the fleet's front door (not the node RPC)."""
+    from bitcoincashplus_tpu.rpc.client import RPCClient
+
+    assert validator.gateway_port, "setup_fleet() first"
+    return RPCClient(port=validator.gateway_port, user=user,
+                     password=password, timeout=timeout)
+
+
+def bootstrap_replica_from_snapshot(replica: TestNode, validator: TestNode,
+                                    snap_path: str, dump: dict) -> None:
+    """Snapshot-onboard a replica (the 30-second spin-up): restart with
+    the -assumeutxo authorization, load the validator-produced snapshot,
+    and connect to the validator for tip fan-out + background history
+    backfill over the normal P2P path."""
+    replica.stop()
+    auth = f"-assumeutxo={dump['bestblock']}:{dump['muhash']}"
+    if auth not in replica.extra_args:
+        replica.extra_args.append(auth)
+    replica.start()
+    replica.rpc.loadtxoutset(snap_path)
+    connect_nodes(replica, validator)
 
 
 # -- sync barriers (test_framework/util.py) ----------------------------

@@ -1,0 +1,171 @@
+"""Fleet serving front door, end to end over real bcpd processes
+(ISSUE 16).
+
+node0 is the validator and runs the ``-gateway`` front door; nodes 1-2
+are read replicas in its ``-replicas`` pool, bootstrapped from a
+validator UTXO snapshot (the assumeutxo spin-up path) and fed tips over
+the normal P2P relay. The single campaign below walks the whole serving
+story in one topology (process spawns dominate the cost, so the phases
+share a fleet):
+
+  1. snapshot bootstrap — a fresh replica loads the validator's dump and
+     JOINS THE ROTATION within the health-probe window, no gateway
+     restart;
+  2. coalescing — 8 identical concurrent queries collapse onto one
+     backend call (counter-asserted);
+  3. hard-kill failover — kill -9 a replica, every in-flight-era read
+     still answers correctly via mid-request failover, the corpse is
+     rotated out, and the restarted replica re-enters rotation;
+  4. consistency gate — a replica cut off from tip relay falls behind
+     ``-maxreplicalag`` and is rotated out; reads keep flowing at the
+     fresh tip; the healed replica is re-admitted.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import pytest
+
+from bitcoincashplus_tpu.consensus.params import regtest_params
+from bitcoincashplus_tpu.wallet.keys import CKey
+
+from .framework import (
+    FunctionalFramework,
+    bootstrap_replica_from_snapshot,
+    connect_nodes,
+    disconnect_nodes,
+    gateway_client,
+    setup_fleet,
+    wait_until,
+)
+
+pytestmark = [pytest.mark.functional, pytest.mark.fleet]
+
+KEY = CKey(0x16F1EE7)
+ADDR = KEY.p2pkh_address(regtest_params())
+
+CHAIN_H = 16
+
+
+def _gw(validator) -> dict:
+    info = validator.rpc.gettpuinfo()["gateway"]
+    assert info["enabled"]
+    return info
+
+
+def _rotation(validator) -> set[str]:
+    return {r["name"] for r in _gw(validator)["pool"]["replicas"]
+            if r["in_rotation"]}
+
+
+def test_fleet_gateway_end_to_end(monkeypatch):
+    # Arm a latency spike on the replica leg (explicit-only site: only
+    # the gateway's proxied reads slow down, nothing consensus-side).
+    # Every replica leg now costs ~80 ms inside the gateway, so the
+    # 8-way identical-query barrage below reliably overlaps in flight —
+    # the coalescing assertion is deterministic instead of a scheduling
+    # race. The env is captured at node spawn; the replica processes
+    # inherit it too but never execute the site.
+    monkeypatch.setenv("BCP_FAULT_MODE", "latency-spike")
+    monkeypatch.setenv("BCP_FAULT_OPS", "replica_rpc")
+    monkeypatch.setenv("BCP_FAULT_LATENCY_MS", "80")
+
+    f = FunctionalFramework(num_nodes=3)
+    setup_fleet(f)
+    with f:
+        validator, r1, r2 = f.nodes
+        r1_name = f"127.0.0.1:{r1.rpc_port}"
+        r2_name = f"127.0.0.1:{r2.rpc_port}"
+        validator.rpc.generatetoaddress(CHAIN_H, ADDR)
+
+        # only node0 fronts the fleet; replicas report a disabled gateway
+        assert r1.rpc.gettpuinfo()["gateway"] == {"enabled": False}
+
+        # -- phase 1: snapshot bootstrap --------------------------------
+        snap_path = os.path.join(validator.datadir, "fleet-snapshot")
+        dump = validator.rpc.dumptxoutset(snap_path)
+        for rep in (r1, r2):
+            bootstrap_replica_from_snapshot(rep, validator, snap_path, dump)
+            assert rep.rpc.getblockcount() == CHAIN_H
+        # a fresh replica joins the rotation within the health-probe
+        # window once its tip clears the lag gate — no manual re-admission
+        wait_until(lambda: len(_rotation(validator)) == 2, timeout=60)
+
+        # settle background snapshot validation before the crash drills,
+        # so kill9 recovery below exercises the ordinary restart path
+        for rep in (r1, r2):
+            wait_until(lambda rep=rep: rep.rpc.gettpuinfo()["store"]
+                       ["snapshot"]["validated"], timeout=180, sleep=1.0)
+
+        gw = gateway_client(validator)
+        tip = validator.rpc.getbestblockhash()
+        assert gw.getblockcount() == CHAIN_H
+        assert gw.getbestblockhash() == tip
+
+        # -- phase 2: coalescing ----------------------------------------
+        before = _gw(validator)
+        results: list = [None] * 8
+        barrier = threading.Barrier(8)
+
+        def fan(i: int) -> None:
+            client = gateway_client(validator)
+            barrier.wait()
+            results[i] = client.getblock(tip)
+
+        threads = [threading.Thread(target=fan, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert all(r is not None and r["hash"] == tip for r in results)
+        after = _gw(validator)
+        assert after["requests"] - before["requests"] == 8
+        hits = after["coalesce_hits"] - before["coalesce_hits"]
+        assert hits >= 1, "identical in-flight queries did not coalesce"
+
+        # -- phase 3: hard-kill failover --------------------------------
+        before = _gw(validator)
+        r1.kill9()
+        # every read during the outage still answers, correctly: the
+        # round-robin leg that lands on the corpse fails over to the
+        # survivor (or the validator) behind one client call
+        for _ in range(8):
+            assert gw.getbestblockhash() == tip
+        after = _gw(validator)
+        assert after["failovers"] > before["failovers"]
+        # the probe loop trips the corpse's breaker and rotates it out
+        wait_until(lambda: _rotation(validator) == {r2_name}, timeout=30)
+
+        # restart: crash recovery, catch up, re-enter rotation
+        r1.start()
+        connect_nodes(r1, validator)
+        wait_until(lambda: r1.rpc.getblockcount() == CHAIN_H, timeout=60)
+        wait_until(lambda: len(_rotation(validator)) == 2, timeout=60)
+
+        # -- phase 4: consistency gate (lag rotation) -------------------
+        disconnect_nodes(r2, validator)
+        max_lag = _gw(validator)["pool"]["max_lag"]
+        validator.rpc.generatetoaddress(max_lag + 2, ADDR)
+        new_tip = validator.rpc.getbestblockhash()
+        # r1 (still connected) follows the relay to the fresh tip; r2 is
+        # cut off, falls past -maxreplicalag, and the gate rotates it out
+        wait_until(lambda: r1.rpc.getbestblockhash() == new_tip, timeout=60)
+        wait_until(lambda: r2_name not in _rotation(validator), timeout=30)
+        # once the gate fires, reads keep flowing and answer at the
+        # fresh tip (from the caught-up replica or validator fallback) —
+        # the stale replica is REMOVED, never served from
+        wait_until(lambda: gw.getbestblockhash() == new_tip, timeout=60)
+        for _ in range(4):
+            assert gw.getbestblockhash() == new_tip
+        assert gw.getblockcount() == CHAIN_H + max_lag + 2
+
+        # heal: the replica catches up and is re-admitted
+        connect_nodes(r2, validator)
+        wait_until(lambda: r2.rpc.getblockcount() == CHAIN_H + max_lag + 2,
+                   timeout=60)
+        wait_until(lambda: len(_rotation(validator)) == 2, timeout=60)
+
+        # the campaign rotated replicas out at least twice (kill + lag)
+        assert _gw(validator)["pool"]["rotations_out"] >= 2

@@ -811,3 +811,104 @@ class TestNetSnapshot:
                     "stall_rerequests", "evicted_stallers", "flood_charges",
                     "orphans_evicted", "banned"):
             assert snap[key] == 0
+
+
+class TestBackfillHardening:
+    """ISSUE 16 satellite: the assumeutxo backfill pull must never wedge
+    behind one dead peer — per-hash deadlines tear overdue requests off
+    their owner, retry on the next peer after a jittered Backoff pause,
+    and strike repeat offenders out of the backfill rotation."""
+
+    H1, H2 = b"\x11" * 32, b"\x12" * 32
+
+    def test_dead_backfill_peer_does_not_wedge_the_pull(self, tmp_path):
+        cm = make_connman(tmp_path, backfilltimeout=2)
+        dead = make_peer(cm)
+        alive = make_peer(cm)
+        t0 = time.time()
+        # no event loop in this harness: request_backfill dispatches
+        # inline (the production path queues the same call on the loop)
+        cm._backfill_dispatch([self.H1, self.H2], t0)
+        owners = {cm._requested_blocks[self.H1],
+                  cm._requested_blocks[self.H2]}
+        assert owners == {dead.id, alive.id}  # round-robined
+        my = [h for h in (self.H1, self.H2)
+              if cm._requested_blocks[h] == dead.id][0]
+
+        # within the backfill deadline nothing moves
+        cm._tick(t0 + 1)
+        assert cm._requested_blocks[my] == dead.id
+
+        # deadline fires: the hash is torn off the dead peer and, after
+        # the jittered pause, re-requested from the other peer
+        cm._tick(t0 + 3)
+        assert cm.net_stats["backfill_retries"] >= 1
+        assert my not in cm._requested_blocks
+        assert my not in dead.inflight
+        cm._tick(t0 + 9)  # past any Backoff pause (max 5s)
+        assert cm._requested_blocks.get(my) == alive.id
+
+    def test_repeat_offender_is_struck_out_then_redeemed(self, tmp_path):
+        cm = make_connman(tmp_path, backfilltimeout=2)
+        flaky = make_peer(cm)
+        t0 = time.time()
+        # three missed deadlines strike the only peer out of the
+        # backfill rotation (BACKFILL_EVICT_STRIKES)
+        now = t0
+        for _ in range(cm.BACKFILL_EVICT_STRIKES):
+            cm._backfill_dispatch([self.H1], now)
+            now += cm.backfill_timeout + 1
+            cm._tick(now)          # deadline fires, strike charged
+            now += 6
+            cm._tick(now)          # pause elapses, retry dispatched
+            # sole peer: the retry necessarily lands back on it (a
+            # degraded pull beats a wedged one)
+        assert cm._backfill_evicted == {flaky.id}
+        assert cm.net_stats["backfill_peer_evictions"] == 1
+
+        # a struck-out peer is skipped while ANY alternative exists
+        fresh = make_peer(cm)
+        cm._backfill.clear()
+        cm._requested_blocks.pop(self.H2, None)
+        cm._backfill_dispatch([self.H2], now)
+        assert cm._requested_blocks[self.H2] == fresh.id
+
+        # delivering a wanted backfill block redeems the striker
+        cm._backfill_dispatch([self.H1], now)
+        owner = cm._requested_blocks.get(self.H1)
+        if owner != flaky.id:  # hand it to the flaky peer explicitly
+            cm._requested_blocks.pop(self.H1, None)
+            cm._request_blocks(flaky, [self.H1], now=now)
+            cm._backfill[self.H1]["peer"] = flaky.id
+        cm._note_block_arrival(flaky, self.H1, now=now)
+        assert flaky.id not in cm._backfill_evicted
+        assert flaky.id not in cm._backfill_strikes
+
+    def test_no_peers_parks_then_counts_retries_only_on_expiry(
+            self, tmp_path):
+        cm = make_connman(tmp_path, backfilltimeout=2)
+        t0 = time.time()
+        cm._backfill_dispatch([self.H1], t0)
+        assert self.H1 in cm._unrequested  # parked, not dropped
+        assert cm.net_stats["backfill_retries"] == 0
+        # once a peer shows up the parked pull is retried onto it
+        peer = make_peer(cm)
+        cm._tick(t0 + 3)   # deadline fires while parked
+        cm._tick(t0 + 9)   # pause elapses -> re-request on the new peer
+        assert cm._requested_blocks.get(self.H1) == peer.id
+
+    def test_arrival_retires_the_backfill_entry(self, tmp_path):
+        cm = make_connman(tmp_path, backfilltimeout=2)
+        peer = make_peer(cm)
+        t0 = time.time()
+        cm._backfill_dispatch([self.H1], t0)
+        cm._note_block_arrival(peer, self.H1, now=t0 + 1)
+        assert self.H1 not in cm._backfill
+        cm._tick(t0 + 5)  # no ghost retries for a delivered block
+        assert cm.net_stats["backfill_retries"] == 0
+
+    def test_backfill_counters_in_net_snapshot(self, tmp_path):
+        cm = make_connman(tmp_path)
+        snap = cm.net_snapshot()
+        assert snap["backfill_retries"] == 0
+        assert snap["backfill_peer_evictions"] == 0

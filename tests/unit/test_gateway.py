@@ -1,0 +1,443 @@
+"""Fleet front-door policy suite (serving/gateway + serving/replicas).
+
+Every rotation/admission/coalescing/failover policy is exercised with
+injected fake transports — no subprocesses, tier-1 fast. The fault
+drills arm the explicit-only ``gateway`` and ``replica_rpc`` sites
+(util/faults.GATEWAY_SITE / REPLICA_RPC_SITE) and prove the ISSUE 16
+robustness story: a dying replica leg fails over mid-request, a dark
+rotation falls back to the validator, and overload sheds read-only
+traffic before tip-critical — metered, never silent."""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import threading
+import time
+
+import pytest
+
+from bitcoincashplus_tpu.ops.dispatch import BreakerConfig
+from bitcoincashplus_tpu.serving.gateway import (
+    Gateway,
+    GatewayReject,
+    BackendRPCError,
+)
+from bitcoincashplus_tpu.serving.replicas import (
+    Replica,
+    ReplicaPool,
+    ReplicaRPCError,
+)
+from bitcoincashplus_tpu.util.faults import (
+    GATEWAY_SITE,
+    REPLICA_RPC_SITE,
+    InjectedFault,
+)
+
+pytestmark = pytest.mark.serving
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def chaininfo(height: int) -> dict:
+    return {"blocks": height, "bestblockhash": f"hash{height:04d}"}
+
+
+class FakeBackendTracker:
+    """Validator-leg stand-in recording every call."""
+
+    def __init__(self, result="validator"):
+        self.calls: list[tuple] = []
+        self.result = result
+        self._lock = threading.Lock()
+
+    def __call__(self, method, params):
+        with self._lock:
+            self.calls.append((method, list(params)))
+        return self.result
+
+
+def make_replica(name, transport, clock=None, threshold=2,
+                 cooldown=5.0) -> Replica:
+    cfg = BreakerConfig(threshold=threshold, cooldown=cooldown,
+                        probe=1.0, seed=7)
+    return Replica(name, transport,
+                   breaker_cfg=cfg, clock=clock or time.monotonic)
+
+
+def make_pool(replicas, max_lag=2, tip=10) -> ReplicaPool:
+    pool = ReplicaPool(replicas, max_lag=max_lag, validator_tip=lambda: tip)
+    pool.probe_once()
+    return pool
+
+
+def healthy_transport(height=10, tag="r"):
+    def call(method, params):
+        if method == "getblockchaininfo":
+            return chaininfo(height)
+        return f"{tag}:{method}"
+    return call
+
+
+# -- admission + graduated shedding ------------------------------------
+
+
+class TestAdmission:
+    def test_read_sheds_before_tip_at_the_soft_ceiling(self):
+        backend = FakeBackendTracker()
+        gw = Gateway(backend, make_pool([]), soft_inflight=0,
+                     hard_inflight=100)
+        try:
+            with pytest.raises(GatewayReject, match="overload"):
+                gw.handle("getblockcount", [], "c")
+            # tip-critical rides to the hard ceiling: still admitted
+            assert gw.handle("sendrawtransaction", ["00"], "c") \
+                == "validator"
+            assert gw.stats["sheds"]["read"] == 1
+            assert gw.stats["sheds"]["tip"] == 0
+        finally:
+            gw.close()
+
+    def test_token_bucket_leaves_a_tip_reserve(self):
+        backend = FakeBackendTracker()
+        # burst=4, read_reserve=0.25 -> reads must stop at 1 token;
+        # rate=0 so nothing refills mid-test
+        gw = Gateway(backend, make_pool([]), rate=0.0, burst=4.0,
+                     read_reserve=0.25)
+        try:
+            for _ in range(3):
+                gw.handle("getblockcount", [], "alice")
+            with pytest.raises(GatewayReject, match="rate"):
+                gw.handle("getblockcount", [], "alice")
+            # the reserved token is still there for tip-critical
+            assert gw.handle("submitblock", ["00"], "alice") == "validator"
+            with pytest.raises(GatewayReject, match="rate"):
+                gw.handle("submitblock", ["00"], "alice")
+            # a different client has its own bucket
+            assert gw.handle("getblockcount", [], "bob") == "validator"
+            assert gw.stats["sheds"] == {"read": 1, "tip": 1}
+        finally:
+            gw.close()
+
+    def test_rejects_are_metered_never_silent(self):
+        gw = Gateway(FakeBackendTracker(), make_pool([]), rate=0.0,
+                     burst=1.0, read_reserve=0.0)
+        try:
+            gw.handle("getblockcount", [], "c")
+            shed_before = gw.stats["sheds"]["read"]
+            with pytest.raises(GatewayReject):
+                gw.handle("getblockcount", [], "c")
+            assert gw.stats["sheds"]["read"] == shed_before + 1
+            # and the HTTP-facing execute() path converts it to a
+            # 429-style JSON-RPC error object, not an exception
+            resp = gw.execute({"id": 9, "method": "getblockcount",
+                               "params": []}, "c")
+            assert resp["error"]["code"] == -429
+            assert "shed" in resp["error"]["message"]
+        finally:
+            gw.close()
+
+
+# -- request coalescing -------------------------------------------------
+
+
+class TestCoalescing:
+    def test_identical_inflight_queries_hit_the_backend_once(self):
+        gate = threading.Event()
+        calls = []
+        lock = threading.Lock()
+
+        def backend(method, params):
+            with lock:
+                calls.append(method)
+            gate.wait(timeout=5)  # hold the leader so followers pile up
+            return "tpl"
+
+        gw = Gateway(backend, make_pool([]), soft_inflight=64)
+        try:
+            with cf.ThreadPoolExecutor(8) as ex:
+                futs = [ex.submit(gw.handle, "getblocktemplate", [],
+                                  f"c{i}") for i in range(8)]
+                # wait until all 8 are inside the gateway, then release
+                deadline = time.monotonic() + 5
+                while gw.snapshot()["inflight"] < 8 \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.005)
+                gate.set()
+                results = [f.result(timeout=10) for f in futs]
+            assert results == ["tpl"] * 8
+            assert len(calls) == 1  # ONE backend call for eight clients
+            assert gw.stats["coalesce_hits"] == 7
+        finally:
+            gw.close()
+
+    def test_distinct_params_do_not_coalesce(self):
+        backend = FakeBackendTracker()
+        gw = Gateway(backend, make_pool([]))
+        try:
+            gw.handle("getblockhash", [1], "c")
+            gw.handle("getblockhash", [2], "c")
+            assert len(backend.calls) == 2
+            assert gw.stats["coalesce_hits"] == 0
+        finally:
+            gw.close()
+
+    def test_leader_error_is_shared_with_followers(self):
+        def backend(method, params):
+            raise BackendRPCError({"code": -5, "message": "Block not found"})
+
+        gw = Gateway(backend, make_pool([]))
+        try:
+            with pytest.raises(BackendRPCError, match="not found"):
+                gw.handle("getblocktemplate", [], "c")
+        finally:
+            gw.close()
+
+
+# -- replica rotation: failover, breakers, lag gate ---------------------
+
+
+class TestFailover:
+    def test_mid_request_failover_to_a_healthy_replica(self):
+        def dead(method, params):
+            if method == "getblockchaininfo":
+                return chaininfo(10)
+            raise OSError("connection reset")
+
+        r_dead = make_replica("dead", dead)
+        r_ok = make_replica("ok", healthy_transport(10, "ok"))
+        pool = make_pool([r_dead, r_ok])
+        gw = Gateway(FakeBackendTracker(), pool)
+        try:
+            # every read lands an answer regardless of which replica the
+            # round-robin tries first; a dead leg is retried elsewhere
+            for _ in range(4):
+                assert gw.handle("getblockcount", [], "c") \
+                    in ("ok:getblockcount",)
+            assert gw.stats["failovers"] >= 1
+            assert r_dead.breaker.consecutive_failures >= 1 or \
+                r_dead.breaker.state != "closed"
+        finally:
+            gw.close()
+
+    def test_rpc_level_error_is_definitive_not_failed_over(self):
+        def answers_error(method, params):
+            if method == "getblockchaininfo":
+                return chaininfo(10)
+            raise ReplicaRPCError({"code": -5, "message": "Block not found"})
+
+        r = make_replica("r", answers_error)
+        gw = Gateway(FakeBackendTracker(), make_pool([r]))
+        try:
+            with pytest.raises(BackendRPCError, match="not found"):
+                gw.handle("getblock", ["00"], "c")
+            assert gw.stats["failovers"] == 0
+            assert r.breaker.healthy()  # answered — not replica sickness
+        finally:
+            gw.close()
+
+    def test_exhausted_rotation_falls_back_to_the_validator(self):
+        def dead(method, params):
+            if method == "getblockchaininfo":
+                return chaininfo(10)
+            raise OSError("dead")
+
+        backend = FakeBackendTracker()
+        gw = Gateway(backend, make_pool([make_replica("d1", dead),
+                                         make_replica("d2", dead)]))
+        try:
+            assert gw.handle("getblockcount", [], "c") == "validator"
+            assert gw.stats["validator_fallback"] == 1
+            assert gw.stats["failovers"] == 2
+            assert backend.calls == [("getblockcount", [])]
+        finally:
+            gw.close()
+
+    def test_breaker_trips_evicts_and_readmits_on_probe_success(self):
+        clock = FakeClock()
+        state = {"dead": True}
+
+        def flaky(method, params):
+            if state["dead"]:
+                raise OSError("down")
+            if method == "getblockchaininfo":
+                return chaininfo(10)
+            return "back"
+
+        r = make_replica("flaky", flaky, clock=clock, threshold=2,
+                         cooldown=5.0)
+        pool = ReplicaPool([r], max_lag=2, validator_tip=lambda: 10)
+        # two failed probes trip the breaker -> out of rotation
+        pool.probe_once()
+        pool.probe_once()
+        assert r.breaker.state == "open"
+        assert not r.in_rotation
+        # still dark within the cooldown: no probe is even attempted
+        calls_before = r.calls
+        pool.probe_once()
+        assert r.calls == calls_before
+        # the replica heals; after the cooldown the half-open probe
+        # succeeds and the replica is re-admitted to the rotation
+        state["dead"] = False
+        clock.advance(6.0)
+        pool.probe_once()
+        assert r.breaker.state == "closed"
+        assert r.in_rotation
+
+    def test_lagging_replica_is_rotated_out_not_served(self):
+        r_tip = make_replica("tip", healthy_transport(10, "tip"))
+        r_lag = make_replica("lag", healthy_transport(6, "lag"))
+        pool = make_pool([r_tip, r_lag], max_lag=2, tip=10)
+        assert pool.fanout_height == 10
+        assert r_tip.in_rotation and r_lag.lagging and not r_lag.in_rotation
+        assert pool.rotations_out == 0  # never admitted, never "rotated"
+        gw = Gateway(FakeBackendTracker(), pool)
+        try:
+            for _ in range(6):
+                assert gw.handle("getbestblockhash", [], "c") \
+                    == "tip:getbestblockhash"
+        finally:
+            gw.close()
+
+    def test_replica_catching_up_rejoins_the_rotation(self):
+        height = {"h": 6}
+
+        def catching_up(method, params):
+            if method == "getblockchaininfo":
+                return chaininfo(height["h"])
+            return "r"
+
+        r = make_replica("r", catching_up)
+        pool = make_pool([r], max_lag=2, tip=10)
+        assert not r.in_rotation
+        height["h"] = 9  # within max_lag of fanout 10
+        pool.probe_once()
+        assert r.in_rotation
+
+    def test_rotation_out_is_counted(self):
+        height = {"h": 10}
+
+        def transport(method, params):
+            if method == "getblockchaininfo":
+                return chaininfo(height["h"])
+            return "r"
+
+        pool = make_pool([make_replica("r", transport)], max_lag=2, tip=10)
+        assert pool.replicas[0].in_rotation
+        # validator races ahead; the replica wedges at 10
+        pool.validator_tip = lambda: 20
+        pool.probe_once()
+        assert not pool.replicas[0].in_rotation
+        assert pool.rotations_out == 1
+
+
+# -- telemetry discipline ----------------------------------------------
+
+
+class TestGatewayTelemetry:
+    def test_collector_projects_replicas_and_unregisters_on_close(self):
+        from bitcoincashplus_tpu.util import telemetry as tm
+
+        pool = make_pool([make_replica("r1", healthy_transport(10))])
+        gw = Gateway(FakeBackendTracker(), pool)
+        fams = {f["name"]: f for f in tm.REGISTRY._collected()}
+        assert "bcp_gateway_replica_state" in fams
+        assert "bcp_gateway_replica_in_rotation" in fams
+        samples = dict(
+            (lbl["replica"], v)
+            for lbl, v in fams["bcp_gateway_replica_in_rotation"]["samples"])
+        assert samples == {"r1": 1}
+        gw.close()
+        fams = {f["name"] for f in tm.REGISTRY._collected()}
+        assert "bcp_gateway_replica_state" not in fams  # the PR 6 lesson
+
+    def test_two_gateways_do_not_collide(self):
+        gw1 = Gateway(FakeBackendTracker(), make_pool([]))
+        gw2 = Gateway(FakeBackendTracker(), make_pool([]))
+        gw1.close()
+        gw2.close()
+
+
+# -- fault drills: the gateway and replica_rpc sites --------------------
+
+
+class TestFaultDrills:
+    def test_replica_rpc_fail_always_drives_validator_fallback(
+            self, fault_harness):
+        fault_harness("fail-always", ops="replica_rpc")
+        r = make_replica("r", healthy_transport(10))
+        r.tip_height, r.in_rotation = 10, True  # pre-armed rotation
+        pool = ReplicaPool([r], max_lag=2, validator_tip=lambda: 10)
+        backend = FakeBackendTracker()
+        gw = Gateway(backend, pool)
+        try:
+            # the replica leg is dark; the read still lands an answer
+            assert gw.handle("getblockcount", [], "c") == "validator"
+            assert gw.stats["failovers"] >= 1
+            assert gw.stats["validator_fallback"] == 1
+        finally:
+            gw.close()
+
+    def test_replica_rpc_fail_n_proves_mid_request_failover(
+            self, fault_harness):
+        fault_harness("fail-n", ops="replica_rpc", n=1)
+        r1 = make_replica("r1", healthy_transport(10, "r1"))
+        r2 = make_replica("r2", healthy_transport(10, "r2"))
+        for r in (r1, r2):
+            r.tip_height, r.in_rotation = 10, True
+        pool = ReplicaPool([r1, r2], max_lag=2, validator_tip=lambda: 10)
+        gw = Gateway(FakeBackendTracker(), pool)
+        try:
+            # first replica attempt eats the injected fault; the SAME
+            # request retries on the other replica and succeeds
+            result = gw.handle("getblockcount", [], "c")
+            assert result in ("r1:getblockcount", "r2:getblockcount")
+            assert gw.stats["failovers"] == 1
+            assert gw.stats["validator_fallback"] == 0
+        finally:
+            gw.close()
+
+    def test_gateway_site_fails_the_front_door_not_the_backends(
+            self, fault_harness):
+        inj = fault_harness("fail-once", ops="gateway")
+        backend = FakeBackendTracker()
+        gw = Gateway(backend, make_pool([]))
+        try:
+            with pytest.raises(InjectedFault):
+                gw.handle("getblockcount", [], "c")
+            assert backend.calls == []  # failed BEFORE admission/dispatch
+            assert inj.injected.get(GATEWAY_SITE) == 1
+            # next request sails through — and execute() wraps the fault
+            # as a JSON-RPC error, never a silent drop
+            assert gw.handle("getblockcount", [], "c") == "validator"
+        finally:
+            gw.close()
+
+    def test_gateway_latency_spike_is_observed(self, fault_harness):
+        fault_harness("latency-spike", ops="gateway", latency_ms=40)
+        gw = Gateway(FakeBackendTracker(), make_pool([]))
+        try:
+            t0 = time.monotonic()
+            gw.handle("getblockcount", [], "c")
+            assert time.monotonic() - t0 >= 0.035
+        finally:
+            gw.close()
+
+    def test_sites_are_explicit_only_all_does_not_arm_them(
+            self, fault_harness):
+        inj = fault_harness("fail-always", ops="all")
+        assert not inj.armed_for(GATEWAY_SITE)
+        assert not inj.armed_for(REPLICA_RPC_SITE)
+        gw = Gateway(FakeBackendTracker(), make_pool([]))
+        try:
+            assert gw.handle("getblockcount", [], "c") == "validator"
+        finally:
+            gw.close()
