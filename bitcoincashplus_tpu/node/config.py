@@ -54,6 +54,19 @@ Options:
                          snapshot tip immediately while background
                          validation replays history into a shadow
                          chainstate and promotes on digest equality
+  -snapshotepoch=<n>     Epoch stride (blocks) for the proof-carrying
+                         snapshot certificate built at dumptxoutset: the
+                         certified MuHash trajectory commits one digest
+                         checkpoint every <n> blocks (default: 64)
+  -snapshotspotcheck=<k> Background snapshot validation re-runs full script
+                         checks on only <k> seeded-drawn certified epochs
+                         (the final epoch always included) instead of all
+                         of history; certificate digest tripwires still
+                         fire at every epoch boundary (default: 0 = full
+                         re-validation)
+  -snapshotcertrequired  Refuse loadtxoutset snapshots that carry no
+                         certificate instead of loading them quarantined
+                         (default: 0)
   -checkblocks=<n>       How many blocks to verify at startup (default: 6)
   -checklevel=<n>        How thorough the startup block verification is (0-4, default: 3)
   -assumevalid=<hex>     Skip script verification for ancestors of this block

@@ -204,6 +204,30 @@ class Node:
                     "<blockhash_hex>:<muhash_hex> (32 bytes each)")
             # display order -> internal little-endian hash
             self.assumeutxo = (h_raw[::-1], d_raw)
+        # Proof-carrying snapshot knobs (store/certificate.py):
+        #  -snapshotepoch=<E>     checkpoint stride for the certificate's
+        #                         MuHash trajectory built at dumptxoutset
+        #  -snapshotspotcheck=<K> shadow validation re-runs full script
+        #                         checks on only K seeded-drawn certified
+        #                         epochs (0 = full re-validation); digest
+        #                         checkpoints still fire at EVERY boundary
+        #  -snapshotcertrequired  refuse certificate-less snapshots at
+        #                         loadtxoutset instead of quarantining
+        self.snapshot_epoch = config.get_int("snapshotepoch", 64)
+        if self.snapshot_epoch < 1:
+            raise ConfigError(
+                f"-snapshotepoch={self.snapshot_epoch}: must be >= 1")
+        self.snapshot_spotcheck = config.get_int("snapshotspotcheck", 0)
+        if self.snapshot_spotcheck < 0:
+            raise ConfigError(
+                f"-snapshotspotcheck={self.snapshot_spotcheck}: must be "
+                ">= 0")
+        self.snapshot_cert_required = config.get_bool("snapshotcertrequired")
+        # the seeded draw reuses -netseed so one seed replays an identical
+        # spot-check drill end to end (orphan eviction included)
+        self._spotcheck_seed: Optional[int] = (
+            config.get_int("netseed", -1)
+            if config.get_int("netseed", -1) >= 0 else None)
         if reindex:
             # wipe the derived state; blk*.dat files are the source of truth
             for p in (index_path, coins_path):
@@ -218,6 +242,8 @@ class Node:
 
             _shutil.rmtree(os.path.join(self.datadir, "chainstate_shadow"),
                            ignore_errors=True)
+            if os.path.exists(self._snapshot_cert_path()):
+                os.remove(self._snapshot_cert_path())
             # undo data is derived too: the import rebuilds every record,
             # and the wiped undo_positions would otherwise leave the old
             # records stranded in the rev files forever (the reference
@@ -278,6 +304,17 @@ class Node:
             self.snapshot_state
             and not self.snapshot_state.get("validated"))
         self._snapshot_thread: Optional[threading.Thread] = None
+        # certificate epoch checkpoints {height: digest_hex} persisted at
+        # load time so a restarted shadow validation keeps its O(E)
+        # divergence detection instead of regressing to trust-until-tip
+        self._cert_checkpoints: Optional[dict] = None
+        if self._snapshot_pending:
+            from ..store.kvstore import read_json as _read_json
+
+            doc = _read_json(self._snapshot_cert_path())
+            if doc and doc.get("checkpoints"):
+                self._cert_checkpoints = {
+                    int(h): d for h, d in doc["checkpoints"].items()}
 
         # -maxsigcachesize=<MiB>: byte budget for the signature cache
         # (src/init.cpp DEFAULT_MAX_SIG_CACHE_SIZE). The entry cap is
@@ -1071,6 +1108,86 @@ class Node:
         info["snapshot"] = self.snapshot_state
         return info
 
+    def _snapshot_cert_path(self) -> str:
+        return os.path.join(self.datadir, "snapshot_cert.json")
+
+    def snapshot_info(self) -> Optional[dict]:
+        """The getblockchaininfo 'snapshot' sub-doc — the certificate /
+        quarantine view the fleet probe keys on. ``certificate_verified``
+        is the serving gate: True when the snapshot carried a verified
+        certificate (trust established at load, in seconds) OR when the
+        background replay finished (trust established the slow way).
+        Absent entirely on nodes that never onboarded from a snapshot."""
+        snap = self.snapshot_state
+        if not snap:
+            return None
+        cert = snap.get("cert") or {}
+        validated = bool(snap.get("validated"))
+        return {
+            "height": snap.get("height"),
+            "validated": validated,
+            "cert_present": bool(cert.get("present")),
+            "cert_verified": bool(cert.get("verified")),
+            "certificate_verified": bool(cert.get("verified")) or validated,
+        }
+
+    def build_snapshot_certificate(self, height: int) -> Optional[dict]:
+        """Produce the proof-carrying certificate for a dumptxoutset at
+        ``height`` (store/certificate.py), or None when this node cannot
+        attest (it onboarded from a snapshot itself and lacks undo data
+        below the snapshot tip, or the legacy store has no accumulator).
+
+        The epoch trajectory is reconstructed EXACTLY from undo data by
+        walking blocks tip->1 and dividing each block's delta out of the
+        live accumulator state — no new runtime bookkeeping, and reorgs
+        are a non-issue because the walk happens under cs_main against
+        the settled chain."""
+        import struct as _struct
+
+        from ..store import certificate as cert_mod
+        from ..validation.coins import BlockUndo, Coin
+
+        state_fn = getattr(self.coins_db, "muhash_state", None)
+        if state_fn is None:
+            return None
+        cs = self.chainstate
+        header_hashes = [cs.chain[h].hash for h in range(height + 1)]
+
+        def deltas():
+            for h in range(height, 0, -1):
+                idx = cs.chain[h]
+                raw = self.block_store.get_block(idx.hash)
+                if raw is None:
+                    raise cert_mod.CertificateError(
+                        f"no block data at height {h} (snapshot-onboarded "
+                        "node without full backfill cannot attest)")
+                block = CBlock.from_bytes(raw)
+                created = []
+                for tx in block.vtx:
+                    txid = tx.txid
+                    cb = tx is block.vtx[0]
+                    for i, out in enumerate(tx.vout):
+                        created.append((
+                            txid + _struct.pack("<I", i),
+                            Coin(out, h, cb).serialize()))
+                spent = []
+                if len(block.vtx) > 1:
+                    rawu = self.block_store.get_undo(idx.hash)
+                    if rawu is None:
+                        raise cert_mod.CertificateError(
+                            f"no undo data at height {h}")
+                    undo = BlockUndo.from_bytes(rawu)
+                    for t, tx in enumerate(block.vtx[1:]):
+                        for vin, coin in zip(tx.vin, undo.vtxundo[t].prevouts):
+                            spent.append((
+                                vin.prevout.hash
+                                + _struct.pack("<I", vin.prevout.n),
+                                coin.serialize()))
+                yield h, created, spent
+
+        return cert_mod.build_certificate(
+            header_hashes, height, self.snapshot_epoch, state_fn(), deltas())
+
     def load_utxo_snapshot(self, path: str) -> dict:
         """loadtxoutset: adopt the snapshot directory at ``path``.
 
@@ -1097,7 +1214,8 @@ class Node:
             self.chainstate.flush()  # settle genesis state first
             info = snapshot_mod.load_snapshot(
                 path, self.coins_db, self.params.network,
-                expected_hash=exp_hash, expected_digest=exp_digest)
+                expected_hash=exp_hash, expected_digest=exp_digest,
+                require_certificate=self.snapshot_cert_required)
             cs = self.chainstate
             # headers go through the normal PoW/contextual checks — the
             # snapshot is trusted for the COIN SET only, never for work
@@ -1118,9 +1236,23 @@ class Node:
             cs.flush()
             self.snapshot_state = self.coins_db.snapshot_state
             self._snapshot_pending = True
+            self._cert_checkpoints = info.get("cert_checkpoints")
+            if self._cert_checkpoints:
+                # persist for restart-resume: the shadow validator must
+                # keep its epoch-divergence tripwires across restarts
+                from ..store.kvstore import atomic_write_json
+
+                atomic_write_json(self._snapshot_cert_path(), {
+                    "checkpoints": {str(h): d for h, d in
+                                    self._cert_checkpoints.items()},
+                    "epoch_blocks": info["certificate"]["epoch_blocks"],
+                })
             log_printf("assumeutxo: serving at snapshot tip %s (height %d)"
-                       " — background validation starting",
-                       hash_to_hex(tip_idx.hash)[:16], tip_idx.height)
+                       " — background validation starting%s",
+                       hash_to_hex(tip_idx.hash)[:16], tip_idx.height,
+                       "" if info.get("certificate") else
+                       "; UNCERTIFIED snapshot — replica serving "
+                       "quarantined until validation completes")
         with self.notify_cv:
             self.notify_cv.notify_all()
         self._start_snapshot_verify()
@@ -1198,6 +1330,28 @@ class Node:
             "pipeline",
             pending_fn=lambda: len(self.chainstate._spec),
             quiet_s=self.watchdog_quiet)
+        # certificate epoch tripwires: {checkpoint height: expected digest}
+        # verified as the replay crosses each boundary — a forged epoch is
+        # caught O(E) blocks past the forgery, not at height H
+        import bisect as _bisect
+
+        cps = self._cert_checkpoints or {}
+        cp_heights = sorted(cps)
+        sampled: Optional[set] = None
+        if cps and self.snapshot_spotcheck > 0:
+            from ..store import certificate as _cert_mod
+
+            sampled = set(_cert_mod.sample_epochs(
+                cp_heights, self.snapshot_spotcheck, self._spotcheck_seed))
+            log_printf("assumeutxo: spot-check mode — full script "
+                       "re-validation on %d/%d certified epochs %s; digest "
+                       "tripwires stay armed at every boundary",
+                       len(sampled), len(cp_heights), sorted(sampled))
+
+        def _epoch_end(height: int) -> Optional[int]:
+            i = _bisect.bisect_left(cp_heights, height)
+            return cp_heights[i] if i < len(cp_heights) else None
+
         ok = False
         try:
             shadow.load_block_index()
@@ -1226,11 +1380,35 @@ class Node:
                         self.connman.request_backfill(missing)
                     self.shutdown_event.wait(0.25)
                     continue
+                if sampled is not None:
+                    # spot-check: blocks outside the K sampled epochs
+                    # replay without script verification (UTXO algebra,
+                    # PoW and digest tripwires still fully enforced) —
+                    # the onboarding-economics lever the certificate buys
+                    shadow.script_verifier = (
+                        verifier if _epoch_end(h) in sampled else None)
                 if not shadow.process_new_block(CBlock.from_bytes(raw)):
                     log_printf("assumeutxo: shadow validation REJECTED "
                                "block at height %d — snapshot chain is "
                                "invalid, promotion abandoned", h)
+                    if self.connman is not None:
+                        self.connman.cancel_backfill()
                     return
+                if h in cps:
+                    shadow.flush()
+                    since_flush = 0
+                    got = shadow_coins.muhash_digest().hex()
+                    if got != cps[h]:
+                        log_printf(
+                            "assumeutxo: EPOCH DIGEST DIVERGENCE at "
+                            "certified checkpoint %d (got %s, certificate "
+                            "%s) — snapshot content is FORGED in epoch "
+                            "ending here; hard abort for manual "
+                            "intervention", h, got[:16], cps[h][:16])
+                        if self.connman is not None:
+                            self.connman.cancel_backfill()
+                        self.shutdown_event.set()
+                        return
                 h += 1
                 since_flush += 1
                 if since_flush >= 64:
@@ -1247,6 +1425,8 @@ class Node:
                            "(got %s, snapshot %s) — the snapshot was bad; "
                            "shutting down for manual intervention",
                            got[:16], want[:16])
+                if self.connman is not None:
+                    self.connman.cancel_backfill()
                 self.shutdown_event.set()
                 return
             with self.cs_main:
@@ -1271,6 +1451,8 @@ class Node:
             shadow_index_kv.close()
             if ok:
                 shutil.rmtree(shadow_dir, ignore_errors=True)
+                if os.path.exists(self._snapshot_cert_path()):
+                    os.remove(self._snapshot_cert_path())
 
     def import_block_files(self, paths: Optional[list[str]] = None) -> int:
         """LoadExternalBlockFile (src/validation.cpp:~4000) over every

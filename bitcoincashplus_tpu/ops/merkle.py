@@ -86,6 +86,60 @@ def _tree_reduce_jit(words, n_levels: int, m):
     return jnp.stack(level, axis=-1)[0], mutated, witness
 
 
+@jax.jit
+def _hash_pairs_jit(words):
+    """(N, 16) u32 rows — each row a 64-byte left||right concatenation —
+    double-SHA'd lane-parallel to (N, 8) u32 digests. One flat level, no
+    tree: the snapshot-certificate MMR (store/certificate.py) drives this
+    once per level over the pow2 peak decomposition."""
+    cols = [words[:, i] for i in range(16)]
+    return jnp.stack(sha256d_64(cols), axis=-1)
+
+
+def sha256d_pairs(pairs: list[bytes]) -> list[bytes]:
+    """Batched sha256d over 64-byte concatenations — the level primitive
+    the snapshot-certificate MMR builds on. Small batches take the host
+    loop outright (dispatch latency dominates); large ones ride the
+    supervised ``merkle`` subsystem with the same poisoned-output witness
+    discipline as the block-Merkle tree: pair 0 is recomputed on the host
+    in 2 hashes, and any device failure degrades to the CPU loop with the
+    result unchanged."""
+    from ..crypto.hashes import sha256d
+    from ..util import devicewatch as dw
+    from . import dispatch
+
+    n = len(pairs)
+    if n == 0:
+        return []
+    if n < PAD_LANES:
+        return [sha256d(p) for p in pairs]
+
+    def device():
+        bucket = -(-n // PAD_LANES) * PAD_LANES
+        words = np.frombuffer(b"".join(pairs), dtype=np.uint8) \
+            .reshape(-1, 16, 4).view(">u4").squeeze(-1).astype(np.uint32)
+        if bucket != n:
+            words = np.concatenate(
+                [words, np.zeros((bucket - n, 16), dtype=np.uint32)], axis=0)
+        dw.note_transfer("merkle", "h2d", int(words.nbytes))
+        # PAD_LANES buckets bound the compiled shapes exactly like the
+        # tree path; the budget mirrors merkle_tree's pow2 rationale
+        with dw.program("merkle_pairs", shape_budget=24).dispatch(
+                bucket, jitfn=_hash_pairs_jit, args=(words,)):
+            out = _hash_pairs_jit(jnp.asarray(words))
+        out = np.asarray(out, dtype=np.uint32)[:n]
+        dw.note_transfer("merkle", "d2h", int(out.nbytes))
+        return [d.tobytes() for d in _words_to_digests(out)]
+
+    out, _used_device = dispatch.supervised_call(
+        "merkle", device, lambda: [sha256d(p) for p in pairs],
+        validate=lambda res: res[0] == sha256d(pairs[0]),
+        poison=lambda res: [bytes(b ^ 0xFF for b in res[0])] + res[1:],
+        items=n,
+    )
+    return out
+
+
 def compute_merkle_root_tpu(hashes: list[bytes]) -> tuple[bytes, bool]:
     """Drop-in for consensus.merkle.compute_merkle_root on large inputs
     (see compute_merkle_root_tpu_ex for the full contract)."""

@@ -696,6 +696,34 @@ class CConnman:
             self._unrequested.discard(h)
             entry["retry_at"] = now + entry["boff"].next()
 
+    def cancel_backfill(self) -> None:
+        """Abandon every outstanding backfill pull (ISSUE 17): the shadow
+        validator hard-aborted (epoch-digest divergence, rejected block or
+        final digest mismatch), so the history it was naming is for a
+        chainstate that will never be promoted — keeping the requests
+        alive would waste peer goodput and hold getdata reservations on a
+        node that is about to shut down for manual intervention.
+        Thread-safe like request_backfill."""
+
+        def _go() -> None:
+            for h in list(self._backfill):
+                owner_id = self._requested_blocks.pop(h, None)
+                if owner_id is not None:
+                    owner = self.peers.get(owner_id)
+                    if owner is not None:
+                        owner.inflight.discard(h)
+                self._unrequested.discard(h)
+            n = len(self._backfill)
+            self._backfill.clear()
+            if n:
+                log_print("net", "backfill cancelled: %d outstanding "
+                          "pull(s) abandoned", n)
+
+        if self.loop is None:
+            _go()
+        else:
+            self.loop.call_soon_threadsafe(_go)
+
     def _backfill_retry(self, h: bytes, entry: dict, now: float) -> None:
         peers = self._backfill_peers(exclude=entry["peer"])
         if not peers:

@@ -87,6 +87,11 @@ def getblockchaininfo(node, params):
         # prune_height is tracked incrementally (and persisted) by
         # prune_block_files — no chain scan under cs_main here
         out["pruneheight"] = node.prune_height
+    # snapshot-onboarded nodes expose the certificate/quarantine view the
+    # fleet probe keys on (serving/replicas.py); absent everywhere else
+    snap_info = node.snapshot_info()
+    if snap_info is not None:
+        out["snapshot"] = snap_info
     return out
 
 
@@ -344,9 +349,24 @@ def dumptxoutset(node, params):
     headers = [cs.chain[h].header.serialize() for h in range(tip.height + 1)]
     from ..store import snapshot as snapshot_mod
 
+    # proof-carrying certificate: built from this node's own undo data
+    # (store/certificate.py). A node that cannot attest — legacy store,
+    # or itself snapshot-onboarded without full backfill — dumps an
+    # uncertified snapshot with a warning rather than failing the dump.
+    from ..store.certificate import CertificateError
+    from ..util.log import log_printf
+
+    certificate = None
+    try:
+        certificate = node.build_snapshot_certificate(tip.height)
+    except CertificateError as e:
+        log_printf("dumptxoutset: cannot attest (%s) — writing an "
+                   "UNCERTIFIED snapshot; loaders will quarantine it "
+                   "until fully validated", e)
+
     manifest = snapshot_mod.dump_snapshot(
         node.coins_db, str(params[0]), headers, tip.height, tip.hash,
-        node.params.network)
+        node.params.network, certificate=certificate)
     return {
         "path": str(params[0]),
         "height": manifest["height"],
@@ -354,6 +374,8 @@ def dumptxoutset(node, params):
         "coins": manifest["coins"],
         "muhash": manifest["muhash"],
         "nfiles": len(manifest["files"]),
+        "certified": certificate is not None,
+        "epochs": len((certificate or {}).get("epochs", [])),
     }
 
 

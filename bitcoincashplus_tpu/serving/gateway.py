@@ -242,6 +242,10 @@ class Gateway:
                "help": "1 when the replica is served from", "samples": []}
         tip = {"name": "bcp_gateway_replica_tip_height", "type": "gauge",
                "help": "Last probed replica tip height", "samples": []}
+        quar = {"name": "bcp_gateway_replica_quarantined", "type": "gauge",
+                "help": "1 while the replica is shed for serving an "
+                        "unverified snapshot (certificate quarantine)",
+                "samples": []}
         infl = {"name": "bcp_gateway_inflight", "type": "gauge",
                 "help": "Requests currently inside the gateway",
                 "samples": [({}, self._inflight)]}
@@ -251,7 +255,8 @@ class Gateway:
                 (lbl, _BREAKER_STATE_NUM.get(rep.breaker.state, -1)))
             rot["samples"].append((lbl, 1 if rep.in_rotation else 0))
             tip["samples"].append((lbl, rep.tip_height))
-        return [state, rot, tip, infl]
+            quar["samples"].append((lbl, 1 if rep.quarantined else 0))
+        return [state, rot, tip, quar, infl]
 
     # -- admission ------------------------------------------------------
 

@@ -103,6 +103,14 @@ class Replica:
         self.tip_height = -1
         self.tip_hash = ""
         self.lagging = False
+        # quarantine (ISSUE 17): the replica onboarded from a snapshot
+        # whose trust is not yet established — no verified certificate at
+        # load AND background validation still running. Pool-visible (it
+        # probes, its tip feeds the fan-out height) but shed from serving
+        # exactly like a lagging replica, until the probe sees
+        # snapshot.certificate_verified flip true.
+        self.quarantined = False
+        self.quarantine_logged = False
         self.in_rotation = False
         self.last_probe_ok = 0.0
         self.calls = 0
@@ -131,6 +139,11 @@ class Replica:
             info = self.call("getblockchaininfo", [])
             self.tip_height = int(info["blocks"])
             self.tip_hash = str(info["bestblockhash"])
+            # absent sub-doc = never snapshot-onboarded = nothing to
+            # quarantine; present = trust the gate it reports
+            snap = info.get("snapshot")
+            self.quarantined = bool(
+                snap and not snap.get("certificate_verified"))
         except Exception as e:
             self.breaker.record_failure(e)
             _PROBE_C.labels(replica=self.name, outcome="fail").inc()
@@ -145,6 +158,7 @@ class Replica:
             "name": self.name,
             "in_rotation": self.in_rotation,
             "lagging": self.lagging,
+            "quarantined": self.quarantined,
             "tip_height": self.tip_height,
             "tip_hash": self.tip_hash,
             "calls": self.calls,
@@ -173,6 +187,7 @@ class ReplicaPool:
         self.validator_tip = validator_tip
         self.fanout_height = -1
         self.rotations_out = 0     # times a replica left the rotation
+        self.quarantines = 0       # rotations-out caused by quarantine
         self._rr = 0               # round-robin cursor
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -197,13 +212,30 @@ class ReplicaPool:
         for rep in self.replicas:
             rep.lagging = (rep.tip_height < 0 or
                            self.fanout_height - rep.tip_height > self.max_lag)
-            admit = rep.breaker.healthy() and not rep.lagging
+            admit = (rep.breaker.healthy() and not rep.lagging
+                     and not rep.quarantined)
             if rep.in_rotation and not admit:
                 self.rotations_out += 1
                 log_print("gateway", "replica %s rotated out (lagging=%s "
-                          "breaker=%s tip=%d fanout=%d)", rep.name,
-                          rep.lagging, rep.breaker.state, rep.tip_height,
+                          "quarantined=%s breaker=%s tip=%d fanout=%d)",
+                          rep.name, rep.lagging, rep.quarantined,
+                          rep.breaker.state, rep.tip_height,
                           self.fanout_height)
+            elif not rep.in_rotation and admit and rep.quarantine_logged:
+                log_print("gateway", "replica %s re-admitted (certificate "
+                          "verified, tip=%d)", rep.name, rep.tip_height)
+            if rep.quarantined and not rep.quarantine_logged:
+                # one per episode, whether the replica was shed from
+                # rotation or arrived already-quarantined (a fresh
+                # cert-less onboard is an episode too)
+                rep.quarantine_logged = True
+                self.quarantines += 1
+                log_print("gateway", "replica %s QUARANTINED: snapshot "
+                          "loaded without verified certificate — shed "
+                          "from serving until validation completes",
+                          rep.name)
+            elif not rep.quarantined:
+                rep.quarantine_logged = False
             rep.in_rotation = admit
 
     def _probe_loop(self) -> None:
@@ -254,5 +286,7 @@ class ReplicaPool:
             "fanout_height": self.fanout_height,
             "max_lag": self.max_lag,
             "rotations_out": self.rotations_out,
+            "quarantines": self.quarantines,
+            "quarantined": sum(1 for r in self.replicas if r.quarantined),
             "replicas": [r.snapshot() for r in self.replicas],
         }

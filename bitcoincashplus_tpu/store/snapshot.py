@@ -28,6 +28,7 @@ from typing import Optional
 
 from ..util.log import log_printf
 from . import muhash
+from .certificate import CERT_NAME, CertificateError, verify_certificate
 from .kvstore import atomic_write_json, read_json
 from .sharded import ShardedCoinsDB, shard_of
 
@@ -51,9 +52,14 @@ def _shard_streams(coins_db):
 
 
 def dump_snapshot(coins_db, path: str, headers: list[bytes],
-                  height: int, best_block: bytes, network: str) -> dict:
+                  height: int, best_block: bytes, network: str,
+                  certificate: Optional[dict] = None) -> dict:
     """Write a snapshot directory at ``path`` from the PERSISTED coin set
-    (the caller flushes first). Returns the manifest dict."""
+    (the caller flushes first). When the dumping node supplies a
+    proof-carrying ``certificate`` (store/certificate.py) it is written
+    alongside as CERTIFICATE.json — self-authenticating via its own
+    commitment chain, so the manifest does not checksum it. Returns the
+    manifest dict."""
     os.makedirs(path, exist_ok=True)
     hdr_blob = b"".join(headers)
     with open(os.path.join(path, HEADERS_NAME), "wb") as f:
@@ -97,8 +103,11 @@ def dump_snapshot(coins_db, path: str, headers: list[bytes],
                     "sha256": hashlib.sha256(hdr_blob).hexdigest()},
     }
     atomic_write_json(os.path.join(path, MANIFEST_NAME), manifest)
-    log_printf("dumptxoutset: %d coins at height %d -> %s (digest %s)",
-               total_coins, height, path, manifest["muhash"][:16])
+    if certificate is not None:
+        atomic_write_json(os.path.join(path, CERT_NAME), certificate)
+    log_printf("dumptxoutset: %d coins at height %d -> %s (digest %s%s)",
+               total_coins, height, path, manifest["muhash"][:16],
+               ", certified" if certificate is not None else "")
     return manifest
 
 
@@ -127,12 +136,25 @@ def _iter_rows(path: str, expect_sha: str):
 
 def load_snapshot(path: str, coins_db: ShardedCoinsDB, network: str,
                   expected_hash: Optional[bytes] = None,
-                  expected_digest: Optional[bytes] = None) -> dict:
+                  expected_digest: Optional[bytes] = None,
+                  require_certificate: bool = False) -> dict:
     """Stream a snapshot into ``coins_db`` (re-partitioned to its shard
     count), verify the recomputed set digest against the manifest and the
     operator authorization BEFORE stamping any chainstate meta, and
     return {height, best_block, headers(list of 80-byte blobs),
-    manifest}. On any failure the loaded rows are wiped."""
+    manifest, certificate, cert_checkpoints}. On any failure the loaded
+    rows are wiped.
+
+    If the snapshot carries CERTIFICATE.json it is verified BEFORE a
+    single row is streamed: wrong MMR root over the snapshot's own
+    headers, truncated epoch trajectory, or a bit-flipped certificate all
+    raise SnapshotError and take the same wipe-and-reject path as a wrong
+    set digest — the chainstate is never half-loaded. On success
+    ``cert_checkpoints`` maps epoch height -> expected MuHash digest hex
+    for the background shadow validator to check itself against as it
+    replays. ``require_certificate`` (``-snapshotcertrequired``) refuses
+    certificate-less snapshots outright; without it they still load but
+    the node quarantines them from serving until fully validated."""
     manifest = read_json(os.path.join(path, MANIFEST_NAME))
     if not manifest or manifest.get("version") != SNAPSHOT_VERSION:
         raise SnapshotError(f"missing or unreadable {MANIFEST_NAME}")
@@ -157,6 +179,12 @@ def load_snapshot(path: str, coins_db: ShardedCoinsDB, network: str,
         raise SnapshotError("headers stream corrupt")
     headers = [hdr_blob[i:i + 80] for i in range(0, len(hdr_blob), 80)]
 
+    certificate = read_json(os.path.join(path, CERT_NAME))
+    if require_certificate and not certificate:
+        raise SnapshotError(
+            "snapshot carries no certificate and -snapshotcertrequired is "
+            "set — refusing trust-me onboarding")
+
     n = coins_db.n_shards
     shard_states = [1] * n
     pending_elems: list[list[int]] = [[] for _ in range(n)]
@@ -174,7 +202,25 @@ def load_snapshot(path: str, coins_db: ShardedCoinsDB, network: str,
                                    ) % muhash.MUHASH_P
                 pending_elems[i] = []
 
+    cert_checkpoints: Optional[dict] = None
     try:
+        if certificate:
+            # fail-fast leg: a bad certificate costs seconds (batched
+            # header-MMR recompute + one hash chain), not a streamed-in
+            # chainstate — and any failure still exits through the same
+            # clear_coins() wipe as a digest mismatch, so a fault-injected
+            # mid-verify crash (snapshot_cert fail-*) provably cannot
+            # leave rows behind
+            from ..crypto.hashes import sha256d
+            try:
+                cert_checkpoints = verify_certificate(
+                    certificate, [sha256d(h) for h in headers],
+                    manifest["height"], manifest["muhash"])
+            except CertificateError as e:
+                raise SnapshotError(f"snapshot certificate rejected: {e}")
+            log_printf("loadtxoutset: certificate verified (%d epoch "
+                       "checkpoints, stride %d)", len(cert_checkpoints),
+                       certificate["epoch_blocks"])
         for entry in manifest["files"]:
             for key36, ser in _iter_rows(os.path.join(path, entry["name"]),
                                          entry["sha256"]):
@@ -204,9 +250,19 @@ def load_snapshot(path: str, coins_db: ShardedCoinsDB, network: str,
         snapshot={"height": manifest["height"],
                   "hash": manifest["best_block"],
                   "digest": manifest["muhash"],
-                  "validated": False})
+                  "validated": False,
+                  "cert": {"present": bool(certificate),
+                           "verified": bool(certificate),
+                           "epoch_blocks": (certificate or {}).get(
+                               "epoch_blocks", 0),
+                           "epochs": len(cert_checkpoints or {})}})
     log_printf("loadtxoutset: %d coins at height %d (digest %s) — "
-               "serving at the snapshot tip, history pending",
-               total, manifest["height"], manifest["muhash"][:16])
+               "serving at the snapshot tip, history pending%s",
+               total, manifest["height"], manifest["muhash"][:16],
+               "" if certificate else
+               " (UNCERTIFIED: quarantined from fleet serving until "
+               "fully validated)")
     return {"height": manifest["height"], "best_block": best_block,
-            "headers": headers, "manifest": manifest}
+            "headers": headers, "manifest": manifest,
+            "certificate": certificate,
+            "cert_checkpoints": cert_checkpoints}

@@ -77,6 +77,17 @@ NET_SITE = "net"
 GATEWAY_SITE = "gateway"
 REPLICA_RPC_SITE = "replica_rpc"
 
+# Proof-carrying snapshot certificate site (ISSUE 17), explicit-only like
+# the other non-accelerator sites. It fires on BOTH legs of the
+# certificate lifecycle: at build (dumptxoutset) poison-output corrupts
+# one mid-trajectory epoch digest BEFORE the commitment chain is sealed —
+# the forged-epoch snapshot that passes structural verification at load
+# and must be caught at the first divergent epoch checkpoint by the
+# shadow validator; at verify (loadtxoutset) fail-* models a certificate
+# check blowing up mid-load and must take the wipe-and-reject path, never
+# a half-loaded chainstate.
+SNAPSHOT_CERT_SITE = "snapshot_cert"
+
 
 class InjectedFault(RuntimeError):
     """A deliberately injected device failure (never raised in production

@@ -21,7 +21,25 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import hashlib  # noqa: E402
 import tempfile  # noqa: E402
+
+# Persistent compilation cache, seeded into the ENVIRONMENT before jax
+# (or any spawned bcpd) initializes: the fused-GLV verify programs cost
+# minutes of cold XLA compile on the CPU backend, and the functional
+# tests spawn real node processes that would otherwise each pay it
+# again. The dir is per-checkout (path-hashed, so parallel checkouts
+# never share entries) but persistent across runs — the cold compile is
+# paid once per machine, and node/node.py's -compilecache env fallback
+# means every spawned bcpd inherits it with no extra flags. Tests assert
+# the inheritance end to end via gettpuinfo.device.compilation_cache.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CACHE_DIR = os.environ.setdefault(
+    "BCP_COMPILE_CACHE",
+    os.path.join(
+        tempfile.gettempdir(),
+        "bcp-jax-test-cache-"
+        + hashlib.sha256(_REPO_ROOT.encode()).hexdigest()[:12]))
 
 import jax  # noqa: E402  (env must be set first)
 
@@ -31,14 +49,12 @@ import jax  # noqa: E402  (env must be set first)
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_device", jax.devices("cpu")[0])
 
-# Persistent compilation cache: the ECDSA batch kernel costs ~90s of XLA
-# compile on the CPU backend; caching it keeps the default suite fast
-# after the first run while still exercising the real kernel every run.
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(tempfile.gettempdir(), "bcp-jax-test-cache"),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+# the in-process half of the same cache (devicewatch.enable_compile_cache
+# also installs the jax.monitoring listener, so in-process cache hits are
+# observable just like the spawned nodes')
+from bitcoincashplus_tpu.util import devicewatch as _dw  # noqa: E402
+
+_dw.enable_compile_cache(_CACHE_DIR)
 
 import pytest  # noqa: E402
 

@@ -169,3 +169,104 @@ def test_fleet_gateway_end_to_end(monkeypatch):
 
         # the campaign rotated replicas out at least twice (kill + lag)
         assert _gw(validator)["pool"]["rotations_out"] >= 2
+
+
+def test_fleet_quarantine_campaign():
+    """ISSUE 17: a replica that onboards from a snapshot whose
+    certificate cannot be verified (here: stripped — the poisoned-
+    provenance stand-in that never flips ``certificate_verified``) is
+    QUARANTINED: pool-visible, probed, but shed from rotation so it
+    never serves a read. The fleet keeps answering consistently from
+    the certified replica + validator fallback, and a clean certified
+    reload re-admits the quarantined node within the probe window."""
+    import shutil
+    import time as _time
+
+    # -rest on the validator: the Prometheus /metrics exposition the
+    # campaign asserts on at the end rides the REST interface
+    f = FunctionalFramework(num_nodes=3, extra_args=[["-rest"], [], []])
+    setup_fleet(f)
+    with f:
+        validator, r1, r2 = f.nodes
+        r2_name = f"127.0.0.1:{r2.rpc_port}"
+        validator.rpc.generatetoaddress(CHAIN_H, ADDR)
+        tip = validator.rpc.getbestblockhash()
+
+        snap_path = os.path.join(validator.datadir, "cert-snapshot")
+        dump = validator.rpc.dumptxoutset(snap_path)
+        assert dump["certified"] is True
+        nocert = os.path.join(validator.datadir, "nocert-snapshot")
+        shutil.copytree(snap_path, nocert)
+        os.remove(os.path.join(nocert, "CERTIFICATE.json"))
+
+        # r1: certified onboarding — admitted on certificate trust alone,
+        # without waiting for background validation
+        bootstrap_replica_from_snapshot(r1, validator, snap_path, dump)
+        wait_until(lambda: len(_rotation(validator)) >= 1, timeout=60)
+
+        # r2: loads the cert-less snapshot and stays DISCONNECTED from the
+        # validator (no backfill → never validated → the serving gate
+        # stays down deterministically). Tip == validator tip, so the lag
+        # gate is NOT what sheds it — quarantine is.
+        r2.stop()
+        auth = f"-assumeutxo={dump['bestblock']}:{dump['muhash']}"
+        if auth not in r2.extra_args:
+            r2.extra_args.append(auth)
+        r2.start()
+        r2.rpc.loadtxoutset(nocert)
+        assert r2.rpc.getblockcount() == CHAIN_H
+        snap_doc = r2.rpc.getblockchaininfo()["snapshot"]
+        assert snap_doc["certificate_verified"] is False
+
+        # the probe loop sees the down gate: shed, but pool-visible
+
+        def _r2_doc() -> dict:
+            return {r["name"]: r for r in
+                    _gw(validator)["pool"]["replicas"]}[r2_name]
+
+        wait_until(lambda: _r2_doc()["quarantined"], timeout=30)
+        pool = _gw(validator)["pool"]
+        by_name = {r["name"]: r for r in pool["replicas"]}
+        assert r2_name not in _rotation(validator)
+        assert by_name[r2_name]["in_rotation"] is False
+        assert pool["quarantined"] >= 1
+        assert pool["quarantines"] >= 1
+
+        # reads keep flowing and every reply is consistent (the
+        # quarantined replica is never picked); p99 stays sane
+        gw = gateway_client(validator)
+        lat = []
+        for _ in range(40):
+            t0 = _time.monotonic()
+            assert gw.getbestblockhash() == tip
+            lat.append(_time.monotonic() - t0)
+        assert gw.getblockcount() == CHAIN_H
+        by_name = {r["name"]: r
+                   for r in _gw(validator)["pool"]["replicas"]}
+        assert by_name[r2_name]["in_rotation"] is False
+        assert by_name[r2_name]["quarantined"] is True
+        lat.sort()
+        assert lat[int(0.99 * len(lat))] < 2.0  # the bench records the bar
+
+        # clean certified reload: fresh datadir, verified certificate,
+        # re-admitted by the ordinary probe path — no gateway restart
+        r2.stop()
+        shutil.rmtree(r2.datadir)
+        r2.start()
+        r2.rpc.loadtxoutset(snap_path)
+        assert r2.rpc.getblockchaininfo()["snapshot"][
+            "certificate_verified"] is True
+        connect_nodes(r2, validator)
+        wait_until(lambda: r2_name in _rotation(validator), timeout=60)
+        by_name = {r["name"]: r
+                   for r in _gw(validator)["pool"]["replicas"]}
+        assert by_name[r2_name]["quarantined"] is False
+
+        # the quarantine surfaced in the Prometheus exposition too
+        # (the validator's REST /metrics; the gauge reads 0 now that the
+        # replica is re-admitted, 1 while it was quarantined)
+        import urllib.request
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{validator.rpc_port}/metrics",
+            timeout=10).read().decode()
+        assert "bcp_gateway_replica_quarantined" in metrics

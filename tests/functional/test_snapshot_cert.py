@@ -1,0 +1,231 @@
+"""Proof-carrying snapshots, end to end over real bcpd processes
+(ISSUE 17).
+
+The producer mines a chain and dumps a CERTIFIED snapshot (MMR header
+commitment + per-epoch MuHash trajectory, store/certificate.py); the
+consumer proves the three trust stories:
+
+  1. certificate-gated onboarding — a certified snapshot is verified at
+     ``loadtxoutset`` and the replica serves immediately with
+     ``certificate_verified`` up BEFORE background validation finishes
+     (the onboarding-economics flip), then spot-check shadow validation
+     converges to a byte-identical digest;
+  2. the rejection matrix — bit-flipped certificate, truncated epoch
+     trajectory, and the armed ``snapshot_cert`` fault site all take the
+     wipe-and-reject path (never a half-loaded chainstate), and
+     ``-snapshotcertrequired`` refuses a cert-less snapshot outright;
+  3. forged-epoch content — a snapshot poisoned AT BUILD (the
+     ``snapshot_cert`` poison-output drill) passes structural
+     verification at load, and the shadow validator hard-aborts the node
+     at the FIRST divergent epoch checkpoint, O(E) blocks past the
+     forgery instead of at height H.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import pytest
+
+from bitcoincashplus_tpu.consensus.params import regtest_params
+from bitcoincashplus_tpu.wallet.keys import CKey
+
+from .framework import FunctionalFramework, connect_nodes, wait_until
+
+pytestmark = [pytest.mark.functional, pytest.mark.snapshot]
+
+KEY = CKey(0x17CE47)
+ADDR = KEY.p2pkh_address(regtest_params())
+
+CHAIN_H = 24
+EPOCH = 8  # checkpoints [8, 16, 24]
+
+CERT_NAME = "CERTIFICATE.json"
+
+
+def _forge_copy(snap_path: str, dest: str, mutate) -> str:
+    """Copy the snapshot dir and run ``mutate(cert_dict)`` over its
+    certificate (the tamper matrix: each mutation is applied to an
+    otherwise-honest snapshot)."""
+    shutil.rmtree(dest, ignore_errors=True)
+    shutil.copytree(snap_path, dest)
+    cert_file = os.path.join(dest, CERT_NAME)
+    with open(cert_file) as f:
+        cert = json.load(f)
+    mutate(cert)
+    with open(cert_file, "w") as f:
+        json.dump(cert, f)
+    return dest
+
+
+def _snap_doc(node) -> dict:
+    return node.rpc.getblockchaininfo()["snapshot"]
+
+
+def test_certified_onboarding_with_spotcheck():
+    with FunctionalFramework(
+            num_nodes=2,
+            extra_args=[[f"-snapshotepoch={EPOCH}"], []]) as f:
+        a, b = f.nodes
+        a.rpc.generatetoaddress(CHAIN_H, ADDR)
+        snap_path = os.path.join(a.datadir, "utxo-snapshot")
+        dump = a.rpc.dumptxoutset(snap_path)
+        assert dump["certified"] is True
+        assert dump["epochs"] == 3  # [8, 16, 24]
+        assert os.path.exists(os.path.join(snap_path, CERT_NAME))
+
+        # restart B authorized, with seeded spot-check sampling (1 of the
+        # 3 certified epochs gets full script re-validation; the digest
+        # tripwires stay armed at every boundary)
+        b.stop()
+        b.extra_args += [
+            f"-assumeutxo={dump['bestblock']}:{dump['muhash']}",
+            "-snapshotspotcheck=1", "-netseed=7",
+        ]
+        b.start()
+
+        # tamper matrix first (each rejected load must leave the node
+        # fresh — tip at genesis, zero coins — or the next load couldn't
+        # even start)
+        flipped = _forge_copy(
+            snap_path, os.path.join(a.datadir, "snap-flip"),
+            lambda c: c.update(commitment="00" + c["commitment"][2:]
+                               if not c["commitment"].startswith("00")
+                               else "ff" + c["commitment"][2:]))
+        with pytest.raises(Exception, match="certificate rejected"):
+            b.rpc.loadtxoutset(flipped)
+        assert b.rpc.getblockcount() == 0
+        assert b.rpc.gettxoutsetinfo()["txouts"] == 0  # wiped, not half-loaded
+
+        truncated = _forge_copy(
+            snap_path, os.path.join(a.datadir, "snap-trunc"),
+            lambda c: c["epochs"].pop(0))
+        with pytest.raises(Exception, match="certificate rejected"):
+            b.rpc.loadtxoutset(truncated)
+        assert b.rpc.getblockcount() == 0
+
+        # the honest certified load: verified at load, serving instantly
+        res = b.rpc.loadtxoutset(snap_path)
+        assert res["height"] == CHAIN_H
+        assert b.rpc.getblockcount() == CHAIN_H
+        doc = _snap_doc(b)
+        # trust established by the certificate, in seconds — BEFORE the
+        # background replay (validated flips later, the gate is already up)
+        assert doc["cert_present"] and doc["cert_verified"]
+        assert doc["certificate_verified"] is True
+
+        # background (spot-check) validation converges byte-identically
+        connect_nodes(b, a)
+        wait_until(lambda: _snap_doc(b)["validated"], timeout=180, sleep=1.0)
+        ia, ib = a.rpc.gettxoutsetinfo(), b.rpc.gettxoutsetinfo()
+        assert ia["muhash"] == ib["muhash"]
+        assert ia["bestblock"] == ib["bestblock"]
+        with open(os.path.join(b.datadir, "debug.log")) as fh:
+            log = fh.read()
+        assert "spot-check mode" in log
+        # the epoch tripwire file is cleaned up once validation lands
+        assert not os.path.exists(
+            os.path.join(b.datadir, "snapshot_cert.json"))
+
+
+def test_certificate_rejection_matrix(monkeypatch):
+    # the snapshot_cert fault site is explicit-only; arming it here
+    # reaches both spawned nodes, but only B's loadtxoutset executes the
+    # verify leg (the producer's dump leg only fires under poison-output)
+    monkeypatch.setenv("BCP_FAULT_MODE", "fail-always")
+    monkeypatch.setenv("BCP_FAULT_OPS", "snapshot_cert")
+    with FunctionalFramework(
+            num_nodes=2,
+            extra_args=[["-snapshotepoch=4"], []]) as f:
+        a, b = f.nodes
+        a.rpc.generatetoaddress(8, ADDR)
+        snap_path = os.path.join(a.datadir, "cert-snapshot")
+        dump = a.rpc.dumptxoutset(snap_path)
+        assert dump["certified"] is True
+
+        nocert = os.path.join(a.datadir, "snap-nocert")
+        shutil.rmtree(nocert, ignore_errors=True)
+        shutil.copytree(snap_path, nocert)
+        os.remove(os.path.join(nocert, CERT_NAME))
+
+        auth = f"-assumeutxo={dump['bestblock']}:{dump['muhash']}"
+        b.stop()
+        b.extra_args += [auth, "-snapshotcertrequired"]
+        b.start()
+
+        # cert-less + -snapshotcertrequired: refused before any row lands
+        with pytest.raises(Exception, match="certificate"):
+            b.rpc.loadtxoutset(nocert)
+        assert b.rpc.getblockcount() == 0
+
+        # armed fail-always: the certificate check blows up mid-load and
+        # MUST take the wipe-and-reject path (BCP005 drill, fail leg)
+        with pytest.raises(Exception, match="[Ii]njected"):
+            b.rpc.loadtxoutset(snap_path)
+        assert b.rpc.getblockcount() == 0
+        assert b.rpc.gettxoutsetinfo()["txouts"] == 0
+
+        # disarm and restart: the same snapshot now verifies and serves
+        monkeypatch.setenv("BCP_FAULT_MODE", "off")
+        b.stop()
+        b.start()
+        res = b.rpc.loadtxoutset(snap_path)
+        assert res["height"] == 8
+        assert _snap_doc(b)["certificate_verified"] is True
+
+        # cert-less WITHOUT the required flag: allowed, but the serving
+        # gate stays down (the fleet-quarantine signal) until validation
+        b.stop()
+        shutil.rmtree(b.datadir)  # back to a fresh node
+        b.extra_args.remove("-snapshotcertrequired")
+        b.start()
+        res = b.rpc.loadtxoutset(nocert)
+        assert res["height"] == 8
+        doc = _snap_doc(b)
+        assert doc["cert_present"] is False
+        assert doc["certificate_verified"] is False
+
+
+def test_forged_epoch_hard_abort(monkeypatch):
+    # poison-output at BUILD: dumptxoutset corrupts one mid-trajectory
+    # epoch digest before the commitment chain is sealed — the forgery
+    # structural verification cannot see
+    monkeypatch.setenv("BCP_FAULT_MODE", "poison-output")
+    monkeypatch.setenv("BCP_FAULT_OPS", "snapshot_cert")
+    with FunctionalFramework(
+            num_nodes=2,
+            extra_args=[[f"-snapshotepoch={EPOCH}"], []]) as f:
+        a, b = f.nodes
+        a.rpc.generatetoaddress(CHAIN_H, ADDR)
+        snap_path = os.path.join(a.datadir, "forged-snapshot")
+        dump = a.rpc.dumptxoutset(snap_path)
+        assert dump["certified"] is True
+
+        b.stop()
+        b.extra_args.append(
+            f"-assumeutxo={dump['bestblock']}:{dump['muhash']}")
+        b.start()
+        # the forged certificate PASSES load-time verification (the chain
+        # was sealed over the forged digest; the final epoch matches the
+        # manifest) — the replica starts serving
+        res = b.rpc.loadtxoutset(snap_path)
+        assert res["height"] == CHAIN_H
+        assert _snap_doc(b)["certificate_verified"] is True
+
+        # ... until the shadow replay crosses the forged checkpoint: the
+        # running MuHash diverges from the certified digest at epoch 16
+        # (the poisoned middle epoch) and the node hard-aborts there —
+        # detection latency O(E) blocks, not the full height-H replay
+        connect_nodes(b, a)
+        wait_until(lambda: b.process.poll() is not None,
+                   timeout=180, sleep=1.0)
+        with open(os.path.join(b.datadir, "debug.log")) as fh:
+            log = fh.read()
+        assert "EPOCH DIGEST DIVERGENCE" in log
+        assert "FORGED" in log
+        assert "checkpoint 16" in log
+        # never reached the final checkpoint: the abort beat the full
+        # re-validation to the punch
+        assert f"checkpoint {CHAIN_H}" not in log

@@ -1,6 +1,9 @@
 """BIP32 derivation — the BIP's published test vectors 1 and 2 plus
 CKDpub/CKDpriv consistency properties (src/test/bip32_tests.cpp)."""
 
+import pytest
+
+pytest.importorskip("hypothesis")  # property tests need the optional test extra
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -7,6 +7,9 @@ vectors, which pin MurmurHash3 bit-for-bit) and src/test/pmt_tests.cpp
 
 import struct
 
+import pytest
+
+pytest.importorskip("hypothesis")  # property tests need the optional test extra
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -3,6 +3,9 @@ reconstruction (src/test/blockencodings_tests.cpp analogues)."""
 
 import struct
 
+import pytest
+
+pytest.importorskip("hypothesis")  # property tests need the optional test extra
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

@@ -3,6 +3,9 @@
 import hashlib
 import struct
 
+import pytest
+
+pytest.importorskip("hypothesis")  # property tests need the optional test extra
 from hypothesis import given
 from hypothesis import strategies as st
 

@@ -376,3 +376,38 @@ def test_chainstate_registers_pipeline_watchdog():
     assert clk_entry["pending_fn"]() == 1
     cs._spec.clear()
     assert clk_entry["pending_fn"]() == 0
+
+
+def test_persistent_cache_hits_surface_in_snapshot(tmp_path):
+    """Second compile of the same program is served from the persistent
+    cache and the monitoring listener tallies it — the cache_hits field
+    gettpuinfo.device.compilation_cache exposes (and that the functional
+    suite asserts > 0 on re-spawned nodes via conftest's seeded
+    BCP_COMPILE_CACHE). Toy jit, so the 2 s min-compile-time floor is
+    lowered for the duration; all cache config is restored after."""
+    saved_dir = jax.config.jax_compilation_cache_dir
+    saved_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    saved_cc = dict(dir=dw._COMPILE_CACHE["dir"],
+                    enabled=dw._COMPILE_CACHE["enabled"])
+    try:
+        dw.enable_compile_cache(str(tmp_path / "cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+
+        @jax.jit
+        def f(x):
+            return x * 2 + 1
+
+        assert int(f(np.int32(20))) == 41  # cold: writes the cache entry
+        jax.clear_caches()  # drop the in-memory executable
+        assert int(f(np.int32(20))) == 41  # warm: persistent-cache read
+        snap = dw.compile_cache_snapshot()
+        assert snap["enabled"]
+        assert snap["dir"] == str(tmp_path / "cache")
+        assert snap["cache_hits"] > 0
+    finally:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          saved_min)
+        if saved_dir is not None:
+            jax.config.update("jax_compilation_cache_dir", saved_dir)
+        with dw._LOCK:
+            dw._COMPILE_CACHE.update(saved_cc)

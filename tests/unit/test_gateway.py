@@ -441,3 +441,80 @@ class TestFaultDrills:
             assert gw.handle("getblockcount", [], "c") == "validator"
         finally:
             gw.close()
+
+
+# -- certificate quarantine (ISSUE 17) ---------------------------------
+
+
+def quarantined_transport(height=10, verified=False):
+    """A replica that onboarded from a snapshot: getblockchaininfo
+    carries the certificate/quarantine sub-doc the probe keys on."""
+    state = {"verified": verified}
+
+    def call(method, params):
+        if method == "getblockchaininfo":
+            info = chaininfo(height)
+            info["snapshot"] = {
+                "height": height, "validated": False,
+                "cert_present": state["verified"],
+                "cert_verified": state["verified"],
+                "certificate_verified": state["verified"],
+            }
+            return info
+        return f"q:{method}"
+
+    return call, state
+
+
+class TestQuarantine:
+    def test_unverified_snapshot_replica_is_shed(self):
+        t, _ = quarantined_transport(height=10, verified=False)
+        quar = make_replica("q", t)
+        ok = make_replica("ok", healthy_transport(10, tag="ok"))
+        pool = make_pool([quar, ok], tip=10)
+        # pool-visible (probed, tip feeds fan-out) but never served from
+        assert quar.tip_height == 10 and quar.quarantined
+        assert not quar.in_rotation and ok.in_rotation
+        assert pool.snapshot()["quarantined"] == 1
+        for _ in range(6):
+            assert pool.pick().name == "ok"
+
+    def test_verified_certificate_admits_immediately(self):
+        t, _ = quarantined_transport(height=10, verified=True)
+        rep = make_replica("r", t)
+        pool = make_pool([rep], tip=10)
+        assert not rep.quarantined and rep.in_rotation
+
+    def test_readmission_when_certificate_verifies(self):
+        t, state = quarantined_transport(height=10, verified=False)
+        rep = make_replica("r", t)
+        pool = make_pool([rep], tip=10)
+        assert rep.quarantined and not rep.in_rotation
+        # background validation (or a clean certified reload) completes
+        state["verified"] = True
+        pool.probe_once()
+        assert not rep.quarantined and rep.in_rotation
+        assert pool.pick().name == "r"
+
+    def test_nodes_without_snapshot_subdoc_never_quarantine(self):
+        rep = make_replica("r", healthy_transport(10))
+        make_pool([rep], tip=10)
+        assert not rep.quarantined and rep.in_rotation
+
+    def test_quarantine_rotation_is_counted_and_metered(self):
+        t, state = quarantined_transport(height=10, verified=True)
+        rep = make_replica("r", t)
+        pool = make_pool([rep], tip=10)
+        assert rep.in_rotation
+        state["verified"] = False  # poisoned reload mid-flight
+        pool.probe_once()
+        assert not rep.in_rotation
+        assert pool.quarantines == 1
+        assert pool.rotations_out == 1
+        gw = Gateway(FakeBackendTracker(), pool)
+        try:
+            fams = {f["name"]: f for f in gw._collect()}
+            q = fams["bcp_gateway_replica_quarantined"]["samples"]
+            assert q == [({"replica": "r"}, 1)]
+        finally:
+            gw.close()

@@ -3,6 +3,9 @@ naive recursive algorithm, plus the CVE-2012-2459 mutation edge)."""
 
 import os
 
+import pytest
+
+pytest.importorskip("hypothesis")  # property tests need the optional test extra
 from hypothesis import given
 from hypothesis import strategies as st
 

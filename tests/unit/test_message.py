@@ -8,6 +8,7 @@ address, reject tampered messages/signatures/wrong addresses.
 import base64
 
 import pytest
+pytest.importorskip("hypothesis")  # property tests need the optional test extra
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

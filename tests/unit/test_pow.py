@@ -4,6 +4,7 @@ synthetic header chains; compact-bits codec edges from arith_uint256 tests)."""
 from dataclasses import dataclass, field
 
 import pytest
+pytest.importorskip("hypothesis")  # property tests need the optional test extra
 from hypothesis import given
 from hypothesis import strategies as st
 

@@ -2,6 +2,7 @@
 round-trip parts, src/test/uint256_tests.cpp)."""
 
 import pytest
+pytest.importorskip("hypothesis")  # property tests need the optional test extra
 from hypothesis import given
 from hypothesis import strategies as st
 

@@ -11,6 +11,7 @@ checker settled by the CPU oracle.
 import hashlib
 
 import pytest
+pytest.importorskip("hypothesis")  # property tests need the optional test extra
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

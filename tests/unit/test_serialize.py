@@ -1,6 +1,7 @@
 """Serialization codec tests (reference model: src/test/serialize_tests.cpp)."""
 
 import pytest
+pytest.importorskip("hypothesis")  # property tests need the optional test extra
 from hypothesis import given
 from hypothesis import strategies as st
 
