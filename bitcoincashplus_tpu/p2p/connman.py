@@ -210,6 +210,17 @@ _TICK_H = tm.histogram(
 
 
 class CConnman:
+    # machine-enforced by bcplint BCP009: every static write site (and
+    # every caller-holds path the reachability analysis can see) must
+    # hold the named lock. _ban_seq is bumped in _snapshot_banlist with
+    # ban_lock held by the caller — the interprocedural lockset proves
+    # it, so the convention is checked, not just documented.
+    GUARDED_BY = {
+        "_banned": "ban_lock",
+        "_ban_seq": "ban_lock",
+        "_ban_saved_seq": "ban_io_lock",
+    }
+
     def __init__(self, node, bind_host: str = "127.0.0.1", listen_port: int = 0):
         self.node = node
         self.magic = node.params.netmagic
@@ -314,6 +325,10 @@ class CConnman:
         # path + ".tmp", so concurrent writers must not interleave)
         self._ban_lock = lockwatch.watched_lock("ban_lock")
         self._ban_io_lock = lockwatch.watched_lock("ban_io_lock")
+        # publish the static GUARDED_BY vocabulary to the runtime
+        # sentinel so gettpuinfo.lockwatch and docs/CONCURRENCY.md agree
+        for field, lk in self.GUARDED_BY.items():
+            lockwatch.declare_guards(lk, [field])
         self._ban_seq = 0        # bumped under _ban_lock per mutation
         self._ban_saved_seq = 0  # last seq persisted (under _ban_io_lock)
         self._banned: dict[str, float] = self._load_banlist()
@@ -426,7 +441,10 @@ class CConnman:
         # this dict concurrently, so never rebind it
         for h, v in list(self._relay_memory.items()):
             if v[1] <= now:
-                self._relay_memory.pop(h, None)
+                # benign cache race: snapshot iteration and pop(h, None)
+                # are each GIL-atomic; a racing RPC re-insert that loses
+                # its entry to this expiry sweep just re-relays later
+                self._relay_memory.pop(h, None)  # BCPLINT-IGNORE[BCP008]: benign GIL-atomic cache expiry race
         # expire aged orphans (ORPHAN_TX_EXPIRE_TIME)
         for txid, entry in list(self._orphans.items()):
             if entry[3] + ORPHAN_EXPIRE_TIME <= now:

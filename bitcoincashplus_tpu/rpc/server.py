@@ -63,6 +63,8 @@ class RPCServer:
     def close(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
         cookie = os.path.join(self.node.datadir, ".cookie")
         if os.path.exists(cookie):
             os.remove(cookie)

@@ -395,6 +395,9 @@ class Gateway:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
         self.pool.close()
         # the PR 6 lesson: a scrape after close must not see this gateway
         tm.REGISTRY.unregister_collector(self._collector_name)

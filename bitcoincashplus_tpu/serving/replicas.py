@@ -113,6 +113,10 @@ class Replica:
         self.quarantine_logged = False
         self.in_rotation = False
         self.last_probe_ok = 0.0
+        # call/error tallies are bumped from every gateway handler
+        # thread plus the probe loop; += is a read-modify-write tear
+        # without this (BCP008)
+        self._stats_lock = threading.Lock()
         self.calls = 0
         self.errors = 0
 
@@ -122,13 +126,15 @@ class Replica:
         CALLER — the gateway records the verdict so a coalesced leader's
         failure is charged exactly once."""
         INJECTOR.on_call(REPLICA_RPC_SITE)
-        self.calls += 1
+        with self._stats_lock:
+            self.calls += 1
         try:
             return self.transport(method, params)
         except ReplicaRPCError:
             raise  # definitive answer — not replica sickness
         except Exception as e:
-            self.errors += 1
+            with self._stats_lock:
+                self.errors += 1
             raise ReplicaError(f"replica {self.name}: {e!r}") from e
 
     def probe(self) -> bool:

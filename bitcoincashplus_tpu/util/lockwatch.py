@@ -68,6 +68,17 @@ class LockMonitor:
         # separately so the cycle report can say WHERE each leg happened
         self.edges: dict[tuple[str, str], int] = {}
         self.edge_sites: dict[tuple[str, str], str] = {}
+        # GUARDED_BY vocabulary: lock name -> sorted fields declared
+        # guarded by it (bcplint BCP009's annotation convention), so the
+        # runtime snapshot and the static concurrency report agree on
+        # which locks are annotation-declared vs merely inferred
+        self.declared_guards: dict[str, list[str]] = {}
+
+    def declare_guards(self, lock_name: str, fields) -> None:
+        with self._mu:
+            cur = set(self.declared_guards.get(lock_name, ()))
+            cur.update(fields)
+            self.declared_guards[lock_name] = sorted(cur)
 
     # -- registration ---------------------------------------------------
 
@@ -223,6 +234,10 @@ class LockMonitor:
                 },
                 "inversions": len(cycles),
                 "cycles": cycles,
+                "declared_guards": {
+                    k: list(v)
+                    for k, v in sorted(self.declared_guards.items())
+                },
             }
 
     def reset(self) -> None:
@@ -233,6 +248,7 @@ class LockMonitor:
             self.acquisitions.clear()
             self.edges.clear()
             self.edge_sites.clear()
+            self.declared_guards.clear()
             self.max_depth = 0
 
 
@@ -358,6 +374,13 @@ def watched_condition(name: str):
     """A ``threading.Condition`` whose underlying lock is watched (the
     cv's lock participates in the order graph like any other lock)."""
     return threading.Condition(watched_lock(name))
+
+
+def declare_guards(lock_name: str, fields) -> None:
+    """Record the GUARDED_BY vocabulary for ``lock_name`` — called by
+    classes adopting bcplint's BCP009 annotation so gettpuinfo reports
+    which locks are declared guards (vs inferred from order edges)."""
+    MONITOR.declare_guards(lock_name, fields)
 
 
 def snapshot() -> dict:

@@ -1,0 +1,19 @@
+"""Seeded BCP004 violation via *explicit* acquire/release pairs: the
+same lock pair taken in opposite orders, but through ``.acquire()`` /
+``.release()`` statements instead of ``with`` blocks — the blind spot
+the gateway/banlist idiom exposed (edges must be minted from
+document-order pairs too)."""
+
+
+class TwoLocksExplicit:
+    def ab(self):
+        self.a_lock.acquire()
+        self.b_lock.acquire()  # BCPLINT-EXPECT
+        self.b_lock.release()
+        self.a_lock.release()
+
+    def ba(self):
+        self.b_lock.acquire()
+        self.a_lock.acquire()
+        self.a_lock.release()
+        self.b_lock.release()

@@ -1,0 +1,39 @@
+"""Seeded BCP007 violation: two spawned threads write the same
+attribute, each under a *different* lock — every write site is locked,
+but no single lock consistently guards the field, so the writes still
+race. The same pattern (run with watched locks) trips the runtime
+lockwatch sentinel via its opposite-order nested acquisitions — the
+cross-check test ties the static and runtime halves together."""
+
+import threading
+
+
+class RaceBox:
+    def __init__(self):
+        self.a_lock = threading.Lock()
+        self.b_lock = threading.Lock()
+        self.latest = 0
+        self.scratch_a = 0
+        self.scratch_b = 0
+        self._t1 = threading.Thread(target=self._writer_a, daemon=True)
+        self._t2 = threading.Thread(target=self._writer_b, daemon=True)
+
+    def start(self):
+        self._t1.start()
+        self._t2.start()
+
+    def _writer_a(self):
+        with self.a_lock:
+            self.latest = 1  # BCPLINT-EXPECT
+            with self.b_lock:
+                self.scratch_a = 1
+
+    def _writer_b(self):
+        with self.b_lock:
+            self.latest = 2
+            with self.a_lock:
+                self.scratch_b = 2
+
+    def close(self):
+        self._t1.join()
+        self._t2.join()

@@ -1,0 +1,27 @@
+"""Seeded BCP008 violations: non-GIL-atomic compound mutations of
+shared state reached from a concurrent root (executor submits) with no
+lock held — the ``+=`` read-modify-write tear and the PR 7 sigcache
+check-then-mutate interleave."""
+
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Tally:
+    def __init__(self):
+        self.pool = ThreadPoolExecutor(max_workers=4)
+        self.hits = 0
+        self.cache = {}
+
+    def bump(self):
+        self.hits += 1  # BCPLINT-EXPECT
+
+    def remember(self, key, value):
+        if key not in self.cache:
+            self.cache[key] = value  # BCPLINT-EXPECT-CHECK
+
+    def serve(self, key, value):
+        self.pool.submit(self.bump)
+        self.pool.submit(self.remember, key, value)
+
+    def close(self):
+        self.pool.shutdown(wait=True)

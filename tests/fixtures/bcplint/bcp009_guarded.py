@@ -1,0 +1,19 @@
+"""Seeded BCP009 violation: an attribute declared guarded via the
+trailing-comment convention is written without the declared lock held.
+The compliant write in ``ok`` proves the rule only fires on the
+unguarded site."""
+
+import threading
+
+
+class Ledger:
+    def __init__(self):
+        self.cs_lock = threading.Lock()
+        self.total = 0  # GUARDED_BY(cs_lock)
+
+    def ok(self):
+        with self.cs_lock:
+            self.total = 1
+
+    def sneaky(self):
+        self.total = 5  # BCPLINT-EXPECT

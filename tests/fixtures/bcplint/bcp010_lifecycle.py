@@ -1,0 +1,20 @@
+"""Seeded BCP010 violation: a thread stored on ``self`` and started,
+with no ``join()`` reachable from ``close()`` — the thread outlives its
+owner (BCP002's register/unregister pairing extended to threads)."""
+
+import threading
+
+
+class Leaky:
+    def __init__(self):
+        self._worker = threading.Thread(  # BCPLINT-EXPECT
+            target=self._run, daemon=True)
+
+    def start(self):
+        self._worker.start()
+
+    def _run(self):
+        pass
+
+    def close(self):
+        pass  # forgets self._worker.join()

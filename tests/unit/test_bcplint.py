@@ -19,8 +19,10 @@ conftest orders the ``lint`` group first for the cheapest signal.
 """
 
 import os
+import shutil
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -225,7 +227,8 @@ def test_cli_findings_exit_one(capsys):
 def test_cli_list_checks(capsys):
     assert cli_main(["--list-checks"]) == 0
     out = capsys.readouterr().out
-    for rule in ("BCP001", "BCP002", "BCP003", "BCP004", "BCP005", "BCP006"):
+    for rule in ("BCP001", "BCP002", "BCP003", "BCP004", "BCP005",
+                 "BCP006", "BCP007", "BCP008", "BCP009", "BCP010"):
         assert rule in out
 
 
@@ -236,3 +239,262 @@ def test_module_invocation_matches_console_script():
         cwd=ROOT, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "bcplint: clean" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# concurrency analysis goldens (ISSUE 18): BCP007-BCP010 + the BCP004
+# explicit-acquire blind-spot regression
+# ---------------------------------------------------------------------------
+
+
+def test_bcp004_fires_on_explicit_acquire_release_pairs():
+    """Regression for the blind spot: order edges must be minted from
+    document-order .acquire()/.release() pairs, not only ``with``."""
+    rel = "tests/fixtures/bcplint/bcp004_acquire.py"
+    f = _sole_finding(_lint_fixture("bcp004_acquire.py"), "BCP004")
+    assert f.path == rel
+    assert f.line == _expect_line(rel)
+    assert ("TwoLocksExplicit.a_lock" in f.message
+            and "TwoLocksExplicit.b_lock" in f.message)
+    assert "opposite orders" in f.message
+
+
+def test_bcp007_fires_on_no_common_lockset():
+    rel = "tests/fixtures/bcplint/bcp007_race.py"
+    result = _lint_fixture("bcp007_race.py")
+    f = _sole_finding(result, "BCP007")
+    assert f.path == rel
+    assert f.line == _expect_line(rel)
+    assert "RaceBox.latest" in f.message
+    assert "RaceBox._writer_a" in f.message
+    assert "RaceBox._writer_b" in f.message
+    assert "no common lock" in f.message
+    # every write site IS under a lock — coverage, not presence, fails;
+    # and the per-writer scratch fields (single root each) stay silent
+    assert not any("scratch" in g.message for g in result.findings)
+
+
+def test_bcp008_fires_on_compound_mutations():
+    rel = "tests/fixtures/bcplint/bcp008_compound.py"
+    result = _lint_fixture("bcp008_compound.py")
+    found = [f for f in result.findings if f.rule == "BCP008"]
+    assert len(found) == 2, [f.render() for f in result.findings]
+    by_line = {f.line: f for f in found}
+    aug = by_line[_expect_line(rel)]
+    assert "Tally.hits" in aug.message
+    assert "read-modify-write" in aug.message
+    check = by_line[_expect_line(rel, "BCPLINT-EXPECT-CHECK")]
+    assert "Tally.cache" in check.message
+    assert "check-then-mutate" in check.message
+    # de-overlap: BCP008-flagged attrs must not double-report as BCP007
+    assert not any(f.rule == "BCP007" for f in result.findings)
+
+
+def test_bcp009_fires_on_declared_guard_violation():
+    rel = "tests/fixtures/bcplint/bcp009_guarded.py"
+    result = _lint_fixture("bcp009_guarded.py")
+    f = _sole_finding(result, "BCP009")
+    assert f.path == rel
+    assert f.line == _expect_line(rel)
+    assert "Ledger.total" in f.message and "'cs_lock'" in f.message
+    assert "GUARDED_BY" in f.message
+    # the compliant write in ok() must not anchor anything
+    assert not any("Ledger.ok" in g.anchor for g in result.findings)
+
+
+def test_bcp009_subset_run_trusts_in_edge_locksets():
+    """Linting connman.py alone (the --changed shape) must not flag
+    _ban_seq: the RPC roots that reach _snapshot_banlist live in
+    rpc/net.py, outside the subset, so BCP009 falls back to the in-edge
+    locksets — setban/unban/clear_banned all call it with ban_lock held,
+    proving the caller-holds convention locally."""
+    path = os.path.join(ROOT, "bitcoincashplus_tpu", "p2p", "connman.py")
+    result = run_lint(ROOT, paths=[path])
+    assert not any(f.rule == "BCP009" for f in result.findings), \
+        [f.message for f in result.findings if f.rule == "BCP009"]
+
+
+def test_bcp010_fires_on_unjoined_thread():
+    rel = "tests/fixtures/bcplint/bcp010_lifecycle.py"
+    f = _sole_finding(_lint_fixture("bcp010_lifecycle.py"), "BCP010")
+    assert f.path == rel
+    assert f.line == _expect_line(rel)
+    assert "Leaky._worker" in f.message
+    assert "join()" in f.message and "close()" in f.message
+
+
+def test_bcp010_stays_silent_when_close_joins():
+    """The BCP007 fixture joins both threads from close() — its result
+    must contain no BCP010 (the credit side of the lifecycle rule)."""
+    result = _lint_fixture("bcp007_race.py")
+    assert not any(f.rule == "BCP010" for f in result.findings)
+
+
+# ---------------------------------------------------------------------------
+# inline suppression machinery: # BCPLINT-IGNORE[BCP00N]: <why>
+# ---------------------------------------------------------------------------
+
+_IGNORE_FIXTURE_SRC = '''\
+from concurrent.futures import ThreadPoolExecutor
+
+
+class T:
+    def __init__(self):
+        self.pool = ThreadPoolExecutor(max_workers=2)
+        self.hits = 0
+
+    def bump(self):
+        self.hits += 1  {comment}
+
+    def serve(self):
+        self.pool.submit(self.bump)
+
+    def close(self):
+        self.pool.shutdown(wait=True)  {stale}
+'''
+
+
+def _ignore_fixture(tmp_path, comment="", stale=""):
+    f = tmp_path / "mod.py"
+    f.write_text(_IGNORE_FIXTURE_SRC.format(comment=comment, stale=stale))
+    return str(f)
+
+
+def test_justified_inline_ignore_suppresses_finding(tmp_path):
+    path = _ignore_fixture(
+        tmp_path, comment="# BCPLINT-IGNORE[BCP008]: single-writer pool")
+    result = run_lint(str(tmp_path), paths=[path])
+    assert result.ok, [f.render() for f in result.findings]
+    assert len(result.ignored) == 1
+    assert result.ignored[0].rule == "BCP008"
+
+
+def test_unjustified_inline_ignore_is_a_hard_failure(tmp_path):
+    path = _ignore_fixture(tmp_path, comment="# BCPLINT-IGNORE[BCP008]")
+    result = run_lint(str(tmp_path), paths=[path])
+    assert not result.ok
+    assert result.unjustified_ignores == ["mod.py:10 BCP008"]
+    # the finding itself survives — an unjustified IGNORE hides nothing
+    assert any(f.rule == "BCP008" for f in result.findings)
+
+
+def test_stale_inline_ignore_is_a_failure(tmp_path):
+    path = _ignore_fixture(
+        tmp_path, comment="# BCPLINT-IGNORE[BCP008]: single-writer pool",
+        stale="# BCPLINT-IGNORE[BCP003]: never fires here")
+    result = run_lint(str(tmp_path), paths=[path])
+    assert not result.ok
+    assert result.stale_ignores == ["mod.py:16 BCP003"]
+
+
+def test_stale_inline_ignore_tolerated_in_partial_runs(tmp_path):
+    """--changed subset runs legitimately miss cross-module findings, so
+    staleness proves nothing there (same contract as baseline entries)."""
+    path = _ignore_fixture(
+        tmp_path, comment="# BCPLINT-IGNORE[BCP008]: single-writer pool",
+        stale="# BCPLINT-IGNORE[BCP003]: never fires here")
+    result = run_lint(str(tmp_path), paths=[path], partial=True)
+    assert result.ok
+    assert not result.stale_ignores
+
+
+def test_docstring_mention_of_ignore_syntax_is_not_a_suppression(tmp_path):
+    """Only real COMMENT tokens register — the engine's own docstring
+    quotes the syntax and must not create stale entries."""
+    f = tmp_path / "mod.py"
+    f.write_text('"""Example:\n\n    x += 1  '
+                 '# BCPLINT-IGNORE[BCP008]: quoted\n"""\nX = 1\n')
+    result = run_lint(str(tmp_path), paths=[str(f)])
+    assert result.ok
+    assert not result.stale_ignores
+
+
+# ---------------------------------------------------------------------------
+# --changed incremental mode
+# ---------------------------------------------------------------------------
+
+
+def _git(cwd, *args):
+    subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t"] + list(args),
+        cwd=cwd, check=True, capture_output=True, timeout=60)
+
+
+@pytest.fixture
+def tiny_repo(tmp_path):
+    pkg = tmp_path / "bitcoincashplus_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "clean.py").write_text("X = 1\n")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-q", "-m", "seed")
+    return tmp_path
+
+
+def test_cli_changed_lints_only_touched_files(tiny_repo, capsys):
+    shutil.copy(os.path.join(FIXTURES, "bcp004_acquire.py"),
+                tiny_repo / "bitcoincashplus_tpu" / "bad.py")
+    rc = cli_main(["--root", str(tiny_repo), "--changed", "HEAD",
+                   "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "BCP004" in out and "bad.py" in out
+    assert "clean.py" not in out
+
+
+def test_cli_changed_with_no_changes_exits_zero(tiny_repo, capsys):
+    rc = cli_main(["--root", str(tiny_repo), "--changed", "HEAD"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "no linted .py files changed" in out
+
+
+def test_cli_changed_and_paths_are_exclusive(capsys):
+    rc = cli_main(["--root", ROOT, "--changed", "HEAD",
+                   os.path.join(FIXTURES, "bcp004_acquire.py")])
+    assert rc == 2
+    assert "exclusive" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# the concurrency report is a checked-in, regenerable artifact
+# ---------------------------------------------------------------------------
+
+
+def test_concurrency_report_regenerates_byte_identically():
+    from tools.bcplint.race import build_report
+
+    with open(os.path.join(ROOT, "docs", "CONCURRENCY.md"),
+              encoding="utf-8") as f:
+        committed = f.read()
+    assert build_report(ROOT) == committed, (
+        "docs/CONCURRENCY.md is stale — regenerate with "
+        "`python -m tools.bcplint.cli --concurrency-report > "
+        "docs/CONCURRENCY.md`")
+
+
+def test_concurrency_report_names_known_roots():
+    from tools.bcplint.race import build_report
+
+    report = build_report(ROOT)
+    for root_name in ("CConnman._run", "ReplicaPool._probe_loop",
+                      "SigService._run", "Watchdog._tick_loop"):
+        assert root_name in report, root_name
+    assert "## Guarded state" in report
+    assert "CConnman._banned" in report
+
+
+# ---------------------------------------------------------------------------
+# tier-1 wall budget: the lint stage must never eat the 870 s cap
+# ---------------------------------------------------------------------------
+
+
+def test_full_tree_run_under_wall_budget():
+    t0 = time.monotonic()
+    result = run_lint(ROOT, baseline_path=DEFAULT_BASELINE)
+    elapsed = time.monotonic() - t0
+    assert result.ok
+    assert elapsed < 10.0, (
+        "full-tree bcplint took %.1fs — the 10s budget keeps the "
+        "conftest-ordered lint group a cheap first signal" % elapsed)

@@ -217,6 +217,59 @@ def test_snapshot_shape_matches_gettpuinfo_contract():
     snap = lockwatch.snapshot()
     assert snap["enabled"] is True
     for key in ("locks", "acquisitions", "acquisitions_total",
-                "max_depth", "order_edges", "inversions", "cycles"):
+                "max_depth", "order_edges", "inversions", "cycles",
+                "declared_guards"):
         assert key in snap, key
     assert "contract" in snap["locks"]
+
+
+# ---------------------------------------------------------------------------
+# GUARDED_BY vocabulary (bcplint BCP009 <-> runtime agreement)
+# ---------------------------------------------------------------------------
+
+
+def test_declared_guards_surface_in_snapshot():
+    """Classes adopting the static ``GUARDED_BY`` annotation publish the
+    same vocabulary to the runtime sentinel, so gettpuinfo.lockwatch and
+    docs/CONCURRENCY.md name the same locks as declared guards."""
+    lockwatch.declare_guards("ban_lock", ["_banned", "_ban_seq"])
+    lockwatch.declare_guards("ban_lock", ["_banned"])  # idempotent merge
+    lockwatch.declare_guards("ban_io_lock", ["_ban_saved_seq"])
+    snap = lockwatch.snapshot()
+    assert snap["declared_guards"] == {
+        "ban_io_lock": ["_ban_saved_seq"],
+        "ban_lock": ["_ban_seq", "_banned"],
+    }
+    MONITOR.reset()
+    assert lockwatch.snapshot()["declared_guards"] == {}
+
+
+def test_bcp007_fixture_pattern_trips_runtime_sentinel():
+    """The seeded BCP007 fixture (tests/fixtures/bcplint/bcp007_race.py)
+    pairs its no-common-lock writes with opposite-order nested
+    acquisitions. Executed with watched locks — writers serialized so
+    the schedule cannot actually deadlock — the runtime monitor still
+    reports the inversion: the static finding and the runtime sentinel
+    flag the same pattern."""
+    a = watched_lock("race_a")
+    b = watched_lock("race_b")
+    box = {"latest": 0}
+
+    def writer_a():
+        with a:
+            box["latest"] = 1
+            with b:
+                pass
+
+    def writer_b():
+        with b:
+            box["latest"] = 2
+            with a:
+                pass
+
+    _run_threads(writer_a)   # serialized on purpose: the order graph
+    _run_threads(writer_b)   # has the cycle even when the timeline can't
+    cycles = MONITOR.cycles()
+    assert cycles, "runtime sentinel missed the fixture pattern"
+    assert {"race_a", "race_b"} <= set(cycles[0]["locks"])
+    assert lockwatch.snapshot()["inversions"] >= 1

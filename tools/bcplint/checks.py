@@ -550,6 +550,18 @@ class LockOrder(Check):
         for _qual, func, cls in iter_funcs(mod.tree):
             self._scan(mod, cls, func.body, held=[])
 
+    def _explicit_pair(self, stmt) -> tuple[str, ast.expr] | None:
+        """``lock.acquire()`` / ``lock.release()`` as a bare statement
+        -> ("acquire"|"release", lock_expr)."""
+        if not (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)):
+            return None
+        term = stmt.value.func.attr
+        if term not in ("acquire", "release"):
+            return None
+        return term, stmt.value.func.value
+
     def _scan(self, mod, cls, stmts, held) -> None:
         for stmt in stmts:
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -567,7 +579,26 @@ class LockOrder(Check):
                     pushed.append(n)
                 self._scan(mod, cls, stmt.body, held)
                 for n in pushed:
-                    held.remove(n)
+                    if n in held:  # an explicit release() may have
+                        held.remove(n)  # dropped it inside the block
+                continue
+            # explicit .acquire()/.release() document-order pairs mint
+            # the same edges as nested with blocks (the gateway/banlist
+            # idiom BCP004 was blind to)
+            pair = self._explicit_pair(stmt)
+            if pair is not None:
+                term, lock_expr = pair
+                n = self._lock_name(lock_expr, cls)
+                if n:
+                    if term == "acquire":
+                        for h in held:
+                            if h != n and (h, n) not in self.edges:
+                                self.edges[(h, n)] = (mod.path,
+                                                      stmt.lineno)
+                        if n not in held:
+                            held.append(n)
+                    elif n in held:
+                        held.remove(n)
                 continue
             for field in ("body", "orelse", "finalbody"):
                 sub = getattr(stmt, field, None)
@@ -808,8 +839,19 @@ ALL_CHECKS = [TelemetryNamespace, RegisterPairing, BlockingUnderCsMain,
               LockOrder, FaultSiteParity, JitHygiene]
 
 
+def all_checks():
+    """The full catalog including the concurrency analysis (race.py
+    imports the helpers above, so its import is deferred here to keep
+    the module graph acyclic)."""
+    from .race import ConcurrencyAnalysis
+
+    return ALL_CHECKS + [ConcurrencyAnalysis]
+
+
 def check_by_rule(rule: str):
-    for c in ALL_CHECKS:
+    for c in all_checks():
         if c.rule == rule:
+            return c
+        if any(r == rule for r, _ in getattr(c, "catalog", ())):
             return c
     raise KeyError(rule)

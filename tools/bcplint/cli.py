@@ -5,7 +5,9 @@ Usage::
     bcplint                      # lint the repo tree with the baseline
     bcplint pkg/mod.py           # lint specific files/dirs
     bcplint --no-baseline        # raw findings, baseline ignored
+    bcplint --changed HEAD~1     # only files touched since a git ref
     bcplint --list-checks        # the check catalog
+    bcplint --concurrency-report # docs/CONCURRENCY.md content to stdout
 
 Exit status: 0 clean, 1 findings (or stale/unjustified baseline
 entries), 2 usage error.
@@ -15,9 +17,10 @@ from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 
-from .checks import ALL_CHECKS
+from .checks import all_checks
 from .engine import render_report, run_lint
 
 DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline")
@@ -41,10 +44,39 @@ def _find_root(start: str) -> str:
     return os.path.abspath(start)
 
 
+def _changed_paths(root: str, ref: str) -> list[str] | None:
+    """Repo-relative .py files under the linted trees touched since
+    ``ref`` (committed diff + untracked), or None on git failure."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--"], cwd=root,
+            capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if diff.returncode != 0:
+        return None
+    names = set(diff.stdout.splitlines())
+    if untracked.returncode == 0:
+        names |= set(untracked.stdout.splitlines())
+    out = []
+    for name in sorted(names):
+        if not name.endswith(".py"):
+            continue
+        if not name.startswith(("bitcoincashplus_tpu/", "tools/")):
+            continue
+        abspath = os.path.join(root, name)
+        if os.path.isfile(abspath):
+            out.append(abspath)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="bcplint",
-        description="project-invariant static analysis (BCP001-BCP006)")
+        description="project-invariant static analysis (BCP001-BCP010)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: the package + tools)")
     ap.add_argument("--root", default=None,
@@ -55,20 +87,53 @@ def main(argv=None) -> int:
                     help="report raw findings, ignore the baseline")
     ap.add_argument("--tests-dir", default=None,
                     help="tests tree for BCP005 parity (default: <root>/tests)")
+    ap.add_argument("--changed", metavar="GIT_REF", default=None,
+                    help="lint only .py files changed since GIT_REF "
+                         "(fast local mode; staleness checks skipped)")
+    ap.add_argument("--concurrency-report", action="store_true",
+                    help="print the generated concurrency model "
+                         "(docs/CONCURRENCY.md content) and exit")
     ap.add_argument("--list-checks", action="store_true")
     args = ap.parse_args(argv)
 
     if args.list_checks:
-        for c in ALL_CHECKS:
-            print("%s  %s" % (c.rule, c.title))
+        for c in all_checks():
+            for rule, title in getattr(c, "catalog", None) or [
+                    (c.rule, c.title)]:
+                print("%s  %s" % (rule, title))
         return 0
 
     root = args.root or _find_root(os.getcwd())
+
+    if args.concurrency_report:
+        from .race import build_report
+
+        sys.stdout.write(build_report(root))
+        return 0
+
+    partial = False
     paths = [os.path.abspath(p) for p in args.paths] or None
+    if args.changed is not None:
+        if paths is not None:
+            print("bcplint: --changed and explicit paths are exclusive",
+                  file=sys.stderr)
+            return 2
+        changed = _changed_paths(root, args.changed)
+        if changed is None:
+            print("bcplint: git diff against %r failed" % args.changed,
+                  file=sys.stderr)
+            return 2
+        if not changed:
+            print("bcplint: no linted .py files changed since %s"
+                  % args.changed)
+            return 0
+        paths = changed
+        partial = True
+
     result = run_lint(
         root, paths=paths,
         baseline_path=None if args.no_baseline else args.baseline,
-        tests_dir=args.tests_dir)
+        tests_dir=args.tests_dir, partial=partial)
     print(render_report(result))
     return 0 if result.ok else 1
 

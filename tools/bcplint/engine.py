@@ -11,13 +11,28 @@ Baseline format (one entry per line)::
 Every entry MUST carry a justification after `` # `` — an unjustified
 entry is itself a lint failure, as is a stale entry that no longer
 matches any finding (so the baseline can only shrink honestly).
+
+Inline suppression mirrors the same contract at the line level::
+
+    self.hits += 1  # BCPLINT-IGNORE[BCP008]: single-writer by design
+
+An IGNORE with no justification is a failure, and an IGNORE on a line
+that no longer triggers its rule is stale — also a failure (except in
+``partial`` runs over a file subset, where cross-module findings are
+legitimately absent).
 """
 
 from __future__ import annotations
 
 import ast
+import io
 import os
+import re
+import tokenize
 from dataclasses import dataclass, field
+
+_IGNORE_RE = re.compile(
+    r"#\s*BCPLINT-IGNORE\[(BCP\d{3})\]\s*(?::\s*(\S.*?))?\s*$")
 
 
 @dataclass(frozen=True)
@@ -46,6 +61,21 @@ class Module:
         with open(abspath, "rb") as f:
             self.source = f.read().decode("utf-8", "replace")
         self.tree = ast.parse(self.source, filename=self.path)
+        # inline suppressions: (rule, line) -> justification-or-None.
+        # Extracted from real COMMENT tokens, so the syntax can be
+        # quoted in docstrings without registering a suppression.
+        self.ignores: dict[tuple[str, int], str | None] = {}
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _IGNORE_RE.match(tok.string)
+                if m:
+                    self.ignores[(m.group(1), tok.start[0])] = m.group(2)
+        except (tokenize.TokenError, IndentationError):
+            pass
 
 
 @dataclass
@@ -54,12 +84,16 @@ class LintResult:
     baselined: list = field(default_factory=list)     # suppressed Findings
     stale_entries: list = field(default_factory=list)      # baseline keys
     unjustified_entries: list = field(default_factory=list)
+    ignored: list = field(default_factory=list)       # inline-suppressed
+    stale_ignores: list = field(default_factory=list)      # "path:line RULE"
+    unjustified_ignores: list = field(default_factory=list)
     errors: list = field(default_factory=list)        # (path, message)
 
     @property
     def ok(self) -> bool:
         return not (self.findings or self.stale_entries
-                    or self.unjustified_entries or self.errors)
+                    or self.unjustified_entries or self.stale_ignores
+                    or self.unjustified_ignores or self.errors)
 
 
 def parse_baseline(path: str):
@@ -94,10 +128,13 @@ def iter_py_files(paths):
 
 
 def run_lint(root: str, paths=None, checks=None, baseline_path=None,
-             tests_dir=None) -> LintResult:
+             tests_dir=None, partial=False) -> LintResult:
     """Drive ``checks`` over every .py file under ``paths`` (default: the
-    package and tools trees under ``root``), then apply the baseline."""
-    from .checks import ALL_CHECKS
+    package and tools trees under ``root``), apply inline IGNOREs, then
+    the baseline. ``partial=True`` (the --changed mode) skips staleness
+    enforcement: a subset run legitimately misses cross-module findings,
+    so absent matches prove nothing."""
+    from .checks import all_checks
 
     root = os.path.abspath(root)
     if paths is None:
@@ -108,10 +145,11 @@ def run_lint(root: str, paths=None, checks=None, baseline_path=None,
         tests_dir = cand if os.path.isdir(cand) else None
 
     result = LintResult()
-    check_classes = checks if checks is not None else ALL_CHECKS
+    check_classes = checks if checks is not None else all_checks()
     instances = [c() for c in check_classes]
     ctx = {"root": root, "tests_dir": tests_dir}
 
+    ignores: dict[str, dict] = {}  # path -> {(rule, line): just|None}
     for abspath in iter_py_files(paths):
         try:
             mod = Module(root, abspath)
@@ -119,6 +157,8 @@ def run_lint(root: str, paths=None, checks=None, baseline_path=None,
             result.errors.append(
                 (os.path.relpath(abspath, root), "syntax error: %s" % e))
             continue
+        if mod.ignores:
+            ignores[mod.path] = mod.ignores
         for check in instances:
             check.collect(mod)
 
@@ -126,6 +166,31 @@ def run_lint(root: str, paths=None, checks=None, baseline_path=None,
     for check in instances:
         findings.extend(check.finalize(ctx))
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.anchor))
+
+    # inline suppressions run first: they match by (path, rule, line)
+    matched_ig: set[tuple[str, str, int]] = set()
+    hard_findings: list[Finding] = []  # bypass the baseline
+    kept: list[Finding] = []
+    for f in findings:
+        just = ignores.get(f.path, {}).get((f.rule, f.line), "absent")
+        if just == "absent":
+            kept.append(f)
+            continue
+        matched_ig.add((f.path, f.rule, f.line))
+        if just is None:
+            result.unjustified_ignores.append(
+                "%s:%d %s" % (f.path, f.line, f.rule))
+            hard_findings.append(f)
+        else:
+            result.ignored.append(f)
+    findings = kept
+    if not partial:
+        for path in sorted(ignores):
+            for (rule, line), _just in sorted(ignores[path].items(),
+                                              key=lambda kv: kv[0][1]):
+                if (path, rule, line) not in matched_ig:
+                    result.stale_ignores.append(
+                        "%s:%d %s" % (path, line, rule))
 
     if baseline_path and os.path.exists(baseline_path):
         entries = parse_baseline(baseline_path)
@@ -140,11 +205,13 @@ def run_lint(root: str, paths=None, checks=None, baseline_path=None,
                     result.baselined.append(f)
             else:
                 result.findings.append(f)
-        result.stale_entries.extend(
-            k for k in entries if k not in matched)
+        if not partial:
+            result.stale_entries.extend(
+                k for k in entries if k not in matched)
     else:
         result.findings = findings
 
+    result.findings.extend(hard_findings)
     return result
 
 
@@ -158,11 +225,20 @@ def render_report(result: LintResult) -> str:
         out.append("baseline entry lacks a justification: %s" % key)
     for key in result.stale_entries:
         out.append("stale baseline entry (no matching finding): %s" % key)
+    for key in result.unjustified_ignores:
+        out.append("inline IGNORE lacks a justification: %s" % key)
+    for key in result.stale_ignores:
+        out.append("stale inline IGNORE (line no longer triggers): %s"
+                   % key)
     if result.ok:
-        out.append("bcplint: clean (%d baselined finding(s) justified)"
-                   % len(result.baselined))
+        out.append("bcplint: clean (%d baselined, %d inline-ignored "
+                   "finding(s) justified)"
+                   % (len(result.baselined), len(result.ignored)))
     else:
         out.append("bcplint: %d finding(s), %d stale, %d unjustified"
-                   % (len(result.findings), len(result.stale_entries),
-                      len(result.unjustified_entries)))
+                   % (len(result.findings),
+                      len(result.stale_entries)
+                      + len(result.stale_ignores),
+                      len(result.unjustified_entries)
+                      + len(result.unjustified_ignores)))
     return "\n".join(out)

@@ -1,0 +1,1129 @@
+"""bcplint concurrency analysis: BCP007-BCP010 and the concurrency report.
+
+Static lockset/race analysis over the threaded fleet. Three layers:
+
+1. **Thread-root discovery** — ``threading.Thread(target=...)`` /
+   ``Timer`` spawns, ``ThreadPoolExecutor.submit`` targets,
+   ``ThreadingHTTPServer`` handler classes (``do_*``/``handle`` methods
+   of ``BaseRequestHandler`` subclasses), and RPC dispatch entries
+   (``@rpc_method`` handlers, which ``rpc/server.execute`` wraps in
+   ``cs_main`` unless the handler sets ``no_cs_main``).
+2. **Lockset inference** — every ``self.<attr>`` write/probe site gets
+   the set of statically-held locks, tracked in document order through
+   nested ``with`` blocks AND explicit ``.acquire()``/``.release()``
+   pairs (the BCP003 held-region discipline generalized to all
+   lock-shaped names).
+3. **Per-root BFS** over a shallow typed call graph (param/return
+   annotations, ``self.attr`` types from ``__init__``, container
+   element types), carrying held-lockset states, attributing every
+   write site to the roots that can reach it.
+
+Rules:
+
+- **BCP007** — shared attribute written from >=2 thread roots with an
+  empty common lockset (no single lock consistently guards it).
+- **BCP008** — compound non-GIL-atomic mutation (``x += 1``,
+  check-then-mutate probe+mutation sequences — the PR 7 sigcache
+  ``move_to_end``/evict lesson) on shared state outside any lock.
+- **BCP009** — violation of a declared guard: the ``GUARDED_BY``
+  convention (class-level ``GUARDED_BY = {"attr": "lock"}`` dict or a
+  trailing ``# GUARDED_BY(lock)`` comment on the ``__init__`` assign)
+  documents intent; this rule machine-enforces it at every write site.
+- **BCP010** — a started thread/timer/executor stored on ``self`` with
+  no ``join()``/``shutdown()``/``cancel()`` reachable from
+  ``close()``/``stop()``/``__exit__`` (BCP002's pairing discipline
+  extended from collectors to threads).
+
+Everything unresolvable errs toward silence, same contract as the rest
+of bcplint: a race lint that cries wolf gets baselined wholesale and
+dies. The same model renders ``--concurrency-report``
+(docs/CONCURRENCY.md): thread roots -> reached functions -> guarded
+fields, so the concurrency model is a reviewable artifact.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .engine import Finding, Module, iter_py_files
+from .checks import (Check, _GLOBAL_LOCKS, _LOCKISH_RE, attr_parts,
+                     call_terminal, const_str)
+
+# methods whose call mutates the receiver in a way that composes with a
+# preceding membership/get probe into a non-atomic compound sequence
+_MUTATORS = {"append", "appendleft", "add", "pop", "popitem", "popleft",
+             "remove", "discard", "clear", "update", "extend",
+             "move_to_end", "setdefault", "insert"}
+_PROBERS = {"get", "keys", "items", "values", "index", "count"}
+_JOINERS = {"join", "shutdown", "cancel"}
+# cross-thread marshaling: work handed to these runs on the event loop
+# thread, never the caller's (call_soon/create_task stay attributed —
+# same-thread scheduling)
+_MARSHALERS = {"call_soon_threadsafe", "run_coroutine_threadsafe"}
+_CLOSE_PREFIXES = ("close", "stop")
+_CLOSEISH = {"close", "stop", "__exit__", "shutdown"}
+_HANDLER_BASES = {"BaseHTTPRequestHandler", "SimpleHTTPRequestHandler",
+                  "StreamRequestHandler", "DatagramRequestHandler",
+                  "BaseRequestHandler"}
+_CONTAINERS = {"Sequence", "List", "list", "Iterable", "Tuple", "tuple",
+               "set", "Set", "frozenset", "deque"}
+_GUARD_COMMENT_RE = re.compile(r"#\s*GUARDED_BY\(([A-Za-z_][\w.]*)\)")
+
+
+def _norm_lock(name: str) -> str:
+    """Comparison form of a lock name: last dotted segment, leading
+    underscores stripped — so a declared ``GUARDED_BY("ban_lock")``
+    matches the observed ``CConnman._ban_lock``."""
+    return name.split(".")[-1].lstrip("_")
+
+
+def ann_type(ann) -> tuple[str | None, str | None]:
+    """(scalar_type, element_type) names from an annotation node.
+    ``Optional[X]`` -> X; ``Sequence[X]`` -> (None, X); single-typed
+    ``Union`` unwrapped; string annotations parsed. None when opaque."""
+    if ann is None:
+        return (None, None)
+    s = const_str(ann)
+    if s is not None:
+        try:
+            ann = ast.parse(s, mode="eval").body
+        except SyntaxError:
+            return (None, None)
+    if isinstance(ann, ast.Name):
+        return (ann.id, None)
+    if isinstance(ann, ast.Attribute):
+        return (ann.attr, None)
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        sides = [ann_type(ann.left), ann_type(ann.right)]
+        real = [t for t in sides if t[0] not in (None, "None")]
+        return real[0] if len(real) == 1 else (None, None)
+    if isinstance(ann, ast.Subscript):
+        base = ann.value
+        bname = (base.id if isinstance(base, ast.Name)
+                 else base.attr if isinstance(base, ast.Attribute) else None)
+        sl = ann.slice
+        if bname == "Optional":
+            return ann_type(sl)
+        if bname == "Union" and isinstance(sl, ast.Tuple):
+            real = [t for t in (ann_type(e) for e in sl.elts)
+                    if t[0] not in (None, "None")]
+            return real[0] if len(real) == 1 else (None, None)
+        if bname in _CONTAINERS:
+            elt = sl.elts[0] if isinstance(sl, ast.Tuple) and sl.elts else sl
+            return (None, ann_type(elt)[0])
+    return (None, None)
+
+
+def _param_env(func) -> dict[str, tuple[str | None, str | None]]:
+    env = {}
+    for a in list(func.args.posonlyargs) + list(func.args.args) + list(
+            func.args.kwonlyargs):
+        t = ann_type(a.annotation)
+        if t != (None, None):
+            env[a.arg] = t
+    return env
+
+
+class ClassInfo:
+    def __init__(self, mod: Module, node: ast.ClassDef, env):
+        self.path = mod.path
+        self.name = node.name
+        self.node = node
+        self.env = env  # closure: enclosing-function param types
+        self.bases = [p[-1] for p in (attr_parts(b) for b in node.bases)
+                      if p]
+        self.methods = {n.name: n for n in node.body
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))}
+        self.attr_types: dict[str, str] = {}
+        self.attr_elems: dict[str, str] = {}
+        self.guards: dict[str, str] = {}     # attr -> declared lock
+        self.guard_lines: dict[str, int] = {}
+        self._collect_guards(mod)
+
+    def _collect_guards(self, mod: Module) -> None:
+        # class-level dict convention: GUARDED_BY = {"attr": "lock"}
+        for stmt in self.node.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "GUARDED_BY"
+                    and isinstance(stmt.value, ast.Dict)):
+                for k, v in zip(stmt.value.keys, stmt.value.values):
+                    ks, vs = const_str(k), const_str(v)
+                    if ks and vs:
+                        self.guards[ks] = vs
+                        self.guard_lines[ks] = stmt.lineno
+        # trailing-comment convention on __init__ assigns:
+        #     self.attr = ...  # GUARDED_BY(lock)
+        init = self.methods.get("__init__")
+        if init is None:
+            return
+        lines = mod.source.splitlines()
+        for stmt in ast.walk(init):
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for t in targets:
+                parts = attr_parts(t)
+                if not (parts and len(parts) == 2 and parts[0] == "self"):
+                    continue
+                if 1 <= stmt.lineno <= len(lines):
+                    m = _GUARD_COMMENT_RE.search(lines[stmt.lineno - 1])
+                    if m:
+                        self.guards.setdefault(parts[1], m.group(1))
+                        self.guard_lines.setdefault(parts[1], stmt.lineno)
+
+
+class FuncFacts:
+    """Per-function facts, locksets relative to function entry."""
+
+    def __init__(self, fid, qual, path):
+        self.fid = fid          # (class_name | None, func_name)
+        self.qual = qual        # "Class.meth" | "func"
+        self.path = path
+        self.writes = []        # (attr "T.a", kind, frozenset, line)
+        self.probes = []        # (attr "T.a", frozenset, line)
+        self.calls = []         # (callee fid, frozenset, line)
+        self.spawns = []        # (bound_attr|None, target fid|None,
+                                #  line, kind thread|timer|executor)
+        self.starts = set()     # self attrs .start()ed
+        self.joins = set()      # self attrs joined/shutdown/cancelled
+        self.submits = []       # (target fid, line)
+
+
+class Root:
+    def __init__(self, fid, kind, concurrent, init_locks, path):
+        self.fid = fid
+        self.kind = kind
+        self.concurrent = concurrent
+        self.init_locks = frozenset(init_locks)
+        self.path = path
+
+    @property
+    def name(self) -> str:
+        cls, fn = self.fid
+        return "%s.%s" % (cls, fn) if cls else fn
+
+
+class Model:
+    """The whole-tree concurrency model: classes, typed call facts,
+    thread roots, and the per-root lockset reachability that the
+    BCP007-BCP010 rules and the --concurrency-report both consume."""
+
+    def __init__(self, mods):
+        self.mods = mods
+        self.all_classes: list[ClassInfo] = []
+        self.classes: dict[str, ClassInfo] = {}  # unique names only
+        self.by_cid: dict[str, ClassInfo] = {}
+        self.modfuncs: dict[str, tuple[Module, ast.AST]] = {}
+        self.rpc_funcs: dict[str, bool] = {}  # fname -> no_cs_main
+        self.facts: dict[tuple, FuncFacts] = {}
+        self.roots: dict[tuple, Root] = {}
+        # BFS output
+        self.reached: dict[str, set[str]] = {}     # root name -> quals
+        self.attr_writes: dict[str, list] = {}     # attr -> site dicts
+        self.attr_probes: dict[str, list] = {}
+        self._built = False
+
+    # -- pass 1: index classes + module functions -----------------------
+
+    def _index(self) -> None:
+        amb_funcs: set[str] = set()
+        for mod in self.mods:
+            self._index_node(mod, mod.tree, {}, top=True,
+                             amb_funcs=amb_funcs)
+        # same-named classes stay structurally analyzable under a
+        # path-qualified id, but NAME-based type resolution only trusts
+        # unique names (anything else errs toward silence)
+        counts: dict[str, int] = {}
+        for ci in self.all_classes:
+            counts[ci.name] = counts.get(ci.name, 0) + 1
+        for ci in self.all_classes:
+            ci.cid = (ci.name if counts[ci.name] == 1
+                      else "%s@%s" % (ci.name, ci.path))
+            self.by_cid[ci.cid] = ci
+            if counts[ci.name] == 1:
+                self.classes[ci.name] = ci
+        for name in amb_funcs:
+            self.modfuncs.pop(name, None)
+            self.rpc_funcs.pop(name, None)
+
+    def _index_node(self, mod, node, env, top, amb_funcs) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                self.all_classes.append(ClassInfo(mod, child, env))
+                self._index_node(mod, child, env, False, amb_funcs)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if top and not isinstance(node, ast.ClassDef):
+                    if child.name in self.modfuncs:
+                        amb_funcs.add(child.name)
+                    else:
+                        self.modfuncs[child.name] = (mod, child)
+                        if self._is_rpc(child):
+                            self.rpc_funcs[child.name] = False
+                env2 = dict(env)
+                env2.update(_param_env(child))
+                self._index_node(mod, child, env2, False, amb_funcs)
+            else:
+                self._index_node(mod, child, env, top, amb_funcs)
+        if isinstance(node, ast.Module):
+            # fn.no_cs_main = True module-level assigns
+            for child in node.body:
+                if (isinstance(child, ast.Assign)
+                        and len(child.targets) == 1):
+                    p = attr_parts(child.targets[0])
+                    if (p and len(p) == 2 and p[1] == "no_cs_main"
+                            and p[0] in self.rpc_funcs
+                            and isinstance(child.value, ast.Constant)
+                            and child.value.value is True):
+                        self.rpc_funcs[p[0]] = True
+
+    def _class(self, t):
+        if not t:
+            return None
+        return self.by_cid.get(t) or self.classes.get(t)
+
+    @staticmethod
+    def _is_rpc(func) -> bool:
+        for dec in func.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            parts = attr_parts(target) or []
+            if parts and parts[-1] == "rpc_method":
+                return True
+        return False
+
+    # -- pass 2: attr types from __init__ -------------------------------
+
+    def _type_attrs(self) -> None:
+        for ci in self.all_classes:
+            init = ci.methods.get("__init__")
+            if init is None:
+                continue
+            env = dict(ci.env)
+            env.update(_param_env(init))
+            for stmt in ast.walk(init):
+                if isinstance(stmt, ast.AnnAssign):
+                    parts = attr_parts(stmt.target)
+                    if parts and len(parts) == 2 and parts[0] == "self":
+                        t, e = ann_type(stmt.annotation)
+                        if t and t in self.classes:
+                            ci.attr_types.setdefault(parts[1], t)
+                        if e and e in self.classes:
+                            ci.attr_elems.setdefault(parts[1], e)
+                    continue
+                if not (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1):
+                    continue
+                parts = attr_parts(stmt.targets[0])
+                if not (parts and len(parts) == 2 and parts[0] == "self"):
+                    continue
+                t, e = self._static_type(stmt.value, env)
+                if t and t in self.classes:
+                    ci.attr_types.setdefault(parts[1], t)
+                if e and e in self.classes:
+                    ci.attr_elems.setdefault(parts[1], e)
+                # executors are lifecycle-tracked even though the class
+                # is stdlib (not in self.classes)
+                if t == "ThreadPoolExecutor":
+                    ci.attr_types.setdefault(parts[1], t)
+        # late construction ("self.x = None, set by start()") is the
+        # dominant lifecycle idiom: a direct ClassName(...) assign in
+        # any other method types the attr too (__init__ typed it first
+        # above, so a conflicting late rebind never overrides it)
+        for ci in self.all_classes:
+            for mname, mnode in ci.methods.items():
+                if mname == "__init__":
+                    continue
+                for stmt in ast.walk(mnode):
+                    if not (isinstance(stmt, ast.Assign)
+                            and len(stmt.targets) == 1
+                            and isinstance(stmt.value, ast.Call)):
+                        continue
+                    parts = attr_parts(stmt.targets[0])
+                    if not (parts and len(parts) == 2
+                            and parts[0] == "self"):
+                        continue
+                    term = call_terminal(stmt.value)
+                    if term and (term in self.classes
+                                 or term == "ThreadPoolExecutor"):
+                        ci.attr_types.setdefault(parts[1], term)
+
+    def _static_type(self, expr, env):
+        """Shallow (type, elem) of an __init__ rvalue: a typed param, a
+        ClassName(...) construction, or list/tuple/sorted(param)."""
+        if isinstance(expr, ast.Name):
+            return env.get(expr.id, (None, None))
+        if isinstance(expr, ast.Call):
+            term = call_terminal(expr)
+            if term in ("list", "tuple", "sorted") and expr.args:
+                inner = expr.args[0]
+                if isinstance(inner, ast.Name):
+                    return (None, env.get(inner.id, (None, None))[1])
+                return (None, None)
+            if term and (term in self.classes
+                         or term == "ThreadPoolExecutor"):
+                return (term, None)
+        return (None, None)
+
+    # -- pass 3: per-function fact extraction ---------------------------
+
+    def _scan_all(self) -> None:
+        for ci in self.all_classes:
+            for mname, mnode in ci.methods.items():
+                env = dict(ci.env)
+                env.update(_param_env(mnode))
+                fid = (ci.cid, mname)
+                self.facts[fid] = self._scan_func(
+                    fid, "%s.%s" % (ci.name, mname), ci.path, mnode, ci,
+                    env)
+        for fname, (mod, fnode) in self.modfuncs.items():
+            env = _param_env(fnode)
+            if fname in self.rpc_funcs:
+                # project convention (rpc/server.execute): handler
+                # param0 is the Node instance, usually unannotated
+                args = fnode.args.posonlyargs + fnode.args.args
+                if args and args[0].arg not in env and "Node" in self.classes:
+                    env[args[0].arg] = ("Node", None)
+            fid = (None, fname)
+            self.facts[fid] = self._scan_func(
+                fid, fname, mod.path, fnode, None, env)
+
+    def _scan_func(self, fid, qual, path, func, ci, env) -> FuncFacts:
+        facts = FuncFacts(fid, qual, path)
+        locals_t: dict[str, tuple] = {}   # name -> (type, elem)
+        binds: dict[str, str] = {}        # name -> self attr (threads)
+        owned: set[str] = set()  # locally-constructed => thread-private
+        held: list[str] = []
+
+        def lookup(name):
+            return locals_t.get(name) or env.get(name) or (None, None)
+
+        def is_owned(parts) -> bool:
+            """Receiver rooted at an object this function constructed:
+            thread-confined until published, so its state is not shared
+            and calls through it are not attributed (the shadow-
+            chainstate pattern — instance aliasing would otherwise
+            charge the private copy's writes to the shared one)."""
+            return bool(parts) and parts[0] in owned
+
+        def chain_type(parts):
+            """Type name of a self./Name. attribute chain, or None."""
+            if not parts:
+                return None
+            if parts[0] == "self":
+                if ci is None:
+                    return None
+                t = ci.cid
+            else:
+                t = lookup(parts[0])[0]
+            for a in parts[1:]:
+                tc = self._class(t)
+                t = tc.attr_types.get(a) if tc else None
+            return t
+
+        def attr_of(parts):
+            """Resolve a chain ending in a data attribute of a typed
+            owner -> "Type.attr", or None."""
+            if not parts or len(parts) < 2:
+                return None
+            owner_t = chain_type(parts[:-1])
+            return "%s.%s" % (owner_t, parts[-1]) if owner_t else None
+
+        def lock_name(expr):
+            parts = attr_parts(expr)
+            if not parts:
+                return None
+            term = parts[-1]
+            if term in _GLOBAL_LOCKS:
+                return term
+            if not _LOCKISH_RE.search(term):
+                return None
+            if len(parts) >= 2:
+                owner_t = chain_type(parts[:-1])
+                if owner_t:
+                    return "%s.%s" % (owner_t, term)
+                return "%s.%s" % (parts[-2], term)
+            return term
+
+        def callee_fid(call):
+            f = call.func
+            if isinstance(f, ast.Name):
+                if f.id in self.modfuncs:
+                    return (None, f.id)
+                return None
+            if isinstance(f, ast.Attribute):
+                recv = attr_parts(f.value)
+                if recv is None:
+                    return None
+                rt = chain_type(recv)
+                tc = self._class(rt)
+                if tc and f.attr in tc.methods:
+                    return (tc.cid, f.attr)
+            return None
+
+        def expr_type(expr):
+            """(type, elem) of an rvalue: names, constructions, typed
+            method calls via return annotations, list()/sorted()."""
+            parts = attr_parts(expr)
+            if parts:
+                if len(parts) == 1:
+                    return lookup(parts[0])
+                t = chain_type(parts)
+                if t:
+                    return (t, None)
+                tc = self._class(chain_type(parts[:-1]))
+                if tc:
+                    return (None, tc.attr_elems.get(parts[-1]))
+                return (None, None)
+            if isinstance(expr, ast.Call):
+                term = call_terminal(expr)
+                if term in ("list", "sorted", "tuple") and expr.args:
+                    return (None, expr_type(expr.args[0])[1])
+                if term and term in self.classes and isinstance(
+                        expr.func, ast.Name):
+                    return (term, None)
+                fid2 = callee_fid(expr)
+                if fid2 is not None:
+                    node = (self.by_cid[fid2[0]].methods[fid2[1]]
+                            if fid2[0] else self.modfuncs[fid2[1]][1])
+                    return ann_type(node.returns)
+            return (None, None)
+
+        def spawn_kind(call):
+            term = call_terminal(call)
+            if term == "Thread":
+                return "thread"
+            if term == "Timer":
+                return "timer"
+            if term == "ThreadPoolExecutor":
+                return "executor"
+            return None
+
+        def spawn_target(call, kind):
+            target = None
+            if kind == "thread":
+                for kw in call.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+            elif kind == "timer":
+                if len(call.args) >= 2:
+                    target = call.args[1]
+                for kw in call.keywords:
+                    if kw.arg == "function":
+                        target = kw.value
+            if target is None:
+                return None
+            parts = attr_parts(target)
+            if parts and len(parts) == 2 and parts[0] == "self" and ci:
+                if parts[1] in ci.methods:
+                    return (ci.cid, parts[1])
+            if parts and len(parts) == 1 and parts[0] in self.modfuncs:
+                return (None, parts[0])
+            return None
+
+        def resolve_callable(expr):
+            parts = attr_parts(expr)
+            if not parts:
+                return None
+            if len(parts) == 1 and parts[0] in self.modfuncs:
+                return (None, parts[0])
+            tc = self._class(chain_type(parts[:-1]))
+            if tc and parts[-1] in tc.methods:
+                return (tc.cid, parts[-1])
+            return None
+
+        def on_call(call):
+            term = call_terminal(call)
+            f = call.func
+            if isinstance(f, ast.Attribute):
+                recv = attr_parts(f.value)
+                # explicit lock discipline: document-order toggle
+                if term in ("acquire", "release") and recv is not None:
+                    ln = lock_name(f.value)
+                    if ln:
+                        if term == "acquire":
+                            if ln not in held:
+                                held.append(ln)
+                        elif ln in held:
+                            held.remove(ln)
+                        return
+                if term == "start" and recv and len(recv) == 2 \
+                        and recv[0] == "self":
+                    facts.starts.add(recv[1])
+                    return
+                if term in _JOINERS and recv is not None:
+                    if len(recv) == 2 and recv[0] == "self":
+                        facts.joins.add(recv[1])
+                        return
+                    if len(recv) == 1 and recv[0] in binds:
+                        facts.joins.add(binds[recv[0]])
+                        return
+                if term == "submit" and recv is not None:
+                    rt = chain_type(recv)
+                    if rt == "ThreadPoolExecutor" and call.args:
+                        tgt = resolve_callable(call.args[0])
+                        if tgt is not None:
+                            facts.submits.append((tgt, call.lineno))
+                        return
+                # chained fire-and-forget: threading.Thread(...).start()
+                if term == "start" and isinstance(f.value, ast.Call):
+                    k = spawn_kind(f.value)
+                    if k:
+                        tgt = spawn_target(f.value, k)
+                        facts.spawns.append((None, tgt, call.lineno, k))
+                        return
+                if recv is not None and is_owned(recv):
+                    return  # thread-private receiver: not attributed
+                if recv is not None and term in _MUTATORS:
+                    a = attr_of(recv)  # bare locals: out of scope
+                    if a:
+                        facts.writes.append(
+                            (a, "mutcall", frozenset(held), call.lineno))
+                if recv is not None and term in _PROBERS:
+                    a = attr_of(recv)
+                    if a:
+                        facts.probes.append(
+                            (a, frozenset(held), call.lineno))
+            fid2 = callee_fid(call)
+            if fid2 is not None and fid2[1] != "__init__":
+                facts.calls.append((fid2, frozenset(held), call.lineno))
+
+        def scan_expr(node):
+            # manual walk so cross-thread marshaling is a boundary: the
+            # callable/coroutine handed to loop.call_soon_threadsafe or
+            # asyncio.run_coroutine_threadsafe executes on the event
+            # loop thread, not here — descending into the args would
+            # attribute the loop's writes to this root (err toward
+            # silence; the loop root reaches them on its own edges)
+            stack = [node]
+            while stack:
+                sub = stack.pop()
+                if isinstance(sub, ast.Call) \
+                        and call_terminal(sub) in _MARSHALERS:
+                    if isinstance(sub.func, ast.Attribute):
+                        stack.append(sub.func.value)  # receiver chain
+                    continue
+                stack.extend(ast.iter_child_nodes(sub))
+                if isinstance(sub, ast.Call):
+                    on_call(sub)
+                elif isinstance(sub, ast.Compare) and any(
+                        isinstance(op, (ast.In, ast.NotIn))
+                        for op in sub.ops):
+                    for comp in sub.comparators:
+                        parts = attr_parts(comp)
+                        a = (attr_of(parts)
+                             if parts and not is_owned(parts) else None)
+                        if a:
+                            facts.probes.append(
+                                (a, frozenset(held), sub.lineno))
+
+        def record_write(target, kind, line):
+            if isinstance(target, ast.Subscript):
+                parts = attr_parts(target.value)
+                a = (attr_of(parts)
+                     if parts and not is_owned(parts) else None)
+                if a:
+                    facts.writes.append(
+                        (a, "itemset", frozenset(held), line))
+                return
+            parts = attr_parts(target)
+            if not parts or is_owned(parts):
+                return
+            a = attr_of(parts)
+            if a:
+                facts.writes.append((a, kind, frozenset(held), line))
+
+        def handle_assign_pair(target, value, line):
+            scan_expr(value)
+            if isinstance(value, ast.Call):
+                k = spawn_kind(value)
+                if k:
+                    bound = None
+                    parts = attr_parts(target)
+                    if parts and len(parts) == 2 and parts[0] == "self":
+                        bound = parts[1]
+                    tgt = (spawn_target(value, k)
+                           if k != "executor" else None)
+                    facts.spawns.append((bound, tgt, line, k))
+            if isinstance(target, ast.Name):
+                t = expr_type(value)
+                if t != (None, None):
+                    locals_t[target.id] = t
+                if (isinstance(value, ast.Call)
+                        and isinstance(value.func, ast.Name)
+                        and value.func.id in self.classes):
+                    owned.add(target.id)
+                else:
+                    owned.discard(target.id)  # rebound to shared state
+                vparts = attr_parts(value)
+                if vparts and len(vparts) == 2 and vparts[0] == "self":
+                    binds[target.id] = vparts[1]
+                return
+            if isinstance(value, ast.Name):
+                owned.discard(value.id)  # published: escapes the thread
+            record_write(target, "assign", line)
+
+        def scan_stmt(st):
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                return
+            if isinstance(st, ast.With):
+                pushed = []
+                for item in st.items:
+                    scan_expr(item.context_expr)
+                    ln = lock_name(item.context_expr)
+                    if ln:
+                        held.append(ln)
+                        pushed.append(ln)
+                scan_block(st.body)
+                for ln in pushed:
+                    if ln in held:
+                        held.remove(ln)
+                return
+            if isinstance(st, ast.Assign):
+                if (len(st.targets) == 1
+                        and isinstance(st.targets[0], ast.Tuple)
+                        and isinstance(st.value, ast.Tuple)
+                        and len(st.targets[0].elts)
+                        == len(st.value.elts)):
+                    for t, v in zip(st.targets[0].elts, st.value.elts):
+                        handle_assign_pair(t, v, st.lineno)
+                    return
+                for t in st.targets:
+                    handle_assign_pair(t, st.value, st.lineno)
+                return
+            if isinstance(st, ast.AnnAssign) and st.value is not None:
+                handle_assign_pair(st.target, st.value, st.lineno)
+                return
+            if isinstance(st, ast.AugAssign):
+                scan_expr(st.value)
+                record_write(st.target, "aug", st.lineno)
+                return
+            if isinstance(st, ast.For):
+                scan_expr(st.iter)
+                if isinstance(st.target, ast.Name):
+                    iparts = attr_parts(st.iter)
+                    elem = None
+                    if iparts:
+                        if len(iparts) == 1:
+                            elem = lookup(iparts[0])[1]
+                        else:
+                            tc = self._class(chain_type(iparts[:-1]))
+                            elem = (tc.attr_elems.get(iparts[-1])
+                                    if tc else None)
+                    if elem:
+                        locals_t[st.target.id] = (elem, None)
+                scan_block(st.body)
+                scan_block(st.orelse)
+                return
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.stmt):
+                    scan_stmt(child)
+                elif isinstance(child, ast.expr):
+                    scan_expr(child)
+                elif isinstance(child, ast.excepthandler):
+                    scan_block(child.body)
+                elif isinstance(child, (ast.withitem, ast.arguments)):
+                    pass
+
+        def scan_block(stmts):
+            for st in stmts:
+                scan_stmt(st)
+
+        scan_block(func.body)
+        return facts
+
+    # -- pass 4: thread roots -------------------------------------------
+
+    def _add_root(self, fid, kind, concurrent, init_locks, path) -> None:
+        if fid is None or fid not in self.facts:
+            return
+        prev = self.roots.get(fid)
+        if prev is None:
+            self.roots[fid] = Root(fid, kind, concurrent, init_locks,
+                                   path)
+        else:
+            prev.concurrent = prev.concurrent or concurrent
+
+    def _find_roots(self) -> None:
+        for facts in self.facts.values():
+            for _bound, tgt, _line, kind in facts.spawns:
+                self._add_root(tgt, kind, False, (), facts.path)
+            for tgt, _line in facts.submits:
+                self._add_root(tgt, "executor", True, (), facts.path)
+        for ci in self.all_classes:
+            if not any(b in _HANDLER_BASES for b in ci.bases):
+                continue
+            for mname in ci.methods:
+                if mname.startswith("do_") or mname == "handle":
+                    self._add_root((ci.cid, mname), "handler", True, (),
+                                   ci.path)
+        for fname, no_cs in self.rpc_funcs.items():
+            init = () if no_cs else ("cs_main",)
+            if (None, fname) in self.facts:
+                self._add_root((None, fname), "rpc", True, init,
+                               self.facts[(None, fname)].path)
+
+    # -- pass 5: per-root lockset BFS -----------------------------------
+
+    _MAX_LOCKSETS = 6  # distinct incoming locksets tracked per function
+
+    def _reach(self) -> None:
+        for root in self.roots.values():
+            seen: dict[tuple, set] = {}
+            stack = [(root.fid, root.init_locks)]
+            reached = self.reached.setdefault(root.name, set())
+            while stack:
+                fid, inc = stack.pop()
+                facts = self.facts.get(fid)
+                if facts is None or fid[1] == "__init__":
+                    continue
+                states = seen.setdefault(fid, set())
+                if inc in states or len(states) >= self._MAX_LOCKSETS:
+                    continue
+                states.add(inc)
+                reached.add(facts.qual)
+                for attr, kind, ls, line in facts.writes:
+                    self.attr_writes.setdefault(attr, []).append({
+                        "root": root, "locks": inc | ls,
+                        "path": facts.path, "line": line, "kind": kind,
+                        "qual": facts.qual, "fid": fid})
+                for attr, ls, line in facts.probes:
+                    self.attr_probes.setdefault(attr, []).append({
+                        "root": root, "locks": inc | ls,
+                        "path": facts.path, "line": line,
+                        "qual": facts.qual, "fid": fid})
+                for callee, ls, _line in facts.calls:
+                    stack.append((callee, inc | ls))
+
+    def build(self) -> None:
+        if self._built:
+            return
+        self._built = True
+        self._index()
+        self._type_attrs()
+        self._scan_all()
+        self._find_roots()
+        self._reach()
+
+    # -- rules ----------------------------------------------------------
+
+    def _declared_guard(self, attr: str) -> str | None:
+        cls, _, name = attr.rpartition(".")
+        ci = self._class(cls)
+        return ci.guards.get(name) if ci else None
+
+    def _shared(self, attr: str) -> bool:
+        """>=2 distinct roots touch the attribute, or any writer root is
+        itself concurrent (handler pool / executor / rpc dispatch)."""
+        writes = self.attr_writes.get(attr, ())
+        probes = self.attr_probes.get(attr, ())
+        roots = {s["root"].name for s in writes}
+        roots |= {s["root"].name for s in probes}
+        if len(roots) >= 2:
+            return True
+        return any(s["root"].concurrent for s in writes)
+
+    def _bcp008(self) -> tuple[list[Finding], set[str]]:
+        out, flagged, seen = [], set(), set()
+        for attr, sites in sorted(self.attr_writes.items()):
+            if not self._shared(attr) or self._declared_guard(attr):
+                continue
+            short = attr.split(".")[-1]
+            # (a) read-modify-write outside any lock
+            for s in sorted(sites, key=lambda s: (s["path"], s["line"])):
+                if s["kind"] != "aug" or s["locks"]:
+                    continue
+                anchor = "%s::compound:%s" % (s["qual"], short)
+                if anchor in seen:
+                    continue
+                seen.add(anchor)
+                flagged.add(attr)
+                out.append(Finding(
+                    "BCP008", s["path"], s["line"],
+                    "compound mutation of shared %s outside any lock — "
+                    "read-modify-write is not GIL-atomic (the += tear)"
+                    % attr, anchor))
+            # (b) check-then-mutate: a lockless membership/get probe and
+            # a lockless mutation of the same attr in the same function
+            probes = {p["fid"] for p in self.attr_probes.get(attr, ())
+                      if not p["locks"]}
+            for s in sorted(sites, key=lambda s: (s["path"], s["line"])):
+                if s["kind"] not in ("mutcall", "itemset"):
+                    continue
+                if s["locks"] or s["fid"] not in probes:
+                    continue
+                anchor = "%s::compound:%s" % (s["qual"], short)
+                if anchor in seen:
+                    continue
+                seen.add(anchor)
+                flagged.add(attr)
+                out.append(Finding(
+                    "BCP008", s["path"], s["line"],
+                    "check-then-mutate on shared %s outside any lock — "
+                    "the probe and the mutation can interleave (the "
+                    "PR 7 sigcache move_to_end/evict lesson)" % attr,
+                    anchor))
+        return out, flagged
+
+    def _bcp007(self, flagged: set[str]) -> list[Finding]:
+        out = []
+        for attr, sites in sorted(self.attr_writes.items()):
+            if attr in flagged or self._declared_guard(attr):
+                continue
+            roots = {s["root"].name for s in sites}
+            if len(roots) < 2:
+                continue
+            common = frozenset.intersection(
+                *(frozenset(s["locks"]) for s in sites))
+            if common:
+                continue
+            first = min(sites, key=lambda s: (s["path"], s["line"]))
+            out.append(Finding(
+                "BCP007", first["path"], first["line"],
+                "shared attribute %s is written from %d thread roots "
+                "(%s) with no common lock — no lockset consistently "
+                "guards it" % (attr, len(roots),
+                               ", ".join(sorted(roots))),
+                "race:%s" % attr))
+        return out
+
+    def _bcp009(self) -> list[Finding]:
+        out, seen = [], set()
+        # root-reached sites carry full locksets; unreached sites fall
+        # back to their in-edge locksets (one level of the caller-holds
+        # convention — crucial for --changed subset runs where the
+        # reaching roots live in un-analyzed files), then to the
+        # locally-recorded lockset
+        reached_sites: dict[tuple, list] = {}
+        for attr, sites in self.attr_writes.items():
+            for s in sites:
+                reached_sites.setdefault(
+                    (attr, s["fid"], s["line"]), []).append(s["locks"])
+        in_edges: dict[tuple, list] = {}
+        for f2 in self.facts.values():
+            for cfid, ls2, _ln in f2.calls:
+                in_edges.setdefault(cfid, []).append(ls2)
+        for ci in sorted(self.all_classes,
+                         key=lambda c: (c.path, c.name)):
+            for attr_name, guard in sorted(ci.guards.items()):
+                attr = "%s.%s" % (ci.cid, attr_name)
+                g = _norm_lock(guard)
+                for facts in self.facts.values():
+                    if facts.fid[0] != ci.cid or facts.fid[1] == "__init__":
+                        continue
+                    for wattr, _kind, ls, line in facts.writes:
+                        if wattr != attr:
+                            continue
+                        key = (attr, facts.fid, line)
+                        locksets = reached_sites.get(key)
+                        if locksets is None:
+                            callers = in_edges.get(facts.fid)
+                            if callers:
+                                locksets = [ls | c for c in callers]
+                            else:
+                                locksets = [ls]
+                        if all(g in {_norm_lock(x) for x in lset}
+                               for lset in locksets):
+                            continue
+                        anchor = "%s::guard:%s" % (facts.qual, attr_name)
+                        if anchor in seen:
+                            continue
+                        seen.add(anchor)
+                        out.append(Finding(
+                            "BCP009", facts.path, line,
+                            "write to %s without its declared guard %r "
+                            "held — the GUARDED_BY annotation promises "
+                            "every mutation happens under that lock"
+                            % (attr, guard), anchor))
+        return out
+
+    def _bcp010(self) -> list[Finding]:
+        out = []
+        for ci in sorted(self.all_classes,
+                         key=lambda c: (c.path, c.name)):
+            spawned: dict[str, tuple] = {}   # attr -> (line, kind)
+            started: set[str] = set()
+            for mname in ci.methods:
+                facts = self.facts.get((ci.cid, mname))
+                if facts is None:
+                    continue
+                for bound, _tgt, line, kind in facts.spawns:
+                    if bound is not None:
+                        spawned.setdefault(bound, (line, kind, mname))
+                started |= facts.starts
+            if not spawned:
+                continue
+            # close-ish closure over self-calls (BCP002 discipline)
+            closeish = {m for m in ci.methods
+                        if m in _CLOSEISH
+                        or m.startswith(_CLOSE_PREFIXES)}
+            frontier = list(closeish)
+            while frontier:
+                facts = self.facts.get((ci.cid, frontier.pop()))
+                if facts is None:
+                    continue
+                for (ccls, cm), _ls, _line in facts.calls:
+                    if ccls == ci.cid and cm not in closeish:
+                        closeish.add(cm)
+                        frontier.append(cm)
+            credited: set[str] = set()
+            for m in closeish:
+                facts = self.facts.get((ci.cid, m))
+                if facts is not None:
+                    credited |= facts.joins
+            for attr, (line, kind, _mname) in sorted(spawned.items()):
+                live = attr in started or kind == "executor"
+                if not live or attr in credited:
+                    continue
+                out.append(Finding(
+                    "BCP010", ci.path, line,
+                    "%s %s.%s is started but no join()/shutdown()/"
+                    "cancel() on it is reachable from close()/stop()/"
+                    "__exit__ — the thread outlives its owner (BCP002 "
+                    "pairing extended to threads)" % (kind, ci.name, attr),
+                    "%s::lifecycle:%s" % (ci.name, attr)))
+        return out
+
+    def findings(self) -> list[Finding]:
+        self.build()
+        comp, flagged = self._bcp008()
+        out = self._bcp007(flagged) + comp + self._bcp009() + \
+            self._bcp010()
+        out.sort(key=lambda f: (f.path, f.line, f.rule, f.anchor))
+        return out
+
+    # -- the concurrency report -----------------------------------------
+
+    def report(self) -> str:
+        self.build()
+        lines = [
+            "# Concurrency model (generated)",
+            "",
+            "Generated by `python -m tools.bcplint.cli "
+            "--concurrency-report > docs/CONCURRENCY.md`. Do not edit "
+            "by hand — CI asserts this file regenerates byte-identically",
+            "from the committed tree.",
+            "",
+            "## Thread roots",
+            "",
+            "| root | kind | concurrent | entry lockset | defined in |",
+            "|---|---|---|---|---|",
+        ]
+        rpc_roots = []
+        plain = []
+        for fid in sorted(self.roots, key=lambda f: (f[0] or "", f[1])):
+            r = self.roots[fid]
+            (rpc_roots if r.kind == "rpc" else plain).append(r)
+        for r in plain:
+            lines.append("| `%s` | %s | %s | %s | `%s` |" % (
+                r.name, r.kind, "yes" if r.concurrent else "no",
+                "{%s}" % ", ".join(sorted(r.init_locks)) or "{}",
+                r.path))
+        if rpc_roots:
+            no_cs = sorted(r.name for r in rpc_roots if not r.init_locks)
+            lines.append(
+                "| `rpc:*` (%d handlers) | rpc | yes | {cs_main}%s | "
+                "`bitcoincashplus_tpu/rpc/` |" % (
+                    len(rpc_roots),
+                    " except no_cs_main: " + ", ".join(no_cs)
+                    if no_cs else ""))
+        lines += ["", "## Reachability", ""]
+        for r in plain:
+            reached = sorted(self.reached.get(r.name, ()))
+            lines.append("### `%s`" % r.name)
+            lines.append("")
+            for q in reached:
+                lines.append("- `%s`" % q)
+            if not reached:
+                lines.append("- (nothing resolvable)")
+            lines.append("")
+        if rpc_roots:
+            union = set()
+            for r in rpc_roots:
+                union |= self.reached.get(r.name, set())
+            lines.append("### `rpc:*` (%d handlers, combined)" %
+                         len(rpc_roots))
+            lines.append("")
+            for q in sorted(union):
+                lines.append("- `%s`" % q)
+            lines.append("")
+        lines += ["## Guarded state", "",
+                  "| attribute | declared guard | write sites | "
+                  "locks seen at writes |", "|---|---|---|---|"]
+        any_guard = False
+        for ci in sorted(self.all_classes,
+                         key=lambda c: (c.path, c.name)):
+            for attr_name, guard in sorted(ci.guards.items()):
+                any_guard = True
+                attr = "%s.%s" % (ci.cid, attr_name)
+                # root-reached sites carry the caller's held locks too
+                # (the caller-holds convention BCP009 validates); fall
+                # back to the locally-recorded lockset when unreached
+                reached = {}
+                for s in self.attr_writes.get(attr, ()):
+                    reached.setdefault(
+                        (s["fid"], s["line"]), set()).update(s["locks"])
+                nsites = 0
+                locks = set()
+                for facts in self.facts.values():
+                    if facts.fid[0] != ci.cid or facts.fid[1] == "__init__":
+                        continue
+                    for wattr, _k, ls, line in facts.writes:
+                        if wattr == attr:
+                            nsites += 1
+                            locks |= reached.get((facts.fid, line), ls)
+                lines.append("| `%s` | `%s` | %d | %s |" % (
+                    attr, guard, nsites,
+                    ", ".join("`%s`" % x for x in sorted(locks))
+                    or "—"))
+        if not any_guard:
+            lines.append("| — | — | — | — |")
+        lines.append("")
+        return "\n".join(lines)
+
+
+class ConcurrencyAnalysis(Check):
+    """BCP007-BCP010: cross-thread lockset/race analysis (one Check
+    emitting four rules — they share the model build)."""
+
+    rule = "BCP007"
+    title = "cross-thread lockset/race analysis"
+    catalog = [
+        ("BCP007", "shared write from >=2 thread roots, no common lock"),
+        ("BCP008", "compound non-GIL-atomic mutation outside any lock"),
+        ("BCP009", "GUARDED_BY declared-guard violation"),
+        ("BCP010", "started thread with no join reachable from close"),
+    ]
+
+    def __init__(self):
+        self._mods: list[Module] = []
+
+    def collect(self, mod: Module) -> None:
+        self._mods.append(mod)
+
+    def finalize(self, ctx) -> list[Finding]:
+        return Model(self._mods).findings()
+
+
+def build_model(root: str, paths=None) -> Model:
+    import os
+    root = os.path.abspath(root)
+    if paths is None:
+        paths = [os.path.join(root, "bitcoincashplus_tpu"),
+                 os.path.join(root, "tools")]
+    mods = []
+    for abspath in iter_py_files(paths):
+        try:
+            mods.append(Module(root, abspath))
+        except SyntaxError:
+            continue
+    model = Model(mods)
+    model.build()
+    return model
+
+
+def build_report(root: str, paths=None) -> str:
+    return build_model(root, paths).report()
