@@ -2616,6 +2616,108 @@ def _device_reachable(timeout_s: int = 180) -> bool:
         return False
 
 
+def bench_schnorr_msm():
+    """ISSUE 19: Schnorr batch verification — Pippenger MSM batch check
+    vs the per-lane ladder, with the batch-vs-ladder crossover curve.
+
+    For each batch size N the same records run through (a) the per-lane
+    CPU oracle (the reference engine and the accept/reject oracle the
+    batch path must match byte-identically) and (b) the full MSM dispatch
+    (canary batches, host pack, one device batch equation). Sizes map to
+    MSM buckets 64/64/256 by default — the bucket-1024 rung is a
+    many-minute XLA compile on a CPU backend, opt in via
+    BCP_BENCH_MSM_SIZES. Writes BENCH_r19.json (schema 2 + host stamp)."""
+    import hashlib
+    import tempfile
+
+    from bitcoincashplus_tpu.crypto import secp256k1 as oracle
+    from bitcoincashplus_tpu.ops import ecdsa_batch as eb
+    from bitcoincashplus_tpu.script.interpreter import SigCheckRecord
+    from bitcoincashplus_tpu.util import devicewatch as dwatch
+
+    # bucket compiles are minutes cold on the XLA CPU backend — share
+    # the persistent cache the test suite / dispatch_breakdown use
+    dwatch.enable_compile_cache(
+        os.environ.get("BCP_COMPILE_CACHE",
+                       os.path.join(tempfile.gettempdir(),
+                                    "bcp-jax-test-cache")))
+
+    sizes = [int(x) for x in os.environ.get(
+        "BCP_BENCH_MSM_SIZES", "8,31,127").split(",") if x.strip()]
+
+    def srec(i):
+        d = 0xB00 + i
+        e = int.from_bytes(hashlib.sha256(b"bench%d" % i).digest(),
+                           "big") % oracle.N
+        r, s = oracle.schnorr_sign(d, e)
+        return SigCheckRecord(oracle.point_mul(d, oracle.G), r, s, e,
+                              algo="schnorr")
+
+    curve = []
+    crossover = None
+    for n in sizes:
+        recs = [srec(i) for i in range(n)]
+        expect = [oracle.schnorr_verify(r.pubkey, r.r, r.s, r.msg_hash)
+                  for r in recs]
+
+        def run_msm():
+            out = eb.dispatch_batch(
+                recs, backend="device", kernel="msm").result()
+            assert out.tolist() == expect, "msm verdicts diverged"
+            return out
+
+        run_msm()  # warm: pay the bucket's XLA compile outside timing
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            run_msm()
+            ts.append(time.perf_counter() - t0)
+        msm_s = sorted(ts)[1]
+
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = eb.dispatch_batch(recs, backend="cpu").result()
+            ts.append(time.perf_counter() - t0)
+            assert out.tolist() == expect
+        lad_s = sorted(ts)[1]
+
+        point = {
+            "batch_sigs": n,
+            "msm_bucket": eb._msm_bucket_for(2 * n + 1),
+            "msm_sigs_per_s": round(n / msm_s, 1),
+            "ladder_sigs_per_s": round(n / lad_s, 1),
+            "msm_speedup": round(lad_s / msm_s, 3),
+        }
+        curve.append(point)
+        if crossover is None and msm_s < lad_s:
+            crossover = n
+        emit("schnorr_msm_sigs_per_s", point["msm_sigs_per_s"], "sigs/s",
+             point["msm_speedup"], batch=n)
+
+    result = {
+        "metric": "schnorr_msm_crossover",
+        **_bench_stamp(),
+        "curve": curve,
+        "crossover_batch_sigs": crossover,
+        "msm_seeded": "BCP_MSM_SEED" in os.environ,
+        "note": "per-dispatch cost includes the 2 canary batches + host "
+                "pack + challenge hashing; the ladder column is the "
+                "per-lane Python-int oracle (the byte-identical "
+                "accept/reject reference). Crossover = smallest measured "
+                "batch where the MSM dispatch beats the ladder; "
+                "-ecdsakernel=msm routes Schnorr lanes through it while "
+                "ECDSA lanes keep riding glv.",
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_r19.json"), "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    best = max(curve, key=lambda p: p["msm_speedup"]) if curve else {}
+    return {"schnorr_msm_crossover_sigs": crossover,
+            "schnorr_msm_best_speedup": best.get("msm_speedup")}
+
+
 def main():
     if not _device_reachable():
         emit("sha256d_sweep_throughput_per_chip", 0.0, "GH/s", 0.0,
@@ -2659,6 +2761,12 @@ def main():
         except Exception as e:  # pragma: no cover - diagnostics only
             emit("snapshot_cert_verify_at_load", -1, "s", 0.0,
                  error=f"{type(e).__name__}: {e}")
+    if os.environ.get("BCP_BENCH_MSM", "1") != "0":
+        try:
+            recap.update(bench_schnorr_msm() or {})  # ISSUE 19: MSM
+        except Exception as e:  # pragma: no cover - diagnostics only
+            emit("schnorr_msm_sigs_per_s", -1, "sigs/s", 0.0,
+                 error=f"{type(e).__name__}: {e}")
     try:
         recap.update(bench_dispatch_breakdown() or {})  # ISSUE 8: phases
     except Exception as e:  # pragma: no cover - diagnostics only
@@ -2687,6 +2795,10 @@ if __name__ == "__main__":
         # multi-process fleet storm: children force JAX_PLATFORMS=cpu,
         # no device needed in this process either
         bench_fleet()
+    elif len(sys.argv) > 1 and sys.argv[1] == "schnorr_msm":
+        # Schnorr MSM batch-vs-ladder crossover (ISSUE 19): CPU backend
+        # is fine — the MSM program is plain XLA
+        bench_schnorr_msm()
     elif len(sys.argv) > 1 and sys.argv[1] == "snapshot_cert":
         # proof-carrying snapshot harness (ISSUE 17): store-level at
         # 10^6 coins plus real-process onboarding/fleet legs on CPU

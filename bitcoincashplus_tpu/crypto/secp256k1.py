@@ -220,6 +220,88 @@ def ecdsa_recover(r: int, s: int, recid: int, e: int):
     return q
 
 
+# ---- BCH Schnorr (2019-05 upgrade, spec 2019-05-15-schnorr.md) ----
+#
+# 64-byte (r || s) signatures over the SAME sighash digests as ECDSA,
+# discriminated from DER by length at the script layer. Verification:
+#   e = SHA256(r32 || ser_compressed(P) || m32) mod n
+#   R = s·G + (n − e)·P;  accept iff R finite, jacobi(R.y) = 1, R.x = r
+# This is the BCH rule set, NOT BIP340: the y-coordinate gate is the
+# Jacobi symbol (not even-y), r is a full field element (no x-only
+# pubkeys), and the challenge commits to the 33-byte COMPRESSED pubkey
+# serialization regardless of how the key appeared on the stack.
+# Schnorr is what makes TRUE batch verification possible (the batch MSM
+# check in ops/secp256k1.py): unlike ECDSA, the verifier learns R itself
+# (lifted from r), so N verifies collapse into one random-linear-
+# combination multi-scalar multiplication.
+
+def jacobi(a: int) -> int:
+    """Jacobi symbol (a | p) via Euler's criterion (p prime): 1 for a
+    quadratic residue, p − 1 (≡ −1) for a non-residue, 0 for 0."""
+    return pow(a, (P - 1) // 2, P)
+
+
+def schnorr_challenge(r: int, pubkey, msg_hash: int) -> int:
+    """e = SHA256(r || ser(P) || m) mod n — the challenge scalar. Binds
+    the compressed pubkey form so the same (r, s) can never be replayed
+    against a different key encoding."""
+    h = hashlib.sha256(
+        r.to_bytes(32, "big")
+        + pubkey_serialize(pubkey, compressed=True)
+        + (msg_hash % (1 << 256)).to_bytes(32, "big")
+    ).digest()
+    return int.from_bytes(h, "big") % N
+
+
+def schnorr_lift_x(r: int):
+    """The affine point (r, y) with jacobi(y) = 1, or None when r³ + 7 is
+    a non-residue (no such point exists, so no signature with this r can
+    ever verify — the batch layer pre-rejects those host-side). p ≡ 3
+    (mod 4), so the residue root is v^((p+1)/4); exactly one of {y, p−y}
+    has Jacobi symbol 1 (p ≡ 3 mod 4 makes −1 a non-residue)."""
+    if not (0 <= r < P):
+        return None
+    y2 = (r * r * r + B) % P
+    y = pow(y2, (P + 1) // 4, P)
+    if y * y % P != y2:
+        return None
+    if jacobi(y) != 1:
+        y = P - y
+    return (r, y)
+
+
+def schnorr_verify(pubkey, r: int, s: int, msg_hash: int) -> bool:
+    """BCH Schnorr verify. Range rules per the spec: fail if r >= p or
+    s >= n (r/s = 0 are in-range but can only verify if the algebra
+    happens to — no special-case)."""
+    if pubkey is None or not (0 <= r < P) or not (0 <= s < N):
+        return False
+    e = schnorr_challenge(r, pubkey, msg_hash)
+    R = point_add(point_mul(s, G), point_mul(N - e, pubkey))
+    if R is None:
+        return False
+    if jacobi(R[1]) != 1:
+        return False
+    return R[0] == r
+
+
+def schnorr_sign(secret: int, msg_hash: int) -> tuple[int, int]:
+    """Deterministic BCH Schnorr signer: RFC6979 nonce with the spec's
+    "Schnorr+SHA256" additional data (verification never sees the nonce
+    scheme, so any deterministic derivation interoperates). k is negated
+    when jacobi(R.y) != 1 so the verifier's Jacobi gate holds; r is R.x
+    as a FULL field element (may exceed n, unlike ECDSA's r)."""
+    assert 1 <= secret < N
+    k = rfc6979_nonce(secret, msg_hash, extra=b"Schnorr+SHA256  ")
+    Rp = point_mul(k, G)
+    if jacobi(Rp[1]) != 1:
+        k = N - k
+    r = Rp[0]
+    e = schnorr_challenge(r, point_mul(secret, G), msg_hash)
+    s = (k + e * secret) % N
+    return r, s
+
+
 # ---- DER (src/pubkey.cpp CPubKey::CheckLowS / ecdsa_signature_parse_der_lax) ----
 
 def sig_der_encode(r: int, s: int) -> bytes:

@@ -145,7 +145,7 @@ def verify_tx_scripts(
     if not records:
         return
     keys = [
-        SignatureCache.entry_key(r.msg_hash, r.r, r.s, r.pubkey)
+        SignatureCache.entry_key(r.msg_hash, r.r, r.s, r.pubkey, r.algo)
         for r in records
     ]
     if sig_service is not None:

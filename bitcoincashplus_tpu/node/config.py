@@ -88,10 +88,15 @@ Options:
   -minrelaytxfee=<amt>   Minimum relay fee rate in satoshis/kB (default: 1000)
   -tpu=<0|1>             Use the TPU batch backend for sig verification and
                          mining sweeps (default: auto-detect)
-  -ecdsakernel=<glv|w4>  Device ECDSA verify kernel: glv = endomorphism-split
+  -ecdsakernel=<glv|w4|msm>
+                         Device ECDSA verify kernel: glv = endomorphism-split
                          ladder + fixed-base G comb (default), w4 = the
-                         64-window kernel (kept as oracle/fallback); unknown
-                         values are rejected at startup
+                         64-window kernel (kept as oracle/fallback), msm =
+                         Pippenger multi-scalar batch check for SCHNORR lanes
+                         (one point-at-infinity verdict per batch; rejected
+                         batches bisect to the per-lane oracle — worth it from
+                         a few dozen Schnorr sigs per batch, ECDSA lanes keep
+                         riding glv); unknown values are rejected at startup
   -compilecache=<dir>    Persistent XLA compilation cache directory (default:
                          off). First compile of each kernel shape writes the
                          cache; every later process start reads it instead of

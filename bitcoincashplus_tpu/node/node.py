@@ -330,7 +330,7 @@ class Node:
         self.versionbits_cache = VersionBitsCache()
         backend = config.tpu_backend
         self.backend = backend
-        # -ecdsakernel=<glv|w4>: device verify kernel selection. Validated
+        # -ecdsakernel=<glv|w4|msm>: device verify kernel selection. Validated
         # HERE, at startup — an unknown value must fail init (like a
         # malformed -maxsigcachesize), not surface as a per-batch fallback
         # at the first block (ops/ecdsa_batch.set_kernel raises on junk)

@@ -30,6 +30,7 @@ the Python-int oracle — the reference's single-threaded VerifyScript path.
 from __future__ import annotations
 
 import os
+import random
 import time
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
@@ -120,6 +121,20 @@ _PW_GLV_DEV = dw.program("ecdsa_glv_decompose",
 _PW_W4_BYTES = dw.program("ecdsa_w4_bytes", shape_budget=PALLAS_SHAPE_BUDGET)
 _PW_W4 = dw.program("ecdsa_w4", shape_budget=len(BUCKETS))
 _PW_XLA = dw.program("ecdsa_xla", shape_budget=len(BUCKETS))
+# Pippenger MSM batch-verification program (ISSUE 19): term counts pad to
+# the _MSM_BUCKETS ladder, and the canary batches reuse the smallest
+# bucket, so the compiled-shape set is exactly that ladder.
+_MSM_BUCKETS = (64, 256, 1024, 4096, 8192, 16384)
+MSM_SHAPE_BUDGET = len(_MSM_BUCKETS)
+_PW_MSM = dw.program("ecdsa_msm", shape_budget=MSM_SHAPE_BUDGET)
+# A batch of n Schnorr sigs costs M = 2n+1 MSM terms (R_i, P_i, and the
+# shared G term); the cap keeps M inside the largest bucket — bigger
+# submissions chunk (the MSM sum cannot ride the ladder kernels' 16384-
+# lane program splitting, each chunk is an independent batch equation).
+MSM_MAX_RECORDS = 8190
+# Below this the bisection hands lanes straight to the per-lane oracle —
+# a device round trip per 8 sigs costs more than 8 scalar verifies.
+MSM_MIN_BATCH = 8
 
 
 def _watched_kernel(pw, bucket: int, arrays, fn, jitfn=None, kwargs=None,
@@ -146,14 +161,18 @@ def _watched_kernel(pw, bucket: int, arrays, fn, jitfn=None, kwargs=None,
     dw.note_phase("ecdsa", "execute", time.monotonic() - t0)
     return out
 
-# ---- kernel selection (-ecdsakernel=glv|w4) --------------------------------
+# ---- kernel selection (-ecdsakernel=glv|w4|msm) ----------------------------
 # "glv": the λ-endomorphism split verifier (ops/secp256k1 GLV core — 32
 # windows / 128 doublings over four addition streams + the fixed-base G
 # comb). "w4": the previous-generation 64-window kernel, kept in-tree as
 # the differential oracle and the breaker/dispatch fallback. The GLV path
 # degrades w4 -> XLA ladder -> CPU on failure; selection is validated at
 # node startup (node.py rejects unknown values before the first batch).
-ECDSA_KERNELS = ("glv", "w4")
+# "msm": the Pippenger batch-verification rung (ISSUE 19) — it applies to
+# SCHNORR records only (the batch equation needs Schnorr's linear verify
+# relation); ECDSA records under -ecdsakernel=msm ride the GLV ladder,
+# and a failed/rejected MSM batch bisects down to the per-lane oracle.
+ECDSA_KERNELS = ("glv", "w4", "msm")
 # Fault-injection site for the GLV leg specifically (explicit opt-in only,
 # like util/faults' "net" site: BCP_FAULT_OPS=all keeps meaning the four
 # accelerator subsystems, so existing dead-backend drills are unchanged).
@@ -166,6 +185,13 @@ GLV_SITE = "ecdsa_glv"
 # GLV_SITE stays armed across the WHOLE GLV family (both legs consult
 # it), so the pre-existing glv -> w4 drills keep their meaning.
 GLV_DEV_SITE = "ecdsa_glv_dev"
+# MSM batch-verification site (ISSUE 19), explicit-only like the GLV
+# legs: fail-* proves the msm -> per-lane fallback rung (a dead MSM
+# program degrades to the scalar oracle, never drops verdicts),
+# poison-output proves the canary gate catches a lying batch verdict
+# (the per-lane KAT gate cannot ride a ONE-bit batch result, so the MSM
+# path carries its own known-answer batches — see _msm_verify_records).
+MSM_SITE = "ecdsa_msm"
 _KERNEL = None  # set_kernel() override; None = BCP_ECDSA_KERNEL or "glv"
 _BAD_ENV_WARNED = False
 
@@ -228,6 +254,17 @@ def kernel_info() -> dict:
             "dispatches": STATS.glv_dev_dispatches,
             "fallbacks": STATS.glv_dev_fallbacks,
         },
+        "msm": {
+            "schnorr_sigs": STATS.schnorr_sigs,
+            "schnorr_cpu_sigs": STATS.schnorr_cpu_sigs,
+            "dispatches": STATS.msm_dispatches,
+            "batches_accepted": STATS.msm_batches_accepted,
+            "batches_rejected": STATS.msm_batches_rejected,
+            "bisects": STATS.msm_bisects,
+            "bisect_depth_max": STATS.msm_bisect_depth_max,
+            "fallback_sigs": STATS.msm_fallback_sigs,
+            "canary_failures": STATS.msm_canary_failures,
+        },
     }
 
 
@@ -281,6 +318,24 @@ class BatchStats:
     # device-False lanes host-confirmed before they could reject a block
     # (reject-side verdicts are never the device's alone to make)
     reject_confirm_sigs: int = 0
+    # Schnorr + MSM batch verification (ISSUE 19): schnorr_sigs counts
+    # every Schnorr record entering dispatch; schnorr_cpu_sigs the ones
+    # settled by the per-lane oracle (no MSM, bisect base cases, and
+    # fallback re-verifies). msm_dispatches counts MSM PROGRAM calls
+    # (canary batches included); a rejected batch bisects (msm_bisects,
+    # with the deepest split level in msm_bisect_depth_max — O(log N)
+    # per forged sig). msm_fallback_sigs are lanes that abandoned the
+    # MSM rung entirely (dead program after retries -> per-lane oracle);
+    # msm_canary_failures are canary-gate trips (also kat_failures).
+    schnorr_sigs: int = 0
+    schnorr_cpu_sigs: int = 0
+    msm_dispatches: int = 0
+    msm_batches_accepted: int = 0
+    msm_batches_rejected: int = 0
+    msm_bisects: int = 0
+    msm_bisect_depth_max: int = 0
+    msm_fallback_sigs: int = 0
+    msm_canary_failures: int = 0
     buckets_used: dict = field(default_factory=dict)
 
     def snapshot(self) -> dict:
@@ -571,10 +626,10 @@ def pack_records_glv(records: Sequence, bucket: int):
     )
 
 
-def _verify_cpu(records: Sequence) -> np.ndarray:
-    """CPU lane: the native C++ scalar module (threaded via -par) when
-    available, else the Python-int oracle. Differential parity is covered
-    by tests/unit/test_native.py."""
+def _verify_cpu_ecdsa(records: Sequence) -> np.ndarray:
+    """ECDSA CPU lane: the native C++ scalar module (threaded via -par)
+    when available, else the Python-int oracle. Differential parity is
+    covered by tests/unit/test_native.py."""
     from .. import native
 
     if native.available():
@@ -586,6 +641,38 @@ def _verify_cpu(records: Sequence) -> np.ndarray:
         ],
         dtype=bool,
     )
+
+
+def _schnorr_oracle(records: Sequence) -> np.ndarray:
+    """Per-lane Schnorr verify on the Python-int oracle — the accept/
+    reject reference every MSM verdict must match byte-identically (and
+    the reject-side engine the bisection funnels into)."""
+    STATS.schnorr_cpu_sigs += len(records)
+    return np.array(
+        [
+            oracle.schnorr_verify(rec.pubkey, rec.r, rec.s, rec.msg_hash)
+            for rec in records
+        ],
+        dtype=bool,
+    )
+
+
+def _verify_cpu(records: Sequence) -> np.ndarray:
+    """CPU lane, algorithm-aware: ECDSA records take the native/oracle
+    scalar path, Schnorr records the Schnorr oracle. Mixed batches are
+    partitioned and re-merged in submission order (the deferral layer
+    tags every SigCheckRecord with ``algo``; blob-path _LazyRecords and
+    legacy callers without the field default to ECDSA)."""
+    algos = [getattr(rec, "algo", "ecdsa") for rec in records]
+    if "schnorr" not in algos:
+        return _verify_cpu_ecdsa(records)
+    out = np.zeros(len(records), bool)
+    ecd = [i for i, a in enumerate(algos) if a != "schnorr"]
+    sch = [i for i, a in enumerate(algos) if a == "schnorr"]
+    if ecd:
+        out[ecd] = _verify_cpu_ecdsa([records[i] for i in ecd])
+    out[sch] = _schnorr_oracle([records[i] for i in sch])
+    return out
 
 
 _KAT = None
@@ -614,6 +701,281 @@ def _kat_records() -> tuple:
         bad = SigCheckRecord(pub, r, s, (e + 1) % oracle.N)
         _KAT = (good, bad)
     return _KAT
+
+
+# ---- Schnorr MSM batch verification (ISSUE 19) -----------------------------
+#
+# The device kernel (ops/secp256k1._msm_program) answers ONE bit per
+# batch: does Σ a_i·R_i + Σ (a_i·e_i)·P_i + ((n − Σ a_i·s_i) mod n)·G
+# land on the point at infinity. Trust architecture around that bit:
+#
+#   accept side — a CANARY gate per verify session: the program must
+#     accept a known-good batch AND reject that batch with a known-bad
+#     sig appended, before any real verdict is trusted (the per-lane KAT
+#     gate can't ride a one-bit result). With the canary green, a false
+#     accept requires the 2^-128 coefficient collision.
+#   reject side — never the device's alone (repo invariant): a rejected
+#     batch BISECTS with fresh coefficients per sub-batch; sub-batches at
+#     or below MSM_MIN_BATCH settle on the per-lane Python oracle. One
+#     forged signature therefore costs O(log N) sub-batch checks, and
+#     every False the caller sees was produced by the oracle.
+#   host prechecks — r/s range and the R = lift_x(r) existence test run
+#     on the host and pre-reject without any device work. This cannot
+#     diverge from the oracle: schnorr_verify accepts only if R'.x == r
+#     for the computed finite R', which forces r³+7 to be a quadratic
+#     residue — exactly the condition lift_x tests (and the oracle's
+#     jacobi(R'.y) gate matches lift_x's root choice).
+
+_SCHNORR_KAT = None
+
+
+def _schnorr_kat_records() -> tuple:
+    """Known-answer Schnorr records for the MSM canary batches: one
+    signature that MUST verify and one that MUST NOT (same sig, shifted
+    message). Generated once from the Python-int oracle."""
+    global _SCHNORR_KAT
+    if _SCHNORR_KAT is None:
+        import hashlib
+
+        from ..script.interpreter import SigCheckRecord
+
+        d = 0x5A7D1C9E3B8F6A2D4C1E8B7F9A3D5C6E8F1A2B4D6C8E9F1B3A5C7E9D2B4F6A8C
+        d %= oracle.N
+        e = int.from_bytes(
+            hashlib.sha256(b"bcp-msm-batch-kat").digest(), "big"
+        ) % oracle.N
+        r, s = oracle.schnorr_sign(d, e)
+        pub = oracle.point_mul(d, oracle.G)
+        good = SigCheckRecord(pub, r, s, e, algo="schnorr")
+        bad = SigCheckRecord(pub, r, s, (e + 1) % oracle.N, algo="schnorr")
+        _SCHNORR_KAT = (good, bad)
+    return _SCHNORR_KAT
+
+
+def _msm_rng() -> random.Random:
+    """Coefficient RNG for one verify session. Security rests on the
+    coefficients being unpredictable to whoever crafted the signatures;
+    os.urandom seeds each session. BCP_MSM_SEED pins the stream for
+    deterministic drills/benches (never set in production)."""
+    seed = os.environ.get("BCP_MSM_SEED")
+    if seed is not None:
+        return random.Random(int(seed, 0))
+    return random.Random(int.from_bytes(os.urandom(16), "big"))
+
+
+def _schnorr_precheck(rec):
+    """Host-side pre-reject + R lift: returns the affine R = lift_x(r)
+    for a structurally admissible record, None where the oracle is
+    guaranteed to reject (range violation, unliftable r, missing
+    pubkey) — see the section comment for the oracle-consistency
+    argument."""
+    if rec.pubkey is None:
+        return None
+    if not (0 <= rec.r < oracle.P and 0 <= rec.s < oracle.N):
+        return None
+    return oracle.schnorr_lift_x(rec.r)
+
+
+def _msm_bucket_for(m: int) -> int:
+    for b in _MSM_BUCKETS:
+        if m <= b:
+            return b
+    raise ValueError(f"MSM term count {m} exceeds the bucket ladder")
+
+
+def _msm_pack(terms, bucket: int):
+    """(x, y, scalar) Python-int terms -> the MSM program's byte
+    matrices, padded to ``bucket`` with infinity-flagged zero-scalar
+    lanes (contribute nothing by construction)."""
+    m = len(terms)
+    xm = np.zeros((bucket, 32), np.uint8)
+    ym = np.zeros((bucket, 32), np.uint8)
+    km = np.zeros((bucket, 32), np.uint8)
+    inf8 = np.ones(bucket, np.uint8)
+    xm[:m] = np.frombuffer(
+        b"".join(x.to_bytes(32, "big") for x, _, _ in terms),
+        np.uint8).reshape(m, 32)
+    ym[:m] = np.frombuffer(
+        b"".join(y.to_bytes(32, "big") for _, y, _ in terms),
+        np.uint8).reshape(m, 32)
+    km[:m] = np.frombuffer(
+        b"".join(k.to_bytes(32, "big") for _, _, k in terms),
+        np.uint8).reshape(m, 32)
+    inf8[:m] = 0
+    return xm, ym, inf8, km
+
+
+def _msm_device_check(pairs, rng: random.Random) -> bool:
+    """ONE batch-equation check on the device: ``pairs`` is a list of
+    (record, lifted_R) with every record already through
+    _schnorr_precheck. Draws FRESH random coefficients (bisection calls
+    this per sub-batch — reusing coefficients across splits would let a
+    crafted pair of forgeries cancel in one half). Returns the batch
+    verdict."""
+    from . import secp256k1 as dev
+
+    INJECTOR.on_call(MSM_SITE)
+    s_acc = 0
+    terms = []
+    for i, (rec, lift) in enumerate(pairs):
+        # a_0 = 1 is safe (the adversary can't anticipate which sig lands
+        # first in a *sub*-batch) and saves one 128-bit scalar ladder
+        a = 1 if i == 0 else rng.getrandbits(128) | 1
+        e = oracle.schnorr_challenge(rec.r, rec.pubkey, rec.msg_hash)
+        s_acc = (s_acc + a * rec.s) % oracle.N
+        terms.append((lift[0], lift[1], a))
+        terms.append((rec.pubkey[0], rec.pubkey[1], (a * e) % oracle.N))
+    terms.append((oracle.GX, oracle.GY, (oracle.N - s_acc) % oracle.N))
+    bucket = _msm_bucket_for(len(terms))
+    with dw.phase("ecdsa", "pack"):
+        arrays = _msm_pack(terms, bucket)
+    out = _watched_kernel(
+        _PW_MSM, bucket, arrays,
+        lambda: dev.schnorr_msm_is_infinity(*arrays),
+        jitfn=dev._msm_program, split=None)
+    STATS.msm_dispatches += 1
+    ok = bool(np.asarray(out)[0])
+    if INJECTOR.should_poison(MSM_SITE):
+        ok = not ok
+    return ok
+
+
+def _msm_verify_records(records: Sequence) -> np.ndarray:
+    """Verdicts for a pure-Schnorr batch via the MSM batch check +
+    bisection. Byte-identical to the per-lane oracle: pre-rejected lanes
+    are oracle-guaranteed False, rejected batches funnel to the oracle,
+    and accepted batches are wrong only on a 2^-128 coefficient
+    collision (with the canary proving the program can tell good from
+    bad at all). Raises on device/canary failure — _dispatch_msm owns
+    the retry/fallback supervision."""
+    n = len(records)
+    out = np.zeros(n, bool)
+    lifts = [_schnorr_precheck(rec) for rec in records]
+    live = [i for i in range(n) if lifts[i] is not None]
+    if not live:
+        return out
+    rng = _msm_rng()
+
+    # canary gate (see section comment): both polarities must be right
+    # before any real verdict from this session is trusted
+    kg, kb = _schnorr_kat_records()
+    kgl = _schnorr_precheck(kg)
+    kbl = _schnorr_precheck(kb)
+    if (not _msm_device_check([(kg, kgl)], rng)
+            or _msm_device_check([(kg, kgl), (kb, kbl)], rng)):
+        STATS.msm_canary_failures += 1
+        STATS.kat_failures += 1
+        raise PoisonedOutput("ecdsa msm canary batches wrong")
+
+    depth_max = 0
+
+    def solve(idxs, depth: int) -> None:
+        nonlocal depth_max
+        depth_max = max(depth_max, depth)
+        if len(idxs) <= MSM_MIN_BATCH:
+            out[idxs] = _schnorr_oracle([records[i] for i in idxs])
+            return
+        if _msm_device_check([(records[i], lifts[i]) for i in idxs], rng):
+            out[idxs] = True
+            STATS.msm_batches_accepted += 1
+            return
+        STATS.msm_batches_rejected += 1
+        STATS.msm_bisects += 1
+        mid = len(idxs) // 2
+        solve(idxs[:mid], depth + 1)
+        solve(idxs[mid:], depth + 1)
+
+    # chunk so M = 2n+1 stays inside the bucket ladder; each chunk is an
+    # independent batch equation
+    for s in range(0, len(live), MSM_MAX_RECORDS):
+        solve(live[s:s + MSM_MAX_RECORDS], 0)
+    STATS.msm_bisect_depth_max = max(STATS.msm_bisect_depth_max, depth_max)
+    return out
+
+
+def _dispatch_msm(records: Sequence, br) -> Optional[BatchHandle]:
+    """Supervised MSM dispatch for a pure-Schnorr record list. EAGER
+    (synchronous settle): the bisection ladder is verdict-driven, so
+    there is nothing to pipeline — the returned handle already carries
+    the final verdicts. Returns None when every attempt failed (caller
+    owns the per-lane fallback). Mirrors _dispatch_device's supervision:
+    breaker retries with backoff, programming errors re-raise, canary
+    trips are PoisonedOutput and retried like any device fault."""
+    boff = Backoff(base=br.cfg.backoff_base, maximum=1.0)
+    last: Optional[BaseException] = None
+    for attempt in range(br.cfg.retries + 1):
+        try:
+            INJECTOR.on_call("ecdsa")
+            out = _msm_verify_records(records)
+            br.record_success()
+            return BatchHandle(len(records), cpu_ok=out)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except (NameError, AttributeError, UnboundLocalError):
+            raise  # programming errors must not degrade silently
+        except Exception as e:  # noqa: BLE001 — supervised boundary
+            last = e
+            if attempt < br.cfg.retries:
+                time.sleep(boff.next())
+    br.record_failure(last)
+    br.note_fallback(len(records))
+    STATS.msm_fallback_sigs += len(records)
+    log_printf("schnorr msm dispatch failed (%s: %s) — per-lane oracle "
+               "fallback for %d sig(s)", type(last).__name__,
+               str(last)[:120], len(records))
+    return None
+
+
+def _dispatch_schnorr(records: Sequence, backend: str,
+                      kernel: str | None) -> "BatchHandle":
+    """Dispatch a pure-Schnorr record list: the MSM batch check when the
+    msm kernel is selected and a device is worth dispatching to, else
+    the per-lane oracle. The per-lane path IS the reference engine — no
+    KAT/confirm layer needed."""
+    n = len(records)
+    STATS.schnorr_sigs += n
+    kern = kernel if kernel in ECDSA_KERNELS else active_kernel()
+    use_device = kern == "msm" and (
+        backend == "device"
+        or (backend == "auto" and n >= CPU_FLOOR and _device_available())
+    )
+    if use_device:
+        br = dispatch.breaker("ecdsa")
+        if br.allow():
+            handle = _dispatch_msm(records, br)
+            if handle is not None:
+                return handle
+            STATS.fault_fallback_sigs += n
+        else:
+            br.note_fallback(n)
+            STATS.fault_fallback_sigs += n
+    STATS.cpu_fallback_sigs += n
+    return BatchHandle(n, cpu_ok=_schnorr_oracle(records))
+
+
+class _MergedHandle:
+    """Mixed ECDSA/Schnorr dispatch: per-algorithm sub-handles re-merged
+    into submission order at settle. Result is memoized like
+    BatchHandle; _bucket mirrors the widest sub-dispatch so LanePacker's
+    fill metering keeps working through mixed batches."""
+
+    __slots__ = ("_n", "_parts", "_result", "_bucket")
+
+    def __init__(self, n: int, parts):
+        self._n = n
+        self._parts = parts  # [(handle, submission indices), ...]
+        self._bucket = max(
+            (getattr(h, "_bucket", 0) for h, _ in parts), default=0)
+        self._result = None
+
+    def result(self) -> np.ndarray:
+        if self._result is None:
+            out = np.zeros(self._n, bool)
+            for handle, idxs in self._parts:
+                out[idxs] = handle.result()
+            self._result = out
+            self._parts = ()
+        return self._result
 
 
 def _device_available() -> bool:
@@ -777,8 +1139,11 @@ def dispatch_batch(records: Sequence, backend: str = "auto",
 
     backend: "auto" (device if available and batch >= CPU_FLOOR),
     "device" (force), "cpu" (force oracle — synchronous).
-    kernel: per-call override of the device verify kernel ("glv"/"w4");
-    None uses active_kernel() (the -ecdsakernel startup selection).
+    kernel: per-call override of the device verify kernel
+    ("glv"/"w4"/"msm"); None uses active_kernel() (the -ecdsakernel
+    startup selection). "msm" selects the Pippenger batch check for the
+    Schnorr lanes; ECDSA lanes under "msm" ride the GLV ladder (the MSM
+    batch equation is Schnorr-shaped).
 
     The device leg is supervised (ops/dispatch): the ecdsa circuit breaker
     gates it, bounded retries absorb transient dispatch errors, and a
@@ -787,6 +1152,21 @@ def dispatch_batch(records: Sequence, backend: str = "auto",
     if not records:
         return BatchHandle(0, cpu_ok=np.zeros(0, bool))
     n = len(records)
+    # Schnorr lanes (script interpreter 64-byte-sig discrimination) take
+    # the MSM batch path; mixed batches split per algorithm and re-merge
+    # in submission order at settle
+    algos = [getattr(r, "algo", "ecdsa") for r in records]
+    if any(a == "schnorr" for a in algos):
+        if all(a == "schnorr" for a in algos):
+            return _dispatch_schnorr(records, backend, kernel)
+        e_idx = [i for i, a in enumerate(algos) if a != "schnorr"]
+        s_idx = [i for i, a in enumerate(algos) if a == "schnorr"]
+        return _MergedHandle(n, [
+            (dispatch_batch([records[i] for i in e_idx], backend,
+                            kernel=kernel), e_idx),
+            (_dispatch_schnorr([records[i] for i in s_idx], backend,
+                               kernel), s_idx),
+        ])
     use_device = backend == "device" or (
         backend == "auto"
         and n >= CPU_FLOOR
@@ -838,6 +1218,10 @@ def _dispatch_device(records: Sequence, br,
     boff = Backoff(base=br.cfg.backoff_base, maximum=1.0)
     last: Optional[BaseException] = None
     kern = kernel if kernel in ECDSA_KERNELS else active_kernel()
+    if kern == "msm":
+        # the MSM batch equation verifies Schnorr sigs only; ECDSA lanes
+        # under -ecdsakernel=msm keep the strongest per-lane ladder
+        kern = "glv"
     # the enqueuing span (block.scan during the pipelined import) is the
     # settle span's parent — settle may run threads/blocks away
     ctx = tm.trace_context()

@@ -1761,3 +1761,247 @@ def field_neg_bytes_np(yb: np.ndarray) -> np.ndarray:
     d, under = _np_sub(
         np.broadcast_to(_np_const16(P, 16), yl.shape).copy(), yl)
     return _np_bytes_le(d)[:, ::-1]
+
+# ---- Pippenger/bucket MSM — Schnorr batch verification (round 19) ----------
+#
+# The GLV ladder above still pays a full group-law ladder PER SIGNATURE.
+# BCH Schnorr signatures admit a true batch check: draw per-sig random
+# 128-bit coefficients a_i and test
+#
+#     Σ a_i·R_i + Σ (a_i·e_i mod n)·P_i + ((n − Σ a_i·s_i) mod n)·G == O
+#
+# — one point-at-infinity check for the whole batch (soundness error
+# 2^-128 per forged signature; the host layer in ops/ecdsa_batch.py owns
+# coefficient drawing, the canary gate and the reject-side bisection).
+# The kernel here is the generic engine: a multi-scalar multiplication
+# Σ k_j·Q_j over M = 2N+1 (point, scalar) terms, Pippenger bucket
+# accumulation with c = 4-bit windows.
+#
+# Compute shape (deliberately unlike the uniform-SIMD ladders): the batch
+# is split into K independent STREAMS; each step gathers every stream's
+# current bucket (take_along_axis over the 16-bucket axis), performs ONE
+# complete mixed add at width K·64 (all 64 windows of all K streams in
+# parallel), and scatters the results back through a 16-wide one-hot
+# select — a gather/scatter bucket walk, not a ladder. Streams then merge
+# pairwise (log2 K complete full adds at shrinking widths), buckets
+# reduce to per-window sums via the suffix-running-sum identity
+# Σ b·B_b = Σ_{j} Σ_{b>=j} B_b (15 iterations, 2 full adds at width 64),
+# and a 64-window Horner ladder (4 doubles + 1 add per window at width 1)
+# collapses to the final accumulator.
+#
+# COMPLETENESS IS LOAD-BEARING on the accept side: an adversary controls
+# R_i and P_i, so bucket/merge/reduce additions CAN hit the same-point
+# and opposite-point cases (identical R across two sigs landing in one
+# bucket, crafted torsion-free collisions). Every addition in this
+# pipeline is therefore the fully complete form (pt_add_mixed /
+# pt_add_full) — unlike the w4/GLV ladders' cheap adds, there is no
+# degenerate-lane escape hatch, because a single wrong add could turn a
+# forged batch into an accepted infinity. The reject side never trusts
+# the device at all (host bisects to the per-lane oracle).
+
+# Stream-count cap: more streams = wider (better-utilized) adds but more
+# merge work; M//32 keeps every stream >= 32 points deep so the merge
+# tree stays a rounding error next to the bucket walk.
+_MSM_STREAM_CAP = 128
+
+
+def pt_add_full(pt: dict, q: dict) -> dict:
+    """COMPLETE Jacobian + Jacobian add via branchless selects — the
+    full-Jacobian analogue of pt_add_mixed's case analysis:
+      P=inf -> Q;  Q=inf -> P;  P==Q -> double(P);  P==-Q -> infinity.
+    add-2007-bl core, same field discipline as _pt_add_full_cheap_u, plus
+    the two exact-norm zero tests and the internal double the cheap form
+    omits. Masks are (B,)-shaped bools (plain-XLA path only)."""
+    X1, Y1, Z1 = pt["X"], pt["Y"], pt["Z"]
+    X2, Y2, Z2 = q["X"], q["Y"], q["Z"]
+    Z1Z1 = f_sqr(Z1)
+    Z2Z2 = f_sqr(Z2)
+    U1 = f_mul(X1, Z2Z2)
+    U2 = f_mul(X2, Z1Z1)
+    S1 = f_mul(Y1, f_mul(Z2, Z2Z2))
+    S2 = f_mul(Y2, f_mul(Z1, Z1Z1))
+    H = f_carry_sub(U2, U1)
+    R = f_carry_sub(S2, S1)
+    h_zero = f_is_zero(H)
+    r_zero = f_is_zero(R)
+    finite_both = ~pt["inf"] & ~q["inf"]
+    same = h_zero & r_zero & finite_both
+    opposite = h_zero & ~r_zero & finite_both
+    HH = f_sqr(H)
+    HHH = f_mul(H, HH)
+    V = f_mul(U1, HH)
+    X3 = f_carry_sub(f_sqr(R), f_carry(f_add(HHH, f_carry(f_add(V, V)))))
+    Y3 = f_carry_sub(f_mul(R, f_carry_sub(V, X3)), f_mul(S1, HHH))
+    Z3 = f_mul(f_mul(Z1, Z2), H)
+    out = {"X": X3, "Y": Y3, "Z": Z3, "inf": opposite}
+    out = pt_select(same, pt_double(pt), out)
+    out = pt_select(pt["inf"], q, out)
+    out = pt_select(q["inf"] & ~pt["inf"], pt, out)
+    return out
+
+
+def _msm_accumulate(xm, ym, inf8, km) -> dict:
+    """The MSM core: xm/ym (M, 32) uint8 big-endian affine coordinates,
+    inf8 (M,) uint8 infinity/padding flags (flagged terms contribute
+    nothing), km (M, 32) uint8 big-endian scalars (< n). M must be a
+    multiple of the stream count (the host pads to the _MSM_BUCKETS
+    ladder, all multiples of every admissible K). Returns the Jacobian
+    accumulator point Σ k_j·Q_j at width 1."""
+    M = xm.shape[0]
+    K = max(1, min(_MSM_STREAM_CAP, M // 32))
+    steps = M // K
+    # stream-major point layout: stream k owns points k*steps .. k*steps+
+    # steps-1, so a plain reshape splits the lane axis into (K, steps)
+    xs = _expand_limb_cols(xm).reshape(N_LIMBS, K, steps)
+    ys = _expand_limb_cols(ym).reshape(N_LIMBS, K, steps)
+    p_inf = inf8.astype(bool).reshape(K, steps)
+    # (64, M) MSB-first 4-bit windows -> (K*64, steps), lane = k*64 + w
+    digits = _expand_nibble_windows(km).reshape(64, K, steps)
+    digits = digits.transpose(1, 0, 2).reshape(K * 64, steps)
+    lanes = K * 64
+
+    # varying-safe infinity inits (shard_map carry-vma: see _sweep)
+    v0 = xs[0, 0, 0] * U32_0
+    t0 = v0 == v0
+
+    def inf_pt(tail: tuple) -> dict:
+        z = jnp.zeros((N_LIMBS,) + tail, jnp.uint32) + v0
+        return {"X": z + np.uint32(1), "Y": z + np.uint32(1), "Z": z,
+                "inf": jnp.zeros(tail, bool) | t0}
+
+    bucket_ids = jnp.arange(16, dtype=jnp.int32)
+
+    def step(t, bk):
+        d = jax.lax.dynamic_index_in_dim(digits, t, 1, keepdims=False)
+        qx = jax.lax.dynamic_index_in_dim(xs, t, 2, keepdims=False)
+        qy = jax.lax.dynamic_index_in_dim(ys, t, 2, keepdims=False)
+        qi = jax.lax.dynamic_index_in_dim(p_inf, t, 1, keepdims=False)
+        # each stream's point fans out across its 64 window lanes
+        qx = jnp.broadcast_to(
+            qx[:, :, None], (N_LIMBS, K, 64)).reshape(N_LIMBS, lanes)
+        qy = jnp.broadcast_to(
+            qy[:, :, None], (N_LIMBS, K, 64)).reshape(N_LIMBS, lanes)
+        qi = jnp.broadcast_to(qi[:, None], (K, 64)).reshape(lanes)
+        cur = {
+            "X": jnp.take_along_axis(bk["X"], d[None, :, None], axis=2)[
+                ..., 0],
+            "Y": jnp.take_along_axis(bk["Y"], d[None, :, None], axis=2)[
+                ..., 0],
+            "Z": jnp.take_along_axis(bk["Z"], d[None, :, None], axis=2)[
+                ..., 0],
+            "inf": jnp.take_along_axis(bk["inf"], d[:, None], axis=1)[:, 0],
+        }
+        new = pt_add_mixed(cur, qx, qy, qi)
+        # one-hot write-back; digit-0 lanes and infinity points are
+        # no-ops (bucket 0 is a sink the reduction never reads)
+        hit = (bucket_ids[None, :] == d[:, None]) & (
+            (d > 0) & ~qi)[:, None]
+        return {
+            "X": jnp.where(hit[None], new["X"][:, :, None], bk["X"]),
+            "Y": jnp.where(hit[None], new["Y"][:, :, None], bk["Y"]),
+            "Z": jnp.where(hit[None], new["Z"][:, :, None], bk["Z"]),
+            "inf": jnp.where(hit, new["inf"][:, None], bk["inf"]),
+        }
+
+    bk = jax.lax.fori_loop(0, steps, step, inf_pt((lanes, 16)))
+
+    # pairwise stream merge: log2(K) complete full adds at halving widths
+    k = K
+    cur = {"X": bk["X"].reshape(N_LIMBS, K, 1024),
+           "Y": bk["Y"].reshape(N_LIMBS, K, 1024),
+           "Z": bk["Z"].reshape(N_LIMBS, K, 1024),
+           "inf": bk["inf"].reshape(K, 1024)}
+    while k > 1:
+        half = k // 2
+        lo = {"X": cur["X"][:, :half].reshape(N_LIMBS, half * 1024),
+              "Y": cur["Y"][:, :half].reshape(N_LIMBS, half * 1024),
+              "Z": cur["Z"][:, :half].reshape(N_LIMBS, half * 1024),
+              "inf": cur["inf"][:half].reshape(half * 1024)}
+        hi = {"X": cur["X"][:, half:].reshape(N_LIMBS, half * 1024),
+              "Y": cur["Y"][:, half:].reshape(N_LIMBS, half * 1024),
+              "Z": cur["Z"][:, half:].reshape(N_LIMBS, half * 1024),
+              "inf": cur["inf"][half:].reshape(half * 1024)}
+        merged = pt_add_full(lo, hi)
+        cur = {"X": merged["X"].reshape(N_LIMBS, half, 1024),
+               "Y": merged["Y"].reshape(N_LIMBS, half, 1024),
+               "Z": merged["Z"].reshape(N_LIMBS, half, 1024),
+               "inf": merged["inf"].reshape(half, 1024)}
+        k = half
+    bX = cur["X"].reshape(N_LIMBS, 64, 16)
+    bY = cur["Y"].reshape(N_LIMBS, 64, 16)
+    bZ = cur["Z"].reshape(N_LIMBS, 64, 16)
+    binf = cur["inf"].reshape(64, 16)
+
+    # weighted bucket reduction via suffix running sums, b = 15 .. 1:
+    # running += B_b; total += running  ==>  total = Σ b·B_b
+    def red(i, carry):
+        b = np.int32(15) - i
+        running, total = carry
+        e = {"X": jax.lax.dynamic_index_in_dim(bX, b, 2, keepdims=False),
+             "Y": jax.lax.dynamic_index_in_dim(bY, b, 2, keepdims=False),
+             "Z": jax.lax.dynamic_index_in_dim(bZ, b, 2, keepdims=False),
+             "inf": jax.lax.dynamic_index_in_dim(binf, b, 1,
+                                                 keepdims=False)}
+        running = pt_add_full(running, e)
+        total = pt_add_full(total, running)
+        return (running, total)
+
+    _, win = jax.lax.fori_loop(0, 15, red, (inf_pt((64,)), inf_pt((64,))))
+
+    # MSB-first Horner over the 64 window sums: acc = 16*acc + W_w
+    wX, wY, wZ, winf = win["X"], win["Y"], win["Z"], win["inf"]
+
+    def horner(w, acc):
+        for _ in range(4):
+            acc = pt_double(acc)
+        e = {"X": jax.lax.dynamic_index_in_dim(wX, w, 1, keepdims=True),
+             "Y": jax.lax.dynamic_index_in_dim(wY, w, 1, keepdims=True),
+             "Z": jax.lax.dynamic_index_in_dim(wZ, w, 1, keepdims=True),
+             "inf": jax.lax.dynamic_slice_in_dim(winf, w, 1, 0)}
+        return pt_add_full(acc, e)
+
+    return jax.lax.fori_loop(0, 64, horner, inf_pt((1,)))
+
+
+@jax.jit
+def _msm_program(xm, ym, inf8, km):
+    """The batch-verification jit surface: MSM over the packed terms,
+    verdict = is the accumulator the point at infinity. Returns (1,)
+    uint32 (1 = batch accepts). One compiled shape per _MSM_BUCKETS
+    entry — the ecdsa_msm program watch budgets exactly that set."""
+    acc = _msm_accumulate(xm, ym, inf8, km)
+    return acc["inf"].astype(jnp.uint32)
+
+
+@jax.jit
+def _msm_partial_program(xm, ym, inf8, km):
+    """Sharded-MSM building block (parallel/sig_shard): the accumulator
+    POINT instead of the verdict, packed (61, 1) uint32 = X(20) || Y(20)
+    || Z(20) || inf(1) weak limbs — per-chip partial sums fold on the
+    host (MSM is a sum; it distributes over row shards)."""
+    acc = _msm_accumulate(xm, ym, inf8, km)
+    return jnp.concatenate(
+        [acc["X"], acc["Y"], acc["Z"],
+         acc["inf"].astype(jnp.uint32).reshape(1, 1)], axis=0)
+
+
+def schnorr_msm_is_infinity(xm, ym, inf8, km) -> np.ndarray:
+    """Host entry for the batch check: returns the (1,) uint32 verdict
+    array (materialized — the MSM dispatch is eager by design; the
+    bisection ladder above it is verdict-driven)."""
+    out = _msm_program(np.asarray(xm, np.uint8), np.asarray(ym, np.uint8),
+                       np.asarray(inf8, np.uint8), np.asarray(km, np.uint8))
+    return np.asarray(out)
+
+
+def msm_partial_point(xm, ym, inf8, km) -> tuple:
+    """Host entry for one shard's partial MSM: returns ((X, Y, Z) Python
+    ints, inf bool) — the Jacobian partial accumulator, host-foldable via
+    the crypto oracle's point arithmetic."""
+    out = np.asarray(_msm_partial_program(
+        np.asarray(xm, np.uint8), np.asarray(ym, np.uint8),
+        np.asarray(inf8, np.uint8), np.asarray(km, np.uint8)))
+    x = from_limbs_np(out[0:N_LIMBS, 0]) % P
+    y = from_limbs_np(out[N_LIMBS:2 * N_LIMBS, 0]) % P
+    z = from_limbs_np(out[2 * N_LIMBS:3 * N_LIMBS, 0]) % P
+    return (x, y, z), bool(out[3 * N_LIMBS, 0])

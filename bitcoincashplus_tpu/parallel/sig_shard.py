@@ -44,6 +44,15 @@ from .mesh import CHIP_AXIS, chip_mesh, shard_map_nocheck
 # to (8, T) vregs with T a multiple of 128
 _CHIP_BUCKET = 1024
 
+# Sharded-MSM program watch (ISSUE 19). Unlike the legacy sig_shard_*
+# registrations (mesh-width x load-dependent bucket — counted, baselined
+# in tools/bcplint), the MSM shape set IS bounded: per-chip buckets come
+# off ops/ecdsa_batch._MSM_BUCKETS (6 rungs) and virtual meshes sweep
+# widths {1, 2, 4, 8}, so the signature space is 6 x 4.
+from ..util import devicewatch as _dw
+
+_PW_SHARD_MSM = _dw.program("sig_shard_msm", shape_budget=24)
+
 
 def _use_interpret(n_chips: int) -> bool:
     """Interpret mode iff the mesh's devices are CPUs — NOT the default
@@ -151,6 +160,75 @@ def _sharded_w4_jit(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8,
         # the specs state the sharding explicitly (check disabled)
     )
     return fn(u1m, u2m, qxb, qyb, qinf8, r0b, rnb, wrap8)
+
+
+@partial(jax.jit, static_argnames=("n_chips",))
+def _sharded_msm_jit(xm, ym, inf8, km, n_chips: int):
+    """Sharded Pippenger MSM (ISSUE 19): the TERM axis shards across the
+    mesh — MSM is a sum, so it distributes over row shards with no
+    cross-chip traffic during accumulation. Each chip runs the full
+    bucket-accumulation pipeline (ops/secp256k1._msm_accumulate) over its
+    local terms and emits its packed (61, 1) Jacobian partial; the host
+    folds n_chips partials with the Python-int oracle (a length-n_chips
+    fold of exact point adds — microseconds, and it keeps the
+    accept-side completeness argument in one place instead of re-proving
+    it for a psum tree of in-field adds)."""
+    from ..ops.secp256k1 import _msm_accumulate
+
+    mesh = chip_mesh(n_chips)
+    row = P(CHIP_AXIS)
+
+    def body(xm, ym, inf8, km):
+        acc = _msm_accumulate(xm, ym, inf8, km)
+        return jnp.concatenate(
+            [acc["X"], acc["Y"], acc["Z"],
+             acc["inf"].astype(jnp.uint32).reshape(1, 1)], axis=0)
+
+    fn = shard_map_nocheck(
+        body,
+        mesh,
+        in_specs=(row, row, row, row),
+        out_specs=P(None, CHIP_AXIS),  # (61, n_chips) packed partials
+    )
+    return fn(xm, ym, inf8, km)
+
+
+def msm_is_infinity_sharded(terms, n_chips: int) -> bool:
+    """Batch-equation check over the mesh: ``terms`` is the host-side
+    [(x, y, scalar)] list from the Schnorr batch equation
+    (ops/ecdsa_batch builds it); returns True iff Σ kᵢ·Pᵢ is the point
+    at infinity. Pads the term count to an MSM bucket per chip so the
+    compiled shapes stay on the declared ladder."""
+    from ..crypto import secp256k1 as oracle
+    from ..ops.ecdsa_batch import _msm_bucket_for, _msm_pack
+    from ..ops.secp256k1 import N_LIMBS, from_limbs_np
+    from ..util import devicewatch as dw
+
+    per_chip = _msm_bucket_for(
+        max(1, (len(terms) + n_chips - 1) // n_chips))
+    bucket = per_chip * n_chips
+    arrays = [np.asarray(a) for a in _msm_pack(terms, bucket)]
+    dw.note_transfer("sig_shard", "h2d",
+                     sum(int(a.nbytes) for a in arrays))
+    with _PW_SHARD_MSM.dispatch((bucket, n_chips)):
+        out = np.asarray(jax.block_until_ready(
+            _sharded_msm_jit(*arrays, n_chips=n_chips)))
+    # host fold: Jacobian partials -> affine -> oracle point_add chain
+    acc = None
+    for c in range(n_chips):
+        col = out[:, c]
+        if col[3 * N_LIMBS]:
+            continue  # chip saw only padded lanes
+        x = from_limbs_np(col[0:N_LIMBS]) % oracle.P
+        y = from_limbs_np(col[N_LIMBS:2 * N_LIMBS]) % oracle.P
+        z = from_limbs_np(col[2 * N_LIMBS:3 * N_LIMBS]) % oracle.P
+        if z == 0:
+            continue
+        zi = pow(z, oracle.P - 2, oracle.P)
+        pt = ((x * zi * zi) % oracle.P,
+              (y * zi * zi * zi) % oracle.P)
+        acc = pt if acc is None else oracle.point_add(acc, pt)
+    return acc is None
 
 
 def verify_batch_sharded(records, n_chips: int,

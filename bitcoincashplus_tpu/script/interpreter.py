@@ -139,9 +139,39 @@ def is_defined_hashtype_signature(sig: bytes) -> bool:
     return 1 <= hashtype <= SIGHASH_SINGLE
 
 
+def is_schnorr_signature(sig: bytes) -> bool:
+    """BCH 2019-05 Schnorr discrimination (CheckTransactionECDSASignature-
+    Encoding's complement): a transaction signature of exactly 65 bytes
+    (64-byte r||s body + 1 hashtype byte) IS Schnorr, by consensus rule.
+    DER encodings of 65 total bytes exist, but the upgrade removed them
+    from validity — length alone decides, so there is no parse
+    ambiguity."""
+    return len(sig) == 65
+
+
+def _check_hashtype_encoding(sig: bytes, flags: int) -> None:
+    """The STRICTENC hashtype/forkid rules, shared by the DER and Schnorr
+    encoding checks (the sighash byte plumbing is scheme-independent)."""
+    if not is_defined_hashtype_signature(sig):
+        raise ScriptError("sig-hashtype")
+    uses_forkid = bool(sig[-1] & SIGHASH_FORKID)
+    forkid_on = bool(flags & SCRIPT_ENABLE_SIGHASH_FORKID)
+    if not forkid_on and uses_forkid:
+        raise ScriptError("illegal-forkid")
+    if forkid_on and not uses_forkid:
+        raise ScriptError("must-use-forkid")
+
+
 def check_signature_encoding(sig: bytes, flags: int) -> None:
     """CheckSignatureEncoding — raises ScriptError on violation."""
     if len(sig) == 0:
+        return
+    if is_schnorr_signature(sig):
+        # Schnorr: the fixed-width encoding has no DER/low-s malleable
+        # forms, so those checks don't apply — but the STRICTENC
+        # hashtype/forkid rules still do
+        if flags & SCRIPT_VERIFY_STRICTENC:
+            _check_hashtype_encoding(sig, flags)
         return
     if flags & (
         SCRIPT_VERIFY_DERSIG | SCRIPT_VERIFY_LOW_S | SCRIPT_VERIFY_STRICTENC
@@ -150,14 +180,7 @@ def check_signature_encoding(sig: bytes, flags: int) -> None:
     if flags & SCRIPT_VERIFY_LOW_S and not is_low_der_signature(sig):
         raise ScriptError("sig-high-s")
     if flags & SCRIPT_VERIFY_STRICTENC:
-        if not is_defined_hashtype_signature(sig):
-            raise ScriptError("sig-hashtype")
-        uses_forkid = bool(sig[-1] & SIGHASH_FORKID)
-        forkid_on = bool(flags & SCRIPT_ENABLE_SIGHASH_FORKID)
-        if not forkid_on and uses_forkid:
-            raise ScriptError("illegal-forkid")
-        if forkid_on and not uses_forkid:
-            raise ScriptError("must-use-forkid")
+        _check_hashtype_encoding(sig, flags)
 
 
 def check_pubkey_encoding(pubkey: bytes, flags: int) -> None:
@@ -223,8 +246,11 @@ def _ecdsa_verify_scalar(pt, r: int, s: int, e: int) -> bool:
 
 @dataclass
 class SigCheckRecord:
-    """One deferred ECDSA verification — the unit the TPU batch consumes.
-    (pubkey point + (r,s) scalars + message-hash int, with attribution.)"""
+    """One deferred signature verification — the unit the TPU batch
+    consumes (pubkey point + (r,s) scalars + message-hash int, with
+    attribution). ``algo`` discriminates the scheme: "ecdsa" records ride
+    the per-lane GLV/w4 kernels, "schnorr" records are batchable into the
+    MSM check (ops/ecdsa_batch partitions on this field)."""
 
     pubkey: tuple  # affine (x, y)
     r: int
@@ -232,6 +258,7 @@ class SigCheckRecord:
     msg_hash: int  # sighash as big-endian int
     txid: bytes = b""
     in_idx: int = -1
+    algo: str = "ecdsa"
 
 
 class BaseSignatureChecker:
@@ -261,31 +288,42 @@ class TransactionSignatureChecker(BaseSignatureChecker):
 
     def _sighash_and_parse(self, sig: bytes, pubkey: bytes, script_code: bytes,
                            flags: int):
-        """Shared parse path: returns (point, r, s, e) or None if any parse
-        fails (pubkey off-curve, empty/garbled sig)."""
+        """Shared parse path: returns (point, r, s, e, algo) or None if any
+        parse fails (pubkey off-curve, empty/garbled sig). ``algo`` is
+        "schnorr" for 65-byte signatures (BCH length discrimination),
+        "ecdsa" for DER — both run over the SAME sighash digests."""
         if not sig:
             return None
         pt = _pubkey_parse_fast(pubkey)
         if pt is None:
             return None
         hashtype = sig[-1]
-        rs = secp.sig_der_decode(sig[:-1])
-        if rs is None:
-            return None
+        if is_schnorr_signature(sig):
+            algo = "schnorr"
+            r = int.from_bytes(sig[0:32], "big")
+            s = int.from_bytes(sig[32:64], "big")
+        else:
+            algo = "ecdsa"
+            rs = secp.sig_der_decode(sig[:-1])
+            if rs is None:
+                return None
+            r, s = rs
         ehash = signature_hash(
             script_code, self.tx, self.in_idx, hashtype, self.amount,
             enable_forkid=bool(flags & SCRIPT_ENABLE_SIGHASH_FORKID),
             cache=self.cache,
             strip_sig=S.push_data_raw(sig),
         )
-        return pt, rs[0], rs[1], int.from_bytes(ehash, "big")
+        return pt, r, s, int.from_bytes(ehash, "big"), algo
 
     def check_sig(self, sig: bytes, pubkey: bytes, script_code: bytes,
                   flags: int, defer_ok: bool = True) -> bool:
         parsed = self._sighash_and_parse(sig, pubkey, script_code, flags)
         if parsed is None:
             return False
-        pt, r, s, e = parsed
+        pt, r, s, e, algo = parsed
+        if algo == "schnorr":
+            return secp.schnorr_verify(pt, r, s, e)
         return _ecdsa_verify_scalar(pt, r, s, e)
 
     def check_locktime(self, locktime: int) -> bool:
@@ -346,11 +384,16 @@ class DeferringSignatureChecker(TransactionSignatureChecker):
         parsed = self._sighash_and_parse(sig, pubkey, script_code, flags)
         if parsed is None:
             return False
-        pt, r, s, e = parsed
-        if not (1 <= r < secp.N and 1 <= s < secp.N):
+        pt, r, s, e, algo = parsed
+        if algo == "schnorr":
+            # Schnorr ranges: r is a field element, s a scalar (spec:
+            # fail if r >= p or s >= n) — out-of-range never verifies
+            if not (r < secp.P and s < secp.N):
+                return False
+        elif not (1 <= r < secp.N and 1 <= s < secp.N):
             return False  # out-of-range scalars never verify; don't defer
         self.records.append(
-            SigCheckRecord(pt, r, s, e, self.tx.txid, self.in_idx)
+            SigCheckRecord(pt, r, s, e, self.tx.txid, self.in_idx, algo)
         )
         return True  # speculative success — batch settles it
 
@@ -711,6 +754,13 @@ def EvalScript(stack: list[bytes], script: bytes, flags: int,
                     while success and sigs_count - si > 0:
                         sig = sigs[si]
                         pubkey = keys[ki]
+                        if is_schnorr_signature(sig):
+                            # BCH consensus: 65-byte (Schnorr-sized) sigs
+                            # are forbidden in legacy CHECKMULTISIG — the
+                            # key-trial loop can't attribute a Schnorr sig
+                            # to a key without running the verify, which
+                            # defeats batching (spec 2019-05-15-schnorr)
+                            raise ScriptError("sig-badlength")
                         check_signature_encoding(sig, flags)
                         check_pubkey_encoding(pubkey, flags)
                         ok = checker.check_sig(

@@ -288,7 +288,9 @@ class SigService:
         per-tx future. Sigcache hits and in-flight duplicates never
         occupy a lane."""
         if keys is None:
-            keys = [SignatureCache.entry_key(r.msg_hash, r.r, r.s, r.pubkey)
+            keys = [SignatureCache.entry_key(
+                        r.msg_hash, r.r, r.s, r.pubkey,
+                        getattr(r, "algo", "ecdsa"))
                     for r in records]
         ctx = tm.trace_context()
         sources: list = []

@@ -58,6 +58,11 @@ NET_SITE = "net"
 # device-decompose leg specifically (ISSUE 11): fail-* proves the
 # device-decompose -> host-decompose rung, poison-output proves the KAT
 # gate; also explicit-only, for the same reason.
+# "ecdsa_msm" (ops/ecdsa_batch.MSM_SITE) targets the Schnorr Pippenger
+# batch-check leg (ISSUE 19): fail-* proves the bisect-to-oracle
+# fallback rung, poison-output flips every batch verdict — canary
+# batches included — proving the per-session canary gate catches a
+# corrupted verdict stream; also explicit-only.
 # "store_shard" (store/sharded.STORE_SHARD_SITE) fires at the head of
 # every shard's journal leg inside a sharded chainstate commit: fail-*
 # proves one failing shard aborts the WHOLE commit with the already-

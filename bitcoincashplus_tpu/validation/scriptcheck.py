@@ -310,7 +310,8 @@ class BlockScriptVerifier:
             silently dropped: the verdict comes from a fresh forced-CPU
             verification, metered as a fault fallback."""
             keys = [
-                SignatureCache.entry_key(r.msg_hash, r.r, r.s, r.pubkey)
+                SignatureCache.entry_key(r.msg_hash, r.r, r.s, r.pubkey,
+                                         r.algo)
                 for r in records[start:]
             ]
             fresh = [

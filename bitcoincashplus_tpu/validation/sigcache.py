@@ -22,9 +22,17 @@ from typing import Optional
 
 from ..util import lockwatch
 
-# Estimated resident cost per entry: the 129-byte key's bytes object
-# (~162 B via sys.getsizeof) plus the OrderedDict slot/link overhead.
+# Estimated resident cost per entry: the 130-byte key's bytes object
+# (~163 B via sys.getsizeof) plus the OrderedDict slot/link overhead.
 ENTRY_COST_BYTES = 280
+
+# Scheme tag byte appended to every entry key. Schnorr and ECDSA share
+# the (sighash, r, s, pubkey) byte layout — a 64-byte Schnorr body is
+# indistinguishable from a decoded DER (r, s) pair once parsed to ints —
+# so without the tag a cached ECDSA TRUE would satisfy a Schnorr probe
+# for the same byte material (and vice versa): presence-implies-validity
+# would cross schemes. The tag makes the keyspace disjoint per algorithm.
+_ALGO_TAGS = {"ecdsa": b"\x00", "schnorr": b"\x01"}
 
 
 class SignatureCache:
@@ -51,13 +59,15 @@ class SignatureCache:
         self.service_dedup_hits = 0
 
     @staticmethod
-    def entry_key(msg_hash: int, r: int, s: int, pubkey: tuple) -> bytes:
+    def entry_key(msg_hash: int, r: int, s: int, pubkey: tuple,
+                  algo: str = "ecdsa") -> bytes:
         return (
             msg_hash.to_bytes(32, "big")
             + r.to_bytes(32, "big")
             + s.to_bytes(32, "big")
             + pubkey[0].to_bytes(32, "big")
             + (pubkey[1] & 1).to_bytes(1, "big")
+            + _ALGO_TAGS[algo]
         )
 
     def note_dedup(self) -> None:

@@ -67,8 +67,10 @@ def pytest_collection_modifyitems(config, items):
     the first thing a timeout cuts, never the established coverage.
     The ``pipeline`` suite (pipelined-IBD differentials/unwind, tier-1,
     JAX_PLATFORMS=cpu) runs after the plain unit suite and before the
-    functional/adversarial groups; the ``glv`` kernel suite is plain-unit
-    (group 0) on purpose — fast, ordered with the unit run. The
+    functional/adversarial groups; the ``glv`` and ``msm`` kernel suites
+    are plain-unit (group 0) on purpose — fast, ordered with the unit
+    run (the msm suite pins every MSM dispatch to the bucket-64 shape,
+    the only rung whose XLA compile is unit-test-priced). The
     ``telemetry`` suite runs after ``pipeline`` (its registry-zeroing
     fixture must not interleave with suites asserting on live counters)
     and the ``serving`` suite (SigService flush policy / serviced-accept

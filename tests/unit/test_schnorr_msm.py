@@ -1,0 +1,493 @@
+"""Schnorr signatures + Pippenger MSM batch verification (ISSUE 19).
+
+Covers the whole vertical: the BCH 2019-05 Schnorr oracle
+(crypto/secp256k1), the script interpreter's 64-byte-sig length
+discrimination (CHECKSIG accepts, CHECKMULTISIG bans, the deferring
+checker records algo), the sigcache scheme tag (a cached ECDSA TRUE can
+never satisfy a Schnorr probe), and the MSM batch check in
+ops/ecdsa_batch: MSM-vs-oracle differentials over a crafted-scalar
+corpus, bad-sig-in-batch adversarial drills (forged sig at every
+position, all-bad, deduped lane) asserted byte-identical against the
+per-lane oracle with bisect depth metered, and the "ecdsa_msm" fault
+site's BCP005 drill parity (fail-* proves the bisect-to-oracle fallback
+rung, poison-output proves the canary gate catches a corrupted verdict
+stream).
+
+Every MSM dispatch in this file stays on the bucket-64 shape (batches of
+at most 31 records) — the only _MSM_BUCKETS rung whose XLA compile is
+unit-test-priced; the sharded differential (a separate compiled shape)
+is slow-marked.
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from bitcoincashplus_tpu.consensus.tx import COutPoint, CTransaction, CTxIn, CTxOut
+from bitcoincashplus_tpu.crypto import secp256k1 as oracle
+from bitcoincashplus_tpu.ops import ecdsa_batch as eb
+from bitcoincashplus_tpu.script import script as S
+from bitcoincashplus_tpu.script.interpreter import (
+    SCRIPT_ENABLE_SIGHASH_FORKID,
+    SCRIPT_VERIFY_NULLDUMMY,
+    SCRIPT_VERIFY_NULLFAIL,
+    SCRIPT_VERIFY_P2SH,
+    SCRIPT_VERIFY_STRICTENC,
+    DeferringSignatureChecker,
+    ScriptError,
+    SigCheckRecord,
+    TransactionSignatureChecker,
+    VerifyScript,
+    is_schnorr_signature,
+)
+from bitcoincashplus_tpu.script.sighash import SIGHASH_ALL, SIGHASH_FORKID, signature_hash
+from bitcoincashplus_tpu.validation.sigcache import SignatureCache
+from bitcoincashplus_tpu.wallet.keys import CKey
+
+pytestmark = pytest.mark.msm
+
+FLAGS = (SCRIPT_VERIFY_P2SH | SCRIPT_VERIFY_STRICTENC
+         | SCRIPT_VERIFY_NULLDUMMY | SCRIPT_VERIFY_NULLFAIL
+         | SCRIPT_ENABLE_SIGHASH_FORKID)
+HASHTYPE = SIGHASH_ALL | SIGHASH_FORKID
+
+
+def _srecord(i: int, good: bool = True) -> SigCheckRecord:
+    """A deterministic Schnorr sigcheck record (algo='schnorr')."""
+    d = 0x3333 + i
+    e = int.from_bytes(hashlib.sha256(b"msm%d" % i).digest(),
+                       "big") % oracle.N
+    r, s = oracle.schnorr_sign(d, e)
+    pub = oracle.point_mul(d, oracle.G)
+    return SigCheckRecord(pub, r, s, e if good else (e + 1) % oracle.N,
+                          algo="schnorr")
+
+
+def _oracle_verdicts(records) -> list:
+    return [oracle.schnorr_verify(r.pubkey, r.r, r.s, r.msg_hash)
+            for r in records]
+
+
+@pytest.fixture
+def msm_seed(monkeypatch):
+    """Pin the MSM coefficient stream (deterministic drills)."""
+    monkeypatch.setenv("BCP_MSM_SEED", "0x5eed")
+
+
+# ----------------------------------------------------------------------
+# Schnorr oracle (crypto/secp256k1)
+# ----------------------------------------------------------------------
+
+
+class TestSchnorrOracle:
+    def test_sign_verify_roundtrip(self):
+        for i in range(8):
+            d = 0x1111 + i
+            e = int.from_bytes(hashlib.sha256(b"rt%d" % i).digest(), "big")
+            r, s = oracle.schnorr_sign(d, e)
+            pub = oracle.point_mul(d, oracle.G)
+            assert oracle.schnorr_verify(pub, r, s, e)
+            assert not oracle.schnorr_verify(pub, r, s, e ^ 1)
+            assert not oracle.schnorr_verify(pub, r, (s + 1) % oracle.N, e)
+
+    def test_out_of_range_rejected(self):
+        d, e = 0xABC, 0xDEF
+        r, s = oracle.schnorr_sign(d, e)
+        pub = oracle.point_mul(d, oracle.G)
+        assert not oracle.schnorr_verify(pub, r + oracle.P, s, e)
+        assert not oracle.schnorr_verify(pub, r, s + oracle.N, e)
+
+    def test_lift_x_matches_verify_acceptance(self):
+        """The host pre-reject is oracle-consistent: lift_x(r) exists
+        exactly when r could ever be a valid Schnorr R.x (r^3+7 must be
+        a quadratic residue), and the lifted point has jacobi(y) = 1 —
+        the same root the verify equation demands."""
+        d, e = 0x777, 0x888
+        r, s = oracle.schnorr_sign(d, e)
+        lift = oracle.schnorr_lift_x(r)
+        assert lift is not None and lift[0] == r
+        assert oracle.jacobi(lift[1]) == 1
+        # an x whose cube+7 is a non-residue is unliftable AND can never
+        # verify, whatever the other inputs
+        x = 2
+        while oracle.schnorr_lift_x(x) is not None:
+            x += 1
+        pub = oracle.point_mul(d, oracle.G)
+        assert not oracle.schnorr_verify(pub, x, s, e)
+
+    def test_deterministic_nonce(self):
+        assert oracle.schnorr_sign(0x42, 0x99) == oracle.schnorr_sign(0x42, 0x99)
+
+
+# ----------------------------------------------------------------------
+# script interpreter: 64-byte-sig discrimination
+# ----------------------------------------------------------------------
+
+
+def _schnorr_spend(key: CKey, amount: int = 50_000):
+    """A P2PKH spend signed with a 65-byte Schnorr signature."""
+    spk = key.p2pkh_script()
+    tx = CTransaction(
+        vin=(CTxIn(COutPoint(b"\x11" * 32, 0)),),
+        vout=(CTxOut(amount - 1000, bytes([S.OP_1])),),
+    )
+    ehash = signature_hash(spk, tx, 0, HASHTYPE, amount, enable_forkid=True)
+    r, s = oracle.schnorr_sign(key.secret, int.from_bytes(ehash, "big"))
+    sig65 = r.to_bytes(32, "big") + s.to_bytes(32, "big") + bytes([HASHTYPE])
+    script_sig = S.push_data_raw(sig65) + S.push_data_raw(key.pubkey)
+    tx = CTransaction(
+        vin=(CTxIn(COutPoint(b"\x11" * 32, 0), script_sig=script_sig),),
+        vout=tx.vout,
+    )
+    return tx, spk, sig65, amount
+
+
+class TestInterpreterDiscrimination:
+    def test_is_schnorr_signature_length_rule(self):
+        assert is_schnorr_signature(b"\x00" * 65)
+        assert not is_schnorr_signature(b"\x00" * 64)
+        assert not is_schnorr_signature(b"\x00" * 71)  # DER-sized
+
+    def test_checksig_accepts_schnorr(self):
+        key = CKey(0xC0FFEE)
+        tx, spk, _sig, amount = _schnorr_spend(key)
+        checker = TransactionSignatureChecker(tx, 0, amount)
+        VerifyScript(tx.vin[0].script_sig, spk, FLAGS, checker)
+
+    def test_checksig_rejects_tampered_schnorr(self):
+        key = CKey(0xC0FFEE)
+        tx, spk, sig65, amount = _schnorr_spend(key)
+        bad = bytearray(sig65)
+        bad[40] ^= 1
+        script_sig = S.push_data_raw(bytes(bad)) + S.push_data_raw(key.pubkey)
+        checker = TransactionSignatureChecker(tx, 0, amount)
+        with pytest.raises(ScriptError):
+            VerifyScript(script_sig, spk, FLAGS, checker)
+
+    def test_deferring_checker_records_algo(self):
+        key = CKey(0xC0FFEE)
+        tx, spk, _sig, amount = _schnorr_spend(key)
+        records: list = []
+        checker = DeferringSignatureChecker(tx, 0, amount, records)
+        VerifyScript(tx.vin[0].script_sig, spk, FLAGS, checker)
+        assert len(records) == 1 and records[0].algo == "schnorr"
+        # the deferred record settles TRUE on the oracle
+        assert _oracle_verdicts(records) == [True]
+
+    def test_deferring_checker_range_gate(self):
+        """Out-of-range Schnorr scalars fail fast, never deferred."""
+        key = CKey(0xC0FFEE)
+        tx, spk, sig65, amount = _schnorr_spend(key)
+        r_big = oracle.P.to_bytes(32, "big")
+        bad = r_big + sig65[32:64] + bytes([HASHTYPE])
+        script_sig = S.push_data_raw(bad) + S.push_data_raw(key.pubkey)
+        records: list = []
+        checker = DeferringSignatureChecker(tx, 0, amount, records)
+        with pytest.raises(ScriptError):
+            VerifyScript(script_sig, spk, FLAGS, checker)
+        assert records == []
+
+    def test_checkmultisig_bans_schnorr_size(self):
+        """BCH consensus: 65-byte sigs inside CHECKMULTISIG are
+        sig-badlength, whatever their content."""
+        keys = [CKey(7000 + i) for i in range(2)]
+        redeem = S.multisig_script(2, [k.pubkey for k in keys])
+        spk = S.p2sh_script_for_redeem(redeem)
+        amount = 50_000
+        tx = CTransaction(
+            vin=(CTxIn(COutPoint(b"\x22" * 32, 0)),),
+            vout=(CTxOut(amount - 1000, bytes([S.OP_1])),),
+        )
+        ehash = signature_hash(redeem, tx, 0, HASHTYPE, amount,
+                               enable_forkid=True)
+        e = int.from_bytes(ehash, "big")
+        sigs = []
+        for k in keys:
+            r, s = oracle.schnorr_sign(k.secret, e)
+            sigs.append(r.to_bytes(32, "big") + s.to_bytes(32, "big")
+                        + bytes([HASHTYPE]))
+        script_sig = (b"\x00" + b"".join(S.push_data_raw(x) for x in sigs)
+                      + S.push_data_raw(redeem))
+        tx = CTransaction(vin=(CTxIn(COutPoint(b"\x22" * 32, 0),
+                                     script_sig=script_sig),),
+                          vout=tx.vout)
+        checker = TransactionSignatureChecker(tx, 0, amount)
+        with pytest.raises(ScriptError, match="sig-badlength"):
+            VerifyScript(script_sig, spk, FLAGS, checker)
+
+
+# ----------------------------------------------------------------------
+# sigcache scheme tag
+# ----------------------------------------------------------------------
+
+
+class TestSigcacheSchemeTag:
+    def test_cross_scheme_keys_disjoint(self):
+        """Crafted cross-scheme collision: the SAME (sighash, r, s,
+        pubkey) byte material keyed under both schemes must produce
+        distinct keys differing exactly in the trailing tag byte."""
+        rec = _srecord(0)
+        k_ecdsa = SignatureCache.entry_key(rec.msg_hash, rec.r, rec.s,
+                                           rec.pubkey, "ecdsa")
+        k_schnorr = SignatureCache.entry_key(rec.msg_hash, rec.r, rec.s,
+                                             rec.pubkey, "schnorr")
+        assert k_ecdsa != k_schnorr
+        assert k_ecdsa[:-1] == k_schnorr[:-1]
+        assert (k_ecdsa[-1], k_schnorr[-1]) == (0, 1)
+
+    def test_cached_ecdsa_true_never_satisfies_schnorr_probe(self):
+        rec = _srecord(1)
+        cache = SignatureCache()
+        cache.add(SignatureCache.entry_key(rec.msg_hash, rec.r, rec.s,
+                                           rec.pubkey, "ecdsa"))
+        assert not cache.contains(SignatureCache.entry_key(
+            rec.msg_hash, rec.r, rec.s, rec.pubkey, "schnorr"))
+        # and the reverse direction
+        cache.add(SignatureCache.entry_key(rec.msg_hash, rec.r, rec.s,
+                                           rec.pubkey, "schnorr"))
+        assert cache.contains(SignatureCache.entry_key(
+            rec.msg_hash, rec.r, rec.s, rec.pubkey, "schnorr"))
+
+    def test_default_algo_is_ecdsa(self):
+        rec = _srecord(2)
+        assert SignatureCache.entry_key(
+            rec.msg_hash, rec.r, rec.s, rec.pubkey
+        ) == SignatureCache.entry_key(
+            rec.msg_hash, rec.r, rec.s, rec.pubkey, "ecdsa")
+
+
+# ----------------------------------------------------------------------
+# kernel selection
+# ----------------------------------------------------------------------
+
+
+class TestKernelSelection:
+    def test_msm_in_ladder_and_settable(self):
+        assert "msm" in eb.ECDSA_KERNELS
+        prev = eb.active_kernel()
+        try:
+            assert eb.set_kernel("msm") == "msm"
+            assert eb.active_kernel() == "msm"
+        finally:
+            eb.set_kernel(prev)
+
+    def test_unknown_kernel_rejected_at_startup(self):
+        with pytest.raises(ValueError, match="ecdsakernel"):
+            eb.set_kernel("pippenger")
+
+    def test_msm_site_declared_explicit_only(self):
+        # BCP005 parity: the fault site constant is the drill handle
+        assert eb.MSM_SITE == "ecdsa_msm"
+        from bitcoincashplus_tpu.util.faults import SITES
+
+        assert eb.MSM_SITE not in SITES  # explicit opt-in only
+
+
+# ----------------------------------------------------------------------
+# MSM batch check vs the per-lane oracle (bucket-64 shapes only)
+# ----------------------------------------------------------------------
+
+
+def _msm_verify(records):
+    h = eb.dispatch_batch(records, backend="device", kernel="msm")
+    return h.result()
+
+
+class TestMsmDifferential:
+    def test_all_good_batch_accepts(self, msm_seed):
+        recs = [_srecord(100 + i) for i in range(12)]
+        before = eb.STATS.msm_batches_accepted
+        got = _msm_verify(recs)
+        assert got.tolist() == _oracle_verdicts(recs)
+        assert got.all()
+        assert eb.STATS.msm_batches_accepted > before
+
+    def test_crafted_scalar_corpus(self, msm_seed):
+        """Byte-identical accept/reject across the crafted corpus: valid
+        sigs, same-R pairs (one signer, one message, two records), the
+        unliftable-r pre-reject, boundary/out-of-range scalars, and a
+        zero scalar — every lane must match the per-lane oracle."""
+        good = _srecord(200)
+        # same-R pair: identical record twice (deterministic nonce) plus
+        # its forged twin sharing r
+        twin = SigCheckRecord(good.pubkey, good.r, good.s, good.msg_hash,
+                              algo="schnorr")
+        forged_same_r = SigCheckRecord(good.pubkey, good.r,
+                                       (good.s + 1) % oracle.N,
+                                       good.msg_hash, algo="schnorr")
+        x = 2
+        while oracle.schnorr_lift_x(x) is not None:
+            x += 1
+        unliftable = SigCheckRecord(good.pubkey, x, good.s, good.msg_hash,
+                                    algo="schnorr")
+        corpus = [
+            good, twin, forged_same_r, unliftable,
+            SigCheckRecord(good.pubkey, 0, good.s, good.msg_hash,
+                           algo="schnorr"),
+            SigCheckRecord(good.pubkey, oracle.P - 1, good.s,
+                           good.msg_hash, algo="schnorr"),
+            SigCheckRecord(good.pubkey, good.r, 0, good.msg_hash,
+                           algo="schnorr"),
+            SigCheckRecord(good.pubkey, good.r, oracle.N - 1,
+                           good.msg_hash, algo="schnorr"),
+            _srecord(201), _srecord(202),
+        ]
+        got = _msm_verify(corpus)
+        assert got.tolist() == _oracle_verdicts(corpus)
+
+    def test_forged_sig_at_every_position(self, msm_seed):
+        """One forged signature at every batch position: verdicts stay
+        byte-identical to the oracle, the batch bisects (depth metered,
+        O(log N) sub-batches), and the forged lane's False always comes
+        off the per-lane oracle (reject side never trusts the device)."""
+        n = 12
+        base = [_srecord(300 + i) for i in range(n)]
+        for pos in range(n):
+            batch = list(base)
+            batch[pos] = SigCheckRecord(
+                base[pos].pubkey, base[pos].r,
+                (base[pos].s + 1) % oracle.N, base[pos].msg_hash,
+                algo="schnorr")
+            b_bisects = eb.STATS.msm_bisects
+            b_cpu = eb.STATS.schnorr_cpu_sigs
+            got = _msm_verify(batch)
+            ref = _oracle_verdicts(batch)
+            assert got.tolist() == ref, f"forged at {pos}"
+            assert not got[pos]
+            assert got.sum() == n - 1
+            assert eb.STATS.msm_bisects > b_bisects, \
+                "a rejected batch must bisect, not settle on the device"
+            assert eb.STATS.schnorr_cpu_sigs > b_cpu, \
+                "the forged lane's verdict must come off the oracle"
+        # 12 -> 6+6 with MSM_MIN_BATCH=8: every drill bottoms out at
+        # depth 1
+        assert eb.STATS.msm_bisect_depth_max >= 1
+
+    def test_all_bad_batch(self, msm_seed):
+        recs = [_srecord(400 + i, good=False) for i in range(10)]
+        got = _msm_verify(recs)
+        assert got.tolist() == _oracle_verdicts(recs)
+        assert not got.any()
+
+    def test_mixed_algo_batch_merges_in_order(self, msm_seed):
+        """ECDSA lanes ride the existing ladder under -ecdsakernel=msm;
+        verdicts re-merge in submission order."""
+        def erec(i, good=True):
+            d = 0x4444 + i
+            e = int.from_bytes(hashlib.sha256(b"mx%d" % i).digest(),
+                               "big") % oracle.N
+            r, s = oracle.ecdsa_sign(d, e)
+            return SigCheckRecord(oracle.point_mul(d, oracle.G), r, s,
+                                  e if good else (e + 1) % oracle.N)
+
+        batch = [erec(0), _srecord(500), erec(1, good=False),
+                 _srecord(501, good=False), erec(2), _srecord(502)]
+        got = eb.dispatch_batch(batch, backend="cpu").result()
+        assert got.tolist() == [True, True, False, False, True, True]
+
+    def test_empty_and_precheck_only_batches(self, msm_seed):
+        assert eb.dispatch_batch([], backend="cpu").result().size == 0
+        # every lane host-pre-rejected: no device work, all False
+        bad = SigCheckRecord(_srecord(0).pubkey, 0, 0, 1, algo="schnorr")
+        before = eb.STATS.msm_dispatches
+        got = _msm_verify([bad] * 9)
+        assert not got.any()
+        assert eb.STATS.msm_dispatches == before
+
+
+# ----------------------------------------------------------------------
+# serving-path dedup (satellite 3: bad sig sharing a deduped lane)
+# ----------------------------------------------------------------------
+
+
+class TestServingDedup:
+    def test_bad_sig_shared_deduped_lane(self, msm_seed):
+        """Two submissions carrying the SAME bad Schnorr record (same
+        dedup key) must both read the one verified lane's False — and a
+        good record's True — byte-identical to the oracle."""
+        from bitcoincashplus_tpu.serving import SigService
+
+        prev = eb.active_kernel()
+        eb.set_kernel("msm")
+        svc = SigService(backend="device", lanes=10_000,
+                         deadline_ms=60_000).start()
+        try:
+            good = _srecord(600)
+            bad = _srecord(601, good=False)
+            fut1 = svc.submit([good, bad])
+            fut2 = svc.submit([bad])  # dedups onto fut1's in-flight lane
+            assert svc.stats["dedup_hits"] == 1
+            assert fut1.result().tolist() == [True, False]
+            assert fut2.result().tolist() == [False]
+        finally:
+            svc.stop()
+            eb.set_kernel(prev)
+
+
+# ----------------------------------------------------------------------
+# "ecdsa_msm" fault-site drills (BCP005 parity)
+# ----------------------------------------------------------------------
+
+
+class TestMsmFaultDrills:
+    def test_fail_always_falls_back_to_oracle(self, fault_harness, msm_seed):
+        """fail-* on ecdsa_msm proves the fallback rung: the batch check
+        dies on every attempt, the dispatch exhausts its retries, and
+        the whole batch settles on the per-lane oracle — verdicts
+        byte-identical, fallback metered."""
+        inj = fault_harness("fail-always", ops="ecdsa_msm")
+        recs = [_srecord(700 + i) for i in range(3)] + [
+            _srecord(710, good=False)]
+        b_fb = eb.STATS.msm_fallback_sigs
+        got = _msm_verify(recs)
+        assert got.tolist() == _oracle_verdicts(recs)
+        assert got.tolist() == [True, True, True, False]
+        assert eb.STATS.msm_fallback_sigs == b_fb + len(recs)
+        assert inj.injected.get("ecdsa_msm", 0) > 0
+
+    def test_poison_output_caught_by_canary(self, fault_harness, msm_seed):
+        """poison-output on ecdsa_msm flips EVERY batch verdict — canary
+        batches included — so the canary gate must trip (a known-good
+        batch reading reject / known-bad reading accept), poisoning must
+        never reach a caller verdict, and the records settle on the
+        oracle."""
+        fault_harness("poison-output", ops="ecdsa_msm")
+        recs = [_srecord(800 + i) for i in range(3)] + [
+            _srecord(810, good=False)]
+        b_canary = eb.STATS.msm_canary_failures
+        b_kat = eb.STATS.kat_failures
+        got = _msm_verify(recs)
+        assert got.tolist() == _oracle_verdicts(recs)
+        assert got.tolist() == [True, True, True, False]
+        assert eb.STATS.msm_canary_failures > b_canary
+        assert eb.STATS.kat_failures > b_kat
+
+
+# ----------------------------------------------------------------------
+# sharded MSM (separate compiled shape -> slow-marked)
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_sharded_msm_matches_oracle():
+    """The mesh-sharded partial-MSM fold agrees with the host oracle on
+    both polarities (exact zero combination accepted, one perturbed
+    scalar rejected)."""
+    import random as _random
+
+    from bitcoincashplus_tpu.parallel.sig_shard import msm_is_infinity_sharded
+
+    rng = _random.Random(13)
+    terms = []
+    for _ in range(8):
+        d = rng.randrange(1, oracle.N)
+        k = rng.randrange(1, oracle.N)
+        p = oracle.point_mul(d, oracle.G)
+        terms.append((p[0], p[1], k))
+        terms.append((p[0], p[1], oracle.N - k))
+    assert msm_is_infinity_sharded(terms, 2) is True
+    bad = terms[:-1] + [(terms[-1][0], terms[-1][1],
+                         (terms[-1][2] + 1) % oracle.N)]
+    assert msm_is_infinity_sharded(bad, 2) is False

@@ -346,6 +346,134 @@ def run_ecdsa_census():
     return parts
 
 
+# ---- Schnorr MSM census (--ecdsa, ISSUE 19) ---------------------------------
+#
+# The Pippenger bucket-accumulation program (ops/secp256k1._msm_accumulate)
+# amortizes ONE batch equation over M terms (M = 2·sigs + 1), so its unit
+# is vector ops per TERM, not per verify lane. Same phase-and-scale
+# convention as the w4/GLV census: each loop body is traced once and
+# multiplied by its trip count. Census shape M = 64 (the test/drill rung:
+# K = 2 streams x 32 steps); the per-term number is K-independent because
+# a step always processes K terms across K·64 lanes.
+
+def _msm_census_parts(M: int = 64):
+    import jax.numpy as jnp
+
+    from bitcoincashplus_tpu.crypto import secp256k1 as orc
+    from bitcoincashplus_tpu.ops import secp256k1 as S
+
+    rng = random.Random(9)
+    K = max(1, min(128, M // 32))
+    steps = M // K
+    lanes = K * 64
+
+    def count(f, *args, floor=64):
+        """Vector ops whose output carries >= ``floor`` elements (the MSM
+        reduction phases run at width 64; the Horner epilogue runs at
+        width 1 and is counted with floor=1 — see below)."""
+        jaxpr = jax.make_jaxpr(f)(*args)
+        total = 0
+
+        def walk(jx):
+            nonlocal total
+            for eqn in jx.eqns:
+                for sub in eqn.params.values():
+                    if hasattr(sub, "jaxpr"):
+                        walk(sub.jaxpr)
+                shapes = [v.aval.shape for v in eqn.outvars
+                          if hasattr(v.aval, "shape")]
+                if any(s and int(np.prod(s)) >= floor for s in shapes):
+                    total += 1
+
+        walk(jaxpr.jaxpr)
+        return total
+
+    def limbs(width):
+        return jnp.asarray(S.pack_batch_np(
+            [rng.randrange(orc.P) for _ in range(width)]))
+
+    # step phase: bucket gather + complete mixed add + one-hot scatter,
+    # emulated at the real (lanes, 16) bucket shape
+    bk = {"X": jnp.ones((S.N_LIMBS, lanes, 16), jnp.uint32),
+          "Y": jnp.ones((S.N_LIMBS, lanes, 16), jnp.uint32),
+          "Z": jnp.zeros((S.N_LIMBS, lanes, 16), jnp.uint32),
+          "inf": jnp.ones((lanes, 16), bool)}
+    d = jnp.ones((lanes,), jnp.int32) * 7
+    qx, qy = limbs(lanes), limbs(lanes)
+    qi = jnp.zeros((lanes,), bool)
+    bucket_ids = jnp.arange(16, dtype=jnp.int32)
+
+    def step_body(bk, qx, qy):
+        cur = {
+            "X": jnp.take_along_axis(bk["X"], d[None, :, None],
+                                     axis=2)[..., 0],
+            "Y": jnp.take_along_axis(bk["Y"], d[None, :, None],
+                                     axis=2)[..., 0],
+            "Z": jnp.take_along_axis(bk["Z"], d[None, :, None],
+                                     axis=2)[..., 0],
+            "inf": jnp.take_along_axis(bk["inf"], d[:, None],
+                                       axis=1)[:, 0],
+        }
+        new = S.pt_add_mixed(cur, qx, qy, qi)
+        hit = (bucket_ids[None, :] == d[:, None]) & ((d > 0) & ~qi)[:, None]
+        return {
+            "X": jnp.where(hit[None], new["X"][:, :, None], bk["X"]),
+            "Y": jnp.where(hit[None], new["Y"][:, :, None], bk["Y"]),
+            "Z": jnp.where(hit[None], new["Z"][:, :, None], bk["Z"]),
+            "inf": jnp.where(hit, new["inf"][:, None], bk["inf"]),
+        }
+
+    step = count(step_body, bk, qx, qy, floor=lanes)
+
+    # merge / reduction phases: one COMPLETE Jacobian add each (the jaxpr
+    # op count of pt_add_full is width-independent; widths halve down the
+    # merge tree and sit at 64 through the bucket reduction)
+    w = 64
+    pt_a = {"X": limbs(w), "Y": limbs(w), "Z": limbs(w),
+            "inf": jnp.zeros((w,), bool)}
+    pt_b = {"X": limbs(w), "Y": limbs(w), "Z": limbs(w),
+            "inf": jnp.zeros((w,), bool)}
+    full_add = count(S.pt_add_full, pt_a, pt_b, floor=w)
+    merge_levels = int(np.log2(K)) if K > 1 else 0
+    # suffix running sums: running += B_b; total += running  (2 adds x 15)
+    red = 2 * full_add
+
+    # Horner epilogue at width 1: 64 x (4 doubles + 1 add) — counted with
+    # floor=1 (every op is a (20, 1) vector op on device; excluded from
+    # the >=64-wide phases above by the same rule that excludes scalar
+    # work from the SHA census)
+    pt_1 = {"X": limbs(1), "Y": limbs(1), "Z": limbs(1),
+            "inf": jnp.zeros((1,), bool)}
+    horner = count(
+        lambda a, b: S.pt_add_full(S.pt_double(S.pt_double(S.pt_double(
+            S.pt_double(a)))), b), pt_1, pt_1, floor=1)
+
+    total = (steps * step + merge_levels * full_add + 15 * red
+             + 64 * horner)
+    return {
+        "M": M, "K": K, "steps": steps, "lanes": lanes,
+        "step": step, "full_add": full_add, "merge_levels": merge_levels,
+        "reduction": 15 * red, "horner": 64 * horner,
+        "total": total, "per_term": total / M,
+    }
+
+
+def run_msm_census():
+    p = _msm_census_parts()
+    print(f"\nSchnorr MSM bucket accumulation — vector ops "
+          f"(M = {p['M']} terms: K = {p['K']} streams x {p['steps']} "
+          f"steps, {p['lanes']} window lanes)")
+    print(f"{'phase':<34}{'ops':>12}")
+    print(f"{'bucket step (each)':<34}{p['step']:>12,}")
+    print(f"{'bucket steps':<34}{p['steps']:>12}")
+    print(f"{'stream merge (full adds)':<34}{p['merge_levels']:>12}")
+    print(f"{'bucket reduction (15 rounds)':<34}{p['reduction']:>12,}")
+    print(f"{'Horner epilogue (64 windows)':<34}{p['horner']:>12,}")
+    print(f"{'TOTAL per batch equation':<34}{p['total']:>12,}")
+    print(f"{'amortized per term':<34}{p['per_term']:>12,.1f}")
+    return p
+
+
 # ---- live cost-analysis drift check (--ecdsa) -------------------------------
 #
 # The static jaxpr census above is a MODEL derived from a specific kernel
@@ -379,6 +507,14 @@ COST_BASELINES = {
             # primitive count (+12.6k census vs +1.19M flops), which is
             # exactly why drift is per kernel against its OWN twin
             "ecdsa_glv_decompose": 3_562_004.0,
+            # Schnorr MSM batch check (ISSUE 19): compiled flops per
+            # TERM-SLOT at bucket 64 (the whole batch-equation program's
+            # flop count / 64 slots — the smallest, unit-test-priced
+            # rung; bigger buckets amortize the fixed Horner epilogue so
+            # their per-slot number is NOT comparable). §10's census
+            # counts 21.1k primitives/term at this shape — same units
+            # caveat as the fused decompose twin above
+            "ecdsa_msm": 3_716_708.0,
             # miner_resident compiled flops/nonce at tile 1024 (exact =
             # looped-compress lowering — the form a CPU backend compiles;
             # h7 = the fully-unrolled trace, which XLA's whole-program
@@ -432,17 +568,28 @@ def run_ecdsa_live_drift(parts, bucket: int = 1024):
             kwargs={"interpret": interp}):
         jax.block_until_ready(
             S._w4_bytes_program(*w4_args, interpret=interp))
+    # Schnorr MSM batch-equation program (ISSUE 19) at ITS census rung —
+    # bucket 64, the smallest _MSM_BUCKETS shape (1024 is a many-minute
+    # XLA compile on a CPU backend; the flops/term-slot unit is bucket-
+    # normalized either way). One canary-sized batch through the real
+    # dispatch helper populates the same "ecdsa_msm" watch a node feeds.
+    msm_bucket = 64
+    kg, kb = eb._schnorr_kat_records()
+    eb._msm_device_check(
+        [(kg, eb._schnorr_precheck(kg)), (kb, eb._schnorr_precheck(kb))],
+        random.Random(17))
 
     progs = dwatch.snapshot()["programs"]
-    sig = str((bucket,))
+    per_name_bucket = {"ecdsa_glv": bucket, "ecdsa_glv_decompose": bucket,
+                       "ecdsa_w4_bytes": bucket, "ecdsa_msm": msm_bucket}
     live = {}
-    for name in ("ecdsa_glv", "ecdsa_glv_decompose", "ecdsa_w4_bytes"):
-        cost = progs.get(name, {}).get("cost", {}).get(sig)
+    for name, bkt in per_name_bucket.items():
+        cost = progs.get(name, {}).get("cost", {}).get(str((bkt,)))
         if not cost:
             print("live drift check: cost_analysis unavailable on this "
                   "backend — skipped")
             return None
-        live[name] = cost["flops"] / bucket
+        live[name] = cost["flops"] / bkt
 
     arrangement = "cpu" if interp else "mosaic"
     baselines = COST_BASELINES.get(arrangement)
@@ -456,6 +603,8 @@ def run_ecdsa_live_drift(parts, bucket: int = 1024):
           f"{live['ecdsa_glv_decompose']:>14,.0f}")
     print(f"census glv/w4 ratio: {census_ratio:.4f} "
           "(primitive counts of the kernel cores — see §7)")
+    print(f"msm compiled flops/term-slot (bucket {msm_bucket}): "
+          f"{live['ecdsa_msm']:>14,.0f}")
     if baselines is None:
         print(f"no compiled-cost baseline recorded for the "
               f"{arrangement!r} lowering arrangement — reporting only "
@@ -588,6 +737,7 @@ def run_mining_live_drift(census_d, tile: int = 1024):
 def main():
     if ECDSA_MODE:
         parts = run_ecdsa_census()
+        run_msm_census()
         run_ecdsa_live_drift(parts)
         return
     if MINING_MODE:
