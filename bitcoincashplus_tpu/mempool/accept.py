@@ -59,6 +59,16 @@ _ACCEPT_H = tm.histogram(
 _ACCEPT_REJECTS = tm.counter(
     "bcp_mempool_reject_total",
     "Transactions rejected at mempool admission")
+# Per-stage breakdown of a successful accept (ISSUE 20): "context" is
+# everything up to and including the ancestor-limit check (policy,
+# finality, coin lookup, fee floor), "scripts" the signature/script leg,
+# "commit" the pool mutation (add_unchecked + trim_to_size). Under a
+# flood the interesting question is WHICH stage the p99 lives in —
+# admission CPU vs script verify vs eviction pressure.
+_STAGE_H = tm.histogram(
+    "bcp_mempool_accept_stage_seconds",
+    "AcceptToMemoryPool per-stage wall-clock",
+    labels=("stage",))
 
 
 class _StaleContext(Exception):
@@ -77,6 +87,17 @@ def accept_latency_quantiles() -> dict:
            for k, v in acc.quantiles((0.5, 0.9, 0.99)).items()}
     out["accepted"] = acc.count
     out["rejected"] = rej.count
+    return out
+
+
+def accept_stage_quantiles() -> dict:
+    """gettpuinfo.mempool's stage view: p50/p99 (ms) per accept stage."""
+    out = {}
+    for stage in ("context", "scripts", "commit"):
+        h = _STAGE_H.labels(stage=stage)
+        out[stage] = {f"{k}_ms": round(v * 1e3, 3)
+                      for k, v in h.quantiles((0.5, 0.99)).items()}
+        out[stage]["count"] = h.count
     return out
 
 
@@ -242,6 +263,7 @@ def _accept_to_memory_pool_inner(
     sig_service=None,
     wait_ctx=None,
 ) -> MempoolEntry:
+    t_ctx = _time.monotonic()
     params = chainstate.params
     if require_standard is None:
         require_standard = params.require_standard
@@ -318,6 +340,8 @@ def _accept_to_memory_pool_inner(
 
     ancestors = pool.check_ancestor_limits(tx, fee,
                                            **(ancestor_limits or {}))
+    t_scripts = _time.monotonic()
+    _STAGE_H.labels(stage="context").observe(t_scripts - t_ctx)
 
     flags = standard_script_flags(params, height)
     verify_tx_scripts(tx, spent_coins, flags, sigcache, backend=backend,
@@ -344,6 +368,8 @@ def _accept_to_memory_pool_inner(
         ancestors = pool.check_ancestor_limits(tx, fee,
                                                **(ancestor_limits or {}))
 
+    t_commit = _time.monotonic()
+    _STAGE_H.labels(stage="scripts").observe(t_commit - t_scripts)
     entry = MempoolEntry(
         tx,
         modified_fee,
@@ -355,6 +381,7 @@ def _accept_to_memory_pool_inner(
     )
     pool.add_unchecked(entry, ancestors)
     removed = pool.trim_to_size()
+    _STAGE_H.labels(stage="commit").observe(_time.monotonic() - t_commit)
     if txid not in pool:
         raise MempoolError("mempool-full", f"evicted with {len(removed) - 1} others")
     return entry

@@ -9,6 +9,7 @@ the reference behaves identically on an empty mempool).
 
 from __future__ import annotations
 
+import time as _time
 from dataclasses import dataclass, field, replace
 from typing import Optional
 
@@ -18,8 +19,30 @@ from ..consensus.params import ChainParams, get_block_subsidy
 from ..consensus.pow import get_next_work_required
 from ..consensus.tx import COutPoint, CTransaction, CTxIn, CTxOut
 from ..consensus.versionbits import compute_block_version
+from ..util import telemetry as tm
 from ..validation.chain import CBlockIndex
 from ..validation.chainstate import ChainstateManager, _script_int
+
+# ISSUE 20: getblocktemplate build-latency breakdown — "select" is the
+# mempool package-selection leg (the batched frontier's hot path),
+# "total" the whole CreateNewBlock including merkle root + the
+# TestBlockValidity dry-run. Under a flood the select leg is the part
+# the incremental frontier must keep flat.
+_TEMPLATE_H = tm.histogram(
+    "bcp_template_build_seconds",
+    "CreateNewBlock wall-clock per template",
+    labels=("stage",))
+
+
+def template_build_quantiles() -> dict:
+    """gettpuinfo.mempool's template view: p50/p99 (ms) per build stage."""
+    out = {}
+    for stage in ("select", "total"):
+        h = _TEMPLATE_H.labels(stage=stage)
+        out[stage] = {f"{k}_ms": round(v * 1e3, 3)
+                      for k, v in h.quantiles((0.5, 0.99)).items()}
+        out[stage]["count"] = h.count
+    return out
 
 
 def bip34_coinbase_script_sig(height: int, extranonce: int = 0) -> bytes:
@@ -63,6 +86,7 @@ class BlockAssembler:
         # an unsettled speculative tip would select mempool txs the
         # speculative layer already spent (the mempool only learns of
         # them at settle), assembling an invalid child
+        t0 = _time.monotonic()
         settle = getattr(self.chainstate, "settle_horizon", None)
         if settle is not None:
             settle()
@@ -81,11 +105,14 @@ class BlockAssembler:
         fees: list[int] = []
         total_fees = 0
         if self.mempool is not None:
+            t_sel = _time.monotonic()
             selected = self.mempool.select_for_block(
                 max_size=self.params.max_block_size - 1000,
                 height=height,
                 block_time=tip.get_median_time_past(),
             )
+            _TEMPLATE_H.labels(stage="select").observe(
+                _time.monotonic() - t_sel)
             for entry in selected:
                 txs.append(entry.tx)
                 fees.append(entry.base_fee)
@@ -121,6 +148,7 @@ class BlockAssembler:
         target, _bad = compact_to_target(bits)
         tmpl = BlockTemplate(block=block, fees=[0, *fees], height=height, target=target)
         self._test_block_validity(tmpl)
+        _TEMPLATE_H.labels(stage="total").observe(_time.monotonic() - t0)
         return tmpl
 
     def _test_block_validity(self, tmpl: BlockTemplate) -> None:

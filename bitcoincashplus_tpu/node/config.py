@@ -85,6 +85,14 @@ Options:
                          lower -telemetry level alongside it is rejected)
   -maxmempool=<n>        Max transaction memory pool size in MiB (default: 300)
   -mempoolexpiry=<n>     Do not keep transactions in mempool longer than <n> hours (default: 336)
+  -mempoolbatch=<0|1>    Batch-shaped mempool: numpy aggregate columns,
+                         incremental mining/eviction frontiers, staged bulk
+                         removal (default: 1; 0 pins the per-tx reference
+                         paths — the differential-test control)
+  -mempoolselfcheck=<0|1>
+                         Re-derive every batched template-selection and
+                         eviction verdict through the per-tx oracle and log
+                         divergence (debug, like -checkmempool; default: 0)
   -minrelaytxfee=<amt>   Minimum relay fee rate in satoshis/kB (default: 1000)
   -tpu=<0|1>             Use the TPU batch backend for sig verification and
                          mining sweeps (default: auto-detect)

@@ -504,6 +504,12 @@ class Node:
         self.mempool = CTxMemPool(
             max_size_bytes=config.get_int("maxmempool", 300) * 1_000_000,
             expiry_seconds=config.get_int("mempoolexpiry", 336) * 3600,
+            # -mempoolbatch=0 pins the per-tx reference paths everywhere
+            # (the differential suite's control); -mempoolselfcheck=1
+            # runs the batched-vs-reference gate on every template
+            # selection / eviction verdict (debug, like -checkmempool)
+            batch=config.get_bool("mempoolbatch", True),
+            selfcheck=config.get_bool("mempoolselfcheck", False),
         )
         self.min_relay_fee_rate = config.get_int("minrelaytxfee", 1000)
         # registry collectors (util/telemetry): project this node's
@@ -514,6 +520,8 @@ class Node:
         telemetry.register_collector("sigcache", self._sigcache_families)
         telemetry.register_collector("pipeline", self._pipeline_families)
         telemetry.register_collector("mempool", self._mempool_families)
+        telemetry.register_collector("mempool_perf",
+                                     self._mempool_perf_families)
         telemetry.register_collector("mining", self._mining_families)
         telemetry.register_collector("store", self._store_families)
         if self.sigservice is not None:
@@ -748,6 +756,42 @@ class Node:
              "help": "Serialized mempool size (bytes)",
              "samples": [({}, self.mempool.total_size)]},
         ]
+
+    def _mempool_perf_families(self) -> list:
+        # batch-shape observability (ISSUE 20): frontier depths and
+        # column occupancy as gauges, the monotone tallies as counters
+        snap = self.mempool.perf_snapshot()
+        gauges = {
+            "frontier_depth_mining": snap["frontier_depth"]["mining"],
+            "frontier_depth_evict": snap["frontier_depth"]["evict"],
+            "columns_live": snap["columns"]["live"],
+            "columns_capacity": snap["columns"]["capacity"],
+            "batch": 1 if snap["batch"] else 0,
+        }
+        counters = {
+            "column_syncs": snap["column_syncs"],
+            "rows_synced": snap["rows_synced"],
+            "frontier_pushes": snap["frontier_pushes"],
+            "frontier_stale_pops": snap["frontier_stale_pops"],
+            "frontier_rebuilds": snap["frontier_rebuilds"],
+            "bulk_evict_episodes": snap["bulk_evict_episodes"],
+            "bulk_evicted": snap["bulk_evicted"],
+            "staged_removals": snap["staged_removals"],
+            "select_batched": snap["select_batched"],
+            "select_fallbacks": snap["select_fallbacks"],
+            "trim_fallbacks": snap["trim_fallbacks"],
+            "selfchecks": snap["selfchecks"],
+            "poisoned_verdicts": snap["poisoned_verdicts"],
+        }
+        return (telemetry.flat_families(
+                    "bcp_mempool_perf", gauges, typ="gauge",
+                    help="flood-scale mempool state (frontier depth, "
+                         "column occupancy, batch mode)")
+                + telemetry.flat_families(
+                    "bcp_mempool_perf", counters, typ="counter",
+                    help="flood-scale mempool tallies (column syncs, "
+                         "stale pops, bulk evictions, fallback/gate "
+                         "verdicts)"))
 
     def _lockwatch_families(self) -> list:
         # only registered when the BCP_LOCKWATCH sentinel is on; the
@@ -2442,8 +2486,8 @@ class Node:
         # otherwise keep the closed node's whole object graph (coins
         # cache, mempool, block index) alive in the process-global
         # REGISTRY for the rest of the process
-        for name in ("sigcache", "pipeline", "mempool", "serving", "mining",
-                     "store", "lockwatch"):
+        for name in ("sigcache", "pipeline", "mempool", "mempool_perf",
+                     "serving", "mining", "store", "lockwatch"):
             telemetry.REGISTRY.unregister_collector(name)
         if self.resident_miner is not None:
             # drops the device template buffers and the miner watchdog

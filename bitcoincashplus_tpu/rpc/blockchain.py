@@ -271,6 +271,9 @@ def savemempool(node, params):
 def getmempoolinfo(node, params):
     info = node.mempool.info()
     info["mempoolminfee"] = node.min_relay_fee_rate / 1e8
+    # flood-scale perf section (ISSUE 20): batch mode, frontier depths,
+    # column occupancy, bulk-evict / fallback / gate tallies
+    info["perf"] = node.mempool.perf_snapshot()
     return info
 
 

@@ -128,7 +128,8 @@ def gettpuinfo(node, params):
         devices = [str(d) for d in jax.devices()]
     except Exception:
         pass
-    from ..mempool.accept import accept_latency_quantiles
+    from ..mempool.accept import accept_latency_quantiles, accept_stage_quantiles
+    from ..mining.assembler import template_build_quantiles
     from ..util import devicewatch, lockwatch, telemetry
 
     return {
@@ -142,6 +143,15 @@ def gettpuinfo(node, params):
         "breakers": dispatch.snapshot(),
         "faults": faults.INJECTOR.snapshot(),
         "sigcache": node.sigcache.snapshot(),
+        # flood-scale mempool (ISSUE 20): frontier depth, column-sync
+        # tallies, bulk-evict episodes, fallback/differential-gate
+        # verdicts, plus the per-stage accept and template-build p50/p99;
+        # getattr-guarded for harness stubs that pass a bare namespace
+        "mempool": ({**node.mempool.perf_snapshot(),
+                     "accept_stages": accept_stage_quantiles(),
+                     "template_build": template_build_quantiles()}
+                    if hasattr(getattr(node, "mempool", None),
+                               "perf_snapshot") else {}),
         # the device-resident mining loop (mining/resident): sweep engine
         # selection + resident-loop state; getattr-guarded for harness
         # stubs that pass a bare node namespace

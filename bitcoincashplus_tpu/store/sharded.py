@@ -49,6 +49,8 @@ import zlib
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, Optional
 
+import numpy as np
+
 from ..consensus.tx import COutPoint
 from ..util import telemetry as tm
 from ..util.faults import INJECTOR, maybe_crash
@@ -89,6 +91,87 @@ _SHARD_BYTES = tm.gauge(
 def shard_of(key36: bytes, n_shards: int) -> int:
     """Hash partition of a 36-byte outpoint key (power-of-two n_shards)."""
     return zlib.crc32(key36) & (n_shards - 1)
+
+
+class _KeyBloom:
+    """Write-side membership filter over a shard's coin keys (ISSUE 20
+    satellite, BENCH_r12 follow-up).
+
+    The accumulator delta must divide out every changed row's PERSISTED
+    old value — which costs a point lookup per changed key even when the
+    key was never persisted (the common case under flood: fresh coin
+    creates). The bloom answers "definitely absent" for those keys so
+    they skip ``get_serialized_many`` entirely; a maybe-present answer
+    falls through to the lookup, so a false positive costs only the old
+    price and a false negative is impossible (every persisted key was
+    ``add``-ed at its own commit, or at the lazy build scan).
+
+    No hash functions: outpoint keys are txid (32 uniformly random
+    bytes) + LE32 vout, so the probes are four 8-byte windows of the key
+    itself, each XOR-mixed with an odd-constant multiple of the vout
+    word (outputs of one tx share all 32 txid bytes — without the mix
+    they would share all four probes). Deterministic across processes
+    (no PYTHONHASHSEED), vectorized across the whole batch.
+    """
+
+    __slots__ = ("m_bits", "mask", "bits", "added")
+
+    _MIX = (0x9E3779B97F4A7C15, 0xC2B2AE3D27D4EB4F,
+            0x165667B19E3779F9, 0x27D4EB2F165667C5)
+
+    def __init__(self, m_bits: int):
+        # power-of-two bit count; ~1 MiB per 2^23 bits
+        self.m_bits = m_bits
+        self.mask = np.uint64(m_bits - 1)
+        self.bits = np.zeros(m_bits // 8, dtype=np.uint8)
+        self.added = 0
+
+    @classmethod
+    def sized(cls, n_keys: int) -> "_KeyBloom":
+        """~16 bits/key (4 probes -> ~0.2% FP), 1 Mi-bit floor."""
+        m = 1 << 20
+        while m < 16 * max(n_keys, 1):
+            m *= 2
+        return cls(m)
+
+    def _probes(self, keys: list[bytes]) -> list[np.ndarray]:
+        flat = np.frombuffer(b"".join(keys), dtype=np.uint8)
+        k = flat.reshape(-1, 36)
+        vout = k[:, 32:36].copy().view(np.uint32).ravel().astype(np.uint64)
+        out = []
+        with np.errstate(over="ignore"):
+            for j, mix in enumerate(self._MIX):
+                w = k[:, 8 * j:8 * j + 8].copy().view(np.uint64).ravel()
+                out.append((w ^ (vout * np.uint64(mix))) & self.mask)
+        return out
+
+    def add_many(self, keys: list[bytes]) -> None:
+        if not keys:
+            return
+        for probe in self._probes(keys):
+            np.bitwise_or.at(
+                self.bits, probe >> np.uint64(3),
+                np.left_shift(np.uint8(1),
+                              (probe & np.uint64(7)).astype(np.uint8)))
+        self.added += len(keys)
+
+    def filter(self, keys: list[bytes]) -> list[bytes]:
+        """The maybe-present subset of ``keys`` (order preserved)."""
+        if not keys:
+            return keys
+        hit = np.ones(len(keys), dtype=bool)
+        for probe in self._probes(keys):
+            hit &= (self.bits[probe >> np.uint64(3)]
+                    >> (probe & np.uint64(7)).astype(np.uint8)) & 1 > 0
+        if bool(hit.all()):
+            return keys
+        return [k for k, h in zip(keys, hit) if h]
+
+    def saturated(self) -> bool:
+        """Adds can only set bits; past ~m/8 keys the FP rate climbs
+        toward useless (~2%) — the owner rebuilds bigger from the
+        persisted rows."""
+        return self.added > self.m_bits // 8
 
 
 def _shard_paths(datadir: str, i: int) -> tuple[str, str]:
@@ -132,6 +215,14 @@ class ShardedCoinsDB(CoinsView):
         self._epoch = int(manifest["epoch"]) if manifest else \
             self._max_shard_epoch()
         self._snapshot_state = (manifest or {}).get("snapshot")
+        # write-side blooms (ISSUE 20 satellite): per-shard, in-memory
+        # only, built lazily at each shard's first commit from the
+        # persisted keys; BCP_STORE_BLOOM=0 disables (the A/B knob the
+        # utxo_store bench sweeps)
+        self.bloom_enabled = os.environ.get("BCP_STORE_BLOOM", "1") != "0"
+        self._blooms: list[Optional[_KeyBloom]] = [None] * n_shards
+        self.bloom_stats = {"checked": 0, "skipped": 0, "builds": 0,
+                            "rebuilds": 0}
         self.last_flush = {"fanout": 0, "seconds": 0.0, "coins": 0,
                            "per_shard_s": []}
 
@@ -198,9 +289,20 @@ class ShardedCoinsDB(CoinsView):
         # nothing), multiply in the new values. One modular inverse per
         # shard per commit (muhash.MuHash.apply).
         new_accs = []
+        flush_bloom = {"checked": 0, "skipped": 0}
         for i in range(self.n_shards):
             changed = list(per_puts[i]) + per_dels[i]
-            old = self.shards[i].get_serialized_many(changed) if changed \
+            # bloom pre-pass: keys the filter proves absent (fresh coin
+            # creates, the flood-common case) skip the old-value lookup;
+            # false positives just pay the lookup, false negatives are
+            # impossible (every persisted key passed through add_many)
+            if changed and self.bloom_enabled:
+                maybe = self._bloom_for(i).filter(changed)
+                flush_bloom["checked"] += len(changed)
+                flush_bloom["skipped"] += len(changed) - len(maybe)
+            else:
+                maybe = changed
+            old = self.shards[i].get_serialized_many(maybe) if maybe \
                 else {}
             removed = [muhash.coin_element(k, old[k])
                        for k in changed if k in old]
@@ -209,6 +311,12 @@ class ShardedCoinsDB(CoinsView):
             acc = muhash.MuHash(self._accs[i].state)
             acc.apply(added, removed)
             new_accs.append(acc)
+            if self.bloom_enabled and per_puts[i]:
+                # the new puts become persisted rows below — future
+                # commits must see them as maybe-present
+                self._bloom_for(i).add_many(list(per_puts[i]))
+        self.bloom_stats["checked"] += flush_bloom["checked"]
+        self.bloom_stats["skipped"] += flush_bloom["skipped"]
 
         meta_epoch = struct.pack("<Q", epoch)
         kv_puts = []
@@ -278,6 +386,7 @@ class ShardedCoinsDB(CoinsView):
             "seconds": time.perf_counter() - t0,
             "coins": n_coins,
             "per_shard_s": [round(s, 6) for s in per_shard_s],
+            "bloom": flush_bloom,
         }
         for i in range(self.n_shards):
             _SHARD_BYTES.labels(shard=str(i)).set(self.shard_bytes(i))
@@ -360,6 +469,22 @@ class ShardedCoinsDB(CoinsView):
 
     # -- CoinsDB-compatible surface --------------------------------------
 
+    def _bloom_for(self, i: int) -> _KeyBloom:
+        """The shard's bloom, built at first use from the persisted keys
+        (one full key scan per shard per process) and rebuilt bigger
+        when adds saturate it."""
+        b = self._blooms[i]
+        if b is not None and b.saturated():
+            self.bloom_stats["rebuilds"] += 1
+            b = None
+        if b is None:
+            keys = [k for k, _ in self.iterate_shard_coins(i)]
+            b = _KeyBloom.sized(max(len(keys) * 2, 1))
+            b.add_many(keys)
+            self._blooms[i] = b
+            self.bloom_stats["builds"] += 1
+        return b
+
     def _shard_for(self, key36: bytes) -> CoinsDB:
         return self.shards[shard_of(key36, self.n_shards)]
 
@@ -426,6 +551,8 @@ class ShardedCoinsDB(CoinsView):
                 f.result()
         else:
             _load(0)
+        # bulk rows bypassed the commit path: rebuild lazily on next use
+        self._blooms = [None] * self.n_shards
 
     def clear_coins(self) -> None:
         """Drop every coin row (failed snapshot load cleanup)."""
@@ -433,6 +560,7 @@ class ShardedCoinsDB(CoinsView):
             dels = [k for k, _ in shard.kv.iterate(_COIN)]
             for i in range(0, len(dels), 10000):
                 shard.kv.write_batch({}, dels[i:i + 10000])
+        self._blooms = [None] * self.n_shards
 
     def finalize_bulk_load(self, best_block: bytes,
                            shard_states: list[int],
@@ -476,6 +604,7 @@ class ShardedCoinsDB(CoinsView):
             "wal": self.wal,
             "epoch": self._epoch,
             "muhash": self.muhash_digest().hex(),
+            "bloom": {"enabled": self.bloom_enabled, **self.bloom_stats},
             "last_flush": dict(self.last_flush),
             "shard_bytes": [self.shard_bytes(i)
                             for i in range(self.n_shards)],

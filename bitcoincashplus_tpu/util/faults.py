@@ -93,6 +93,17 @@ REPLICA_RPC_SITE = "replica_rpc"
 # a half-loaded chainstate.
 SNAPSHOT_CERT_SITE = "snapshot_cert"
 
+# Flood-scale mempool site (ISSUE 20), explicit-only like the other
+# non-accelerator sites. It fires at the head of the batched legs of
+# template selection (CTxMemPool.select_for_block) and bulk eviction
+# (trim_to_size): fail-* proves the per-tx reference fallback rung
+# (frontier/columns bypassed, answer unchanged), poison-output corrupts
+# the batched verdict — a dropped template tail, a wrong eviction victim
+# — and must be caught by the differential gate re-deriving the verdict
+# through the per-tx oracle (the -mempoolselfcheck path, always-on under
+# poison drills).
+MEMPOOL_SITE = "mempool"
+
 
 class InjectedFault(RuntimeError):
     """A deliberately injected device failure (never raised in production

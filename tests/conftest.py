@@ -94,6 +94,12 @@ def pytest_collection_modifyitems(config, items):
             # failure the run can produce
             if item.get_closest_marker("lint"):
                 return -1
+            # the ``mempoolstorm`` differential suite (ISSUE 20) is the
+            # newest non-functional coverage: after ``serving``, still
+            # before every functional group (fractional key — the
+            # functional ladder starts at 6)
+            if item.get_closest_marker("mempoolstorm"):
+                return 5.5
             if item.get_closest_marker("serving"):
                 return 5
             if item.get_closest_marker("mining"):

@@ -1,0 +1,391 @@
+"""Flood-scale mempool differentials (ISSUE 20).
+
+The batched pool (numpy columns + incremental frontiers + staged bulk
+removal) must agree ENTRY-FOR-ENTRY with the per-tx reference paths —
+same survivors, same aggregates, same template, same eviction victims —
+over seeded random package graphs, including deep chains at the
+ancestor limits and prioritisetransaction deltas mid-storm. The
+`mempoolstorm` marker groups the suite after the serving unit tests
+(conftest ordering); everything here is pure pool mechanics, no
+chainstate, tier-1 fast.
+"""
+
+import random
+
+import pytest
+
+from bitcoincashplus_tpu.consensus.tx import COutPoint, CTransaction, CTxIn, CTxOut
+from bitcoincashplus_tpu.mempool import CTxMemPool, MempoolEntry
+from bitcoincashplus_tpu.mempool.mempool import (
+    MEMPOOL_SITE,
+    feerate_gt,
+    score_key,
+)
+
+pytestmark = pytest.mark.mempoolstorm
+
+
+def _fake_tx(inputs, n_out=1, value=10_000, salt=0):
+    return CTransaction(
+        vin=tuple(CTxIn(op, bytes([salt % 256, (salt >> 8) % 256]))
+                  for op in inputs),
+        vout=tuple(CTxOut(value, b"\x51") for _ in range(n_out)),
+    )
+
+
+def _root_tx(salt, n_out=1):
+    return _fake_tx(
+        [COutPoint(salt.to_bytes(4, "big") * 8, 0)], n_out=n_out, salt=salt)
+
+
+def _entry(tx, fee=1000, t=0, height=1):
+    return MempoolEntry(tx, fee, t, height)
+
+
+# ----------------------------------------------------------------------
+# seeded storm: a random package-graph op sequence applied to a pool
+# ----------------------------------------------------------------------
+
+
+def _run_storm(pool: CTxMemPool, seed: int, n_ops: int = 300,
+               max_bytes: int = None) -> None:
+    """Apply a deterministic random op storm: adds (deep chains and wide
+    fans alike), recursive removals, block confirmations, prioritise
+    deltas (negative included), expiry sweeps, and -maxmempool trims.
+    Same seed => byte-identical op sequence regardless of pool flavor."""
+    rng = random.Random(seed)
+    salt = seed * 1_000_000
+    clock = 0
+    for _ in range(n_ops):
+        clock += rng.randint(0, 50)
+        op = rng.random()
+        if op < 0.62 or not pool.entries:
+            salt += 1
+            # extend an existing package (possibly to the 25-deep limit)
+            # or start a fresh root
+            if pool.entries and rng.random() < 0.7:
+                parent = pool.entries[
+                    rng.choice(sorted(pool.entries))]
+                if parent.count_with_ancestors >= 25:
+                    tx = _root_tx(salt, n_out=rng.randint(1, 3))
+                else:
+                    spent = {op_.n for op_ in pool.map_next_tx
+                             if op_.hash == parent.txid}
+                    free = [i for i in range(len(parent.tx.vout))
+                            if i not in spent]
+                    if not free:
+                        tx = _root_tx(salt, n_out=rng.randint(1, 3))
+                    else:
+                        tx = _fake_tx(
+                            [COutPoint(parent.txid, rng.choice(free))],
+                            n_out=rng.randint(1, 3), salt=salt)
+            else:
+                tx = _root_tx(salt, n_out=rng.randint(1, 3))
+            fee = rng.randint(100, 50_000)
+            fee += pool.map_deltas.get(tx.txid, 0)
+            pool.add_unchecked(_entry(tx, fee=fee, t=clock))
+        elif op < 0.72:
+            victim = rng.choice(sorted(pool.entries))
+            pool.remove_recursive(victim)
+        elif op < 0.82:
+            txid = rng.choice(sorted(pool.entries))
+            pool.prioritise(txid, rng.randint(-3000, 8000))
+        elif op < 0.90:
+            # confirm a package prefix in a "block" — parents first, the
+            # order remove_for_block sees
+            roots = [t for t, e in pool.entries.items()
+                     if e.count_with_ancestors == 1]
+            if roots:
+                root = rng.choice(sorted(roots))
+                stage = sorted(
+                    pool.calculate_descendants(root),
+                    key=lambda t: (pool.entries[t].count_with_ancestors, t))
+                k = rng.randint(1, len(stage))
+                pool.remove_for_block(
+                    [pool.entries[t].tx for t in stage[:k]])
+        elif op < 0.95:
+            pool.expire(now=clock - rng.randint(0, 500)
+                        + pool.expiry_seconds)
+        elif max_bytes is not None:
+            pool.trim_to_size(
+                max(max_bytes, int(pool.total_size * 0.7)))
+
+
+def _oracle_aggregates(pool: CTxMemPool, txid: bytes) -> tuple:
+    """Brute-force recompute of one entry's cached aggregates by walking
+    the live graph."""
+    e = pool.entries[txid]
+    anc = pool.calculate_ancestors(e.tx)
+    desc = pool.calculate_descendants(txid)  # includes self
+    return (
+        len(anc) + 1,
+        e.size + sum(pool.entries[a].size for a in anc),
+        e.fee + sum(pool.entries[a].fee for a in anc),
+        len(desc),
+        sum(pool.entries[d].size for d in desc),
+        sum(pool.entries[d].fee for d in desc),
+    )
+
+
+def _assert_pool_consistent(pool: CTxMemPool) -> None:
+    for txid, e in pool.entries.items():
+        assert (
+            e.count_with_ancestors, e.size_with_ancestors,
+            e.fees_with_ancestors, e.count_with_descendants,
+            e.size_with_descendants, e.fees_with_descendants,
+        ) == _oracle_aggregates(pool, txid), txid.hex()
+        if pool.batch:
+            row = pool.columns.txrow[txid]
+            assert pool.columns.fees_wa[row] == e.fees_with_ancestors
+            assert pool.columns.size_wd[row] == e.size_with_descendants
+            assert pool.columns.count_wa[row] == e.count_with_ancestors
+            assert pool.columns.fee[row] == e.fee
+    assert pool.total_size == sum(e.size for e in pool.entries.values())
+    assert pool.total_fee == sum(e.fee for e in pool.entries.values())
+    assert len(pool.map_next_tx) == sum(
+        len(e.tx.vin) for e in pool.entries.values())
+
+
+class TestStormDifferential:
+    @pytest.mark.parametrize("seed", [1, 7, 42, 1337])
+    def test_batched_vs_reference_lockstep(self, seed):
+        """Same seeded storm into a batched and a reference pool: the
+        surviving sets, every cached aggregate, the template, and the
+        eviction victims must be identical."""
+        batched = CTxMemPool(batch=True)
+        reference = CTxMemPool(batch=False)
+        _run_storm(batched, seed, max_bytes=60_000)
+        _run_storm(reference, seed, max_bytes=60_000)
+
+        assert set(batched.entries) == set(reference.entries)
+        for txid, e in batched.entries.items():
+            r = reference.entries[txid]
+            assert (e.fee, e.fees_with_ancestors, e.size_with_ancestors,
+                    e.fees_with_descendants, e.size_with_descendants) == \
+                   (r.fee, r.fees_with_ancestors, r.size_with_ancestors,
+                    r.fees_with_descendants, r.size_with_descendants)
+        _assert_pool_consistent(batched)
+        _assert_pool_consistent(reference)
+
+        # template parity at several size caps (overflow-skip coverage)
+        for cap in (2_000, 10_000, 1_000_000):
+            sel_b = batched.select_for_block(cap, height=1, block_time=0)
+            sel_r = reference.select_for_block(cap, height=1, block_time=0)
+            assert [e.txid for e in sel_b] == [e.txid for e in sel_r]
+
+        # eviction parity: trim both to the same shrinking caps
+        for frac in (0.75, 0.4, 0.0):
+            cap = int(batched.total_size * frac)
+            assert batched.trim_to_size(cap) == reference.trim_to_size(cap)
+            assert set(batched.entries) == set(reference.entries)
+        assert batched.perf["select_batched"] >= 3
+        assert batched.perf["bulk_evict_episodes"] >= 1
+
+    @pytest.mark.parametrize("seed", [3, 99])
+    def test_aggregate_oracle_after_storm(self, seed):
+        pool = CTxMemPool(batch=True)
+        _run_storm(pool, seed, n_ops=400, max_bytes=50_000)
+        _assert_pool_consistent(pool)
+
+    def test_prioritise_mid_storm_negative_delta(self):
+        """A negative delta mid-chain reorders both template and
+        eviction identically in both flavors."""
+        pools = [CTxMemPool(batch=True), CTxMemPool(batch=False)]
+        for pool in pools:
+            parent = _root_tx(1, n_out=2)
+            child = _fake_tx([COutPoint(parent.txid, 0)], salt=2)
+            rival = _root_tx(3)
+            pool.add_unchecked(_entry(parent, fee=5000))
+            pool.add_unchecked(_entry(child, fee=5000))
+            pool.add_unchecked(_entry(rival, fee=4000))
+            pool.prioritise(parent.txid, -4500)
+        sel = [[e.txid for e in p.select_for_block(10**6, 1, 0)]
+               for p in pools]
+        assert sel[0] == sel[1]
+        assert sel[0][0] == pools[0].entries[
+            sorted(pools[0].entries,
+                   key=lambda t: -score_key(
+                       pools[0].entries[t].fees_with_ancestors,
+                       pools[0].entries[t].size_with_ancestors))[0]].txid
+        assert pools[0].trim_to_size(0) == pools[1].trim_to_size(0)
+
+    def test_deep_chain_at_ancestor_limit(self):
+        """A 25-deep chain (the ancestor limit) stays exact in both
+        flavors through selection and staged removal."""
+        pools = [CTxMemPool(batch=True), CTxMemPool(batch=False)]
+        for pool in pools:
+            prev = _root_tx(1)
+            pool.add_unchecked(_entry(prev, fee=100))
+            for d in range(24):
+                nxt = _fake_tx([COutPoint(prev.txid, 0)], salt=d + 2)
+                pool.add_unchecked(_entry(nxt, fee=100 * (d + 2)))
+                prev = nxt
+            assert pool.entries[prev.txid].count_with_ancestors == 25
+        sels = [[e.txid for e in p.select_for_block(10**6, 1, 0)]
+                for p in pools]
+        assert sels[0] == sels[1] and len(sels[0]) == 25
+        # confirming the middle of the chain in a block must not leak
+        # aggregates in either flavor
+        for pool in pools:
+            stage = sorted(
+                pool.entries.values(),
+                key=lambda e: e.count_with_ancestors)[:13]
+            pool.remove_for_block([e.tx for e in stage])
+            _assert_pool_consistent(pool)
+        assert set(pools[0].entries) == set(pools[1].entries)
+
+
+class TestExactFeerates:
+    def test_cross_multiplication_beats_float_ties(self):
+        """Fee magnitudes where float64 rounds to a tie must still order
+        exactly (the satellite's reason to exist)."""
+        fee_a, size_a = (1 << 53) + 1, 1000
+        fee_b, size_b = (1 << 53), 1000
+        assert fee_a / size_a == fee_b / size_b  # float can't see it
+        assert feerate_gt(fee_a, size_a, fee_b, size_b)
+        assert not feerate_gt(fee_b, size_b, fee_a, size_a)
+        assert score_key(fee_a, size_a) > score_key(fee_b, size_b)
+
+    def test_score_key_matches_cross_multiplication(self):
+        rng = random.Random(5)
+        pairs = [(rng.randint(-10_000, 10**15), rng.randint(60, 2_500_000))
+                 for _ in range(500)]
+        for (fa, sa), (fb, sb) in zip(pairs[:-1], pairs[1:]):
+            gt = feerate_gt(fa, sa, fb, sb)
+            lt = feerate_gt(fb, sb, fa, sa)
+            key_a, key_b = score_key(fa, sa), score_key(fb, sb)
+            if gt:
+                assert key_a > key_b
+            elif lt:
+                assert key_a < key_b
+            else:
+                assert key_a == key_b
+
+    def test_float_forms_still_exist_for_display(self):
+        e = _entry(_root_tx(1), fee=1234)
+        assert e.fee_rate() == pytest.approx(1234 / e.size)
+        assert e.ancestor_fee_rate() == e.descendant_fee_rate()
+
+
+class TestRemoveForBlockLeak:
+    def test_parent_before_child_confirmation_no_leak(self):
+        """Regression: G -> A -> B with A and B confirmed in one block.
+        The old sequential removal dropped A first, severing B's
+        ancestor walk to G — G kept phantom descendant aggregates
+        forever. The staged removal fixes both relatives against the
+        pre-removal graph."""
+        pool = CTxMemPool(batch=True)
+        g = _root_tx(1)
+        a = _fake_tx([COutPoint(g.txid, 0)], salt=2)
+        b = _fake_tx([COutPoint(a.txid, 0)], salt=3)
+        pool.add_unchecked(_entry(g, fee=1000))
+        pool.add_unchecked(_entry(a, fee=2000))
+        pool.add_unchecked(_entry(b, fee=3000))
+        pool.remove_for_block([a, b])  # block order: parent first
+        ge = pool.entries[g.txid]
+        assert ge.count_with_descendants == 1
+        assert ge.size_with_descendants == ge.size
+        assert ge.fees_with_descendants == ge.fee
+        _assert_pool_consistent(pool)
+
+
+class TestFaultDrills:
+    def test_fail_once_falls_back_to_reference(self, fault_harness):
+        """BCP005 parity, fail leg: an injected fault at the mempool
+        site must take the per-tx reference path and still produce the
+        reference answer."""
+        pool = CTxMemPool(batch=True)
+        control = CTxMemPool(batch=False)
+        for p in (pool, control):
+            _run_storm(p, seed=11, n_ops=120)
+        fault_harness("fail-once", ops="mempool")
+        sel = [e.txid for e in pool.select_for_block(10**6, 1, 0)]
+        ref = [e.txid for e in control.select_for_block(10**6, 1, 0)]
+        assert sel == ref
+        assert pool.perf["select_fallbacks"] == 1
+
+        fault_harness("fail-once", ops="mempool")
+        assert pool.trim_to_size(0) == control.trim_to_size(0)
+        assert pool.perf["trim_fallbacks"] == 1
+
+    def test_poison_caught_by_differential_gate(self, fault_harness):
+        """BCP005 parity, poison leg: a corrupted batched verdict (a
+        dropped template tail, a wrong eviction victim) must be caught
+        by the gate and replaced with the per-tx oracle's answer."""
+        pool = CTxMemPool(batch=True)
+        control = CTxMemPool(batch=False)
+        for p in (pool, control):
+            _run_storm(p, seed=23, n_ops=120)
+        fault_harness("poison-output", ops="mempool")
+        sel = [e.txid for e in pool.select_for_block(10**6, 1, 0)]
+        ref = [e.txid for e in control.select_for_block(10**6, 1, 0)]
+        assert sel == ref  # the oracle's answer, not the poisoned one
+        assert pool.perf["poisoned_verdicts"] >= 1
+
+        before = pool.perf["poisoned_verdicts"]
+        assert pool.trim_to_size(0) == control.trim_to_size(0)
+        assert pool.perf["poisoned_verdicts"] > before
+        assert set(pool.entries) == set(control.entries) == set()
+
+    def test_selfcheck_clean_on_honest_verdicts(self):
+        """-mempoolselfcheck with no fault armed: gates run, nothing
+        diverges."""
+        pool = CTxMemPool(batch=True, selfcheck=True)
+        _run_storm(pool, seed=31, n_ops=150)
+        pool.select_for_block(10**6, 1, 0)
+        pool.trim_to_size(max(0, pool.total_size // 2))
+        assert pool.perf["selfchecks"] >= 1
+        assert pool.perf["poisoned_verdicts"] == 0
+
+
+class TestPerfSurface:
+    def test_perf_snapshot_shape(self):
+        pool = CTxMemPool(batch=True)
+        _run_storm(pool, seed=2, n_ops=80, max_bytes=20_000)
+        snap = pool.perf_snapshot()
+        assert snap["batch"] is True
+        assert snap["frontier_depth"]["mining"] >= len(pool.entries)
+        assert snap["columns"]["live"] == len(pool.entries)
+        for key in ("column_syncs", "rows_synced", "frontier_pushes",
+                    "frontier_stale_pops", "bulk_evict_episodes",
+                    "staged_removals", "select_fallbacks",
+                    "poisoned_verdicts"):
+            assert isinstance(snap[key], int)
+
+    def test_reference_pool_snapshot(self):
+        pool = CTxMemPool(batch=False)
+        _run_storm(pool, seed=2, n_ops=40)
+        snap = pool.perf_snapshot()
+        assert snap["batch"] is False
+        assert snap["columns"]["live"] == 0
+
+    def test_frontier_compaction_bounds_heap(self):
+        """Dead keys accumulate per mutation; the lazy heaps must stay
+        O(pool) via compaction."""
+        pool = CTxMemPool(batch=True)
+        root = _root_tx(1)
+        pool.add_unchecked(_entry(root, fee=1000))
+        for i in range(600):
+            pool.prioritise(root.txid, 1 if i % 2 == 0 else -1)
+        assert len(pool._mine_heap) <= max(256, 8 * len(pool.entries))
+        assert pool.perf["frontier_rebuilds"] >= 1
+        # the surviving frontier still answers exactly
+        assert pool.select_for_block(10**6, 1, 0)[0].txid == root.txid
+
+
+class TestColumnsGrowth:
+    def test_row_recycling_and_growth(self):
+        pool = CTxMemPool(batch=True)
+        txids = []
+        for i in range(1, 1500):
+            tx = _root_tx(i)
+            pool.add_unchecked(_entry(tx, fee=1000 + i))
+            txids.append(tx.txid)
+        assert pool.columns.cap >= 1500 and pool.columns.grows >= 1
+        for t in txids[:700]:
+            pool.remove_recursive(t)
+        free_before = len(pool.columns.free)
+        for i in range(2000, 2300):
+            pool.add_unchecked(_entry(_root_tx(i), fee=500))
+        assert len(pool.columns.free) == free_before - 300  # recycled
+        _assert_pool_consistent(pool)
